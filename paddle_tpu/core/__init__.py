@@ -1,0 +1,16 @@
+"""paddle_tpu.core — runtime core (L1–L3 analog, SURVEY.md §7 stage 1)."""
+from . import autograd, device, dtype, flags, random  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from .random import get_seed, seed  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

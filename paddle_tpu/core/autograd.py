@@ -1,0 +1,400 @@
+"""Tape-based reverse-mode autograd for eager (dygraph) mode.
+
+TPU-native analog of the reference's imperative engine:
+  - `Tracer::TraceOp` (reference: paddle/fluid/imperative/tracer.cc:132) --
+    here `apply()`: run the op, and if any input requires grad, record a
+    TapeNode holding the op's VJP (obtained from `jax.vjp`, replacing the
+    reference's per-op GradOpMaker machinery in op_registry.h).
+  - `BasicEngine` (reference: paddle/fluid/imperative/basic_engine.cc:39,221,265)
+    -- here `run_backward()`: topological walk of TapeNodes from the loss,
+    calling each VJP and accumulating cotangents (GradientAccumulator analog).
+  - `PartialGradEngine` (partial_grad_engine.cc) -- here `grad()`.
+
+Design notes (tpu-first): every eager op is dispatched to XLA through jax;
+grad functions are jax VJPs, so the backward graph is XLA-compiled per op the
+same way the forward is. For full-program performance, to_static wraps the
+whole step in a single jitted function whose VJP becomes ONE tape node, so
+the tape overhead vanishes (the analog of the reference's run_program op,
+operators/run_program_op.cc).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.trace_depth = 0  # >0 -> inside a jit trace: tape off, pure jax
+
+
+_state = _State()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled and _state.trace_depth == 0
+
+
+def set_grad_enabled(flag: bool) -> bool:
+    prev = _state.grad_enabled
+    _state.grad_enabled = bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def no_grad():
+    """paddle.no_grad() — disable tape recording."""
+    prev = set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = set_grad_enabled(True)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+@contextlib.contextmanager
+def trace_mode():
+    """Inside a to_static/jit trace: ops run as pure jax, no tape."""
+    _state.trace_depth += 1
+    try:
+        yield
+    finally:
+        _state.trace_depth -= 1
+
+
+def in_trace() -> bool:
+    return _state.trace_depth > 0
+
+
+class TapeNode:
+    """One recorded op on the tape (OpBase/GradOpNode analog, op_base.h:33)."""
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "n_out",
+        "out_avals",
+        "out_refs",
+        "name",
+        "released",
+    )
+
+    def __init__(self, vjp_fn, inputs, n_out, out_avals, name=None):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # tuple[Tensor] — strong refs, like VarBase grad graph
+        self.n_out = n_out
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.out_refs = [None] * n_out  # weakrefs to wrapped output Tensors
+        self.name = name or "op"
+        self.released = False
+
+
+def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
+    """Run `raw_fn` over the raw jax arrays of `tensors`; record VJP if needed.
+
+    Returns Tensor or tuple[Tensor] mirroring raw_fn's output structure.
+    The Tracer::TraceOp analog: forward dispatch + tape append
+    (reference: tracer.cc:132,205 CreateGradOpNode).
+    """
+    from .tensor import Tensor  # late import; Tensor depends on ops at patch time
+
+    raws = tuple(t._data for t in tensors)
+    need_grad = (
+        _state.trace_depth == 0
+        and _state.grad_enabled
+        and any(not t.stop_gradient for t in tensors)
+    )
+    if not need_grad:
+        out = raw_fn(*raws)
+        if isinstance(out, (tuple, list)):
+            return tuple(Tensor._wrap(o, stop_gradient=True) for o in out)
+        return Tensor._wrap(out, stop_gradient=True)
+
+    out, vjp_fn = jax.vjp(raw_fn, *raws)
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+    node = TapeNode(
+        vjp_fn,
+        tuple(tensors),
+        len(outs),
+        [(o.shape, o.dtype) for o in outs],
+        name=name,
+    )
+    wrapped = tuple(
+        Tensor._wrap(o, stop_gradient=False, node=node, out_idx=i)
+        for i, o in enumerate(outs)
+    )
+    node.out_refs = [weakref.ref(w) for w in wrapped]
+    return wrapped if multi else wrapped[0]
+
+
+def apply_nondiff(raw_fn: Callable, tensors: Sequence):
+    """Dispatch an op that is never differentiable (argmax, comparisons...)."""
+    from .tensor import Tensor
+
+    out = raw_fn(*(t._data for t in tensors))
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor._wrap(o, stop_gradient=True) for o in out)
+    return Tensor._wrap(out, stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# Backward engine
+# ---------------------------------------------------------------------------
+
+
+def _topo_order(roots: List[TapeNode]) -> List[TapeNode]:
+    """Postorder DFS -> topological order (inputs before consumers).
+
+    Analog of BasicEngine::PrepareDeps' in-degree pass (basic_engine.cc:221);
+    an explicit stack keeps arbitrarily deep graphs from hitting the Python
+    recursion limit.
+    """
+    order: List[TapeNode] = []
+    visited = set()
+    for root in roots:
+        if id(root) in visited:
+            continue
+        stack: List[Tuple[TapeNode, bool]] = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for t in node.inputs:
+                if (
+                    t._node is not None
+                    and not t.stop_gradient
+                    and id(t._node) not in visited
+                ):
+                    stack.append((t._node, False))
+    return order
+
+
+def _zeros_for(aval):
+    shape, dtype = aval
+    return jnp.zeros(shape, dtype)
+
+
+def _is_float0(g) -> bool:
+    return getattr(g, "dtype", None) == jax.dtypes.float0
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False):
+    """loss.backward() engine (BasicEngine::Execute analog, basic_engine.cc:265).
+
+    Accumulates cotangents into `.grad` of leaf tensors with
+    stop_gradient=False (paddle accumulation semantics: grads sum across
+    backward calls until clear_grad).
+    """
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    if not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    if len(grad_tensors) != len(tensors):
+        raise ValueError(
+            f"backward: got {len(tensors)} tensors but {len(grad_tensors)} "
+            "grad_tensors"
+        )
+
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            seeds.append(jnp.ones(t._data.shape, t._data.dtype))
+        else:
+            seeds.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+
+    _run_engine(tensors, seeds, accumulate_into_grad=True, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad — compute grads of outputs wrt inputs without touching .grad.
+
+    PartialGradEngine analog (reference: imperative/partial_grad_engine.cc).
+    create_graph (double grad) is not yet supported in eager mode; use
+    jax.grad composition through to_static for higher-order derivatives.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported on the eager tape; "
+            "compose jax.grad via paddle_tpu.jit for higher-order derivatives"
+        )
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    if not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if len(grad_outputs) != len(outputs):
+        raise ValueError(
+            f"grad: got {len(outputs)} outputs but {len(grad_outputs)} "
+            "grad_outputs"
+        )
+
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            seeds.append(jnp.ones(t._data.shape, t._data.dtype))
+        else:
+            seeds.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+
+    wanted = {id(t): i for i, t in enumerate(inputs)}
+    collected = {}
+
+    _run_engine(
+        outputs,
+        seeds,
+        accumulate_into_grad=False,
+        retain_graph=bool(retain_graph),
+        wanted=wanted,
+        collected=collected,
+    )
+
+    results = []
+    for t in inputs:
+        g = collected.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the "
+                    "graph; pass allow_unused=True to return None for it"
+                )
+            results.append(None)
+        else:
+            results.append(Tensor._wrap(g, stop_gradient=True))
+    return results
+
+
+def _run_engine(
+    tensors,
+    seeds,
+    accumulate_into_grad: bool,
+    retain_graph: bool = False,
+    wanted=None,
+    collected=None,
+):
+    """Core reverse sweep.
+
+    Cotangents are routed to the producing (node, out_idx) slot, which is the
+    per-tensor total: a tensor's gradient is *finalized* exactly when its
+    producer node is popped (all consumers processed first, by topo order).
+    Hooks therefore fire once, on the accumulated gradient — matching the
+    reference's accumulator-then-hook order (gradient_accumulator.cc +
+    VariableWrapper hooks).
+    """
+    from .tensor import Tensor
+
+    pending = {}  # id(node) -> [cotangent per output]
+    leaf_acc = {}  # id(tensor) -> [tensor, cotangent]
+
+    def deposit(t, g):
+        if t._node is not None:
+            slot = pending.setdefault(id(t._node), [None] * t._node.n_out)
+            slot[t._out_idx] = (
+                g if slot[t._out_idx] is None else slot[t._out_idx] + g
+            )
+        else:
+            ent = leaf_acc.setdefault(id(t), [t, None])
+            ent[1] = g if ent[1] is None else ent[1] + g
+
+    def finalize(t, g):
+        """Apply hooks to a finalized total and serve `wanted` collection."""
+        for hook in t._grad_hooks:
+            h = hook(Tensor._wrap(g, stop_gradient=True))
+            if h is not None:
+                g = h._data if isinstance(h, Tensor) else h
+        if wanted is not None and id(t) in wanted:
+            prev = collected.get(id(t))
+            collected[id(t)] = g if prev is None else prev + g
+        return g
+
+    roots = []
+    for t, s in zip(tensors, seeds):
+        if t._node is not None:
+            if t._node.released:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time; "
+                    "pass retain_graph=True to the first backward call"
+                )
+            roots.append(t._node)
+        deposit(t, s)
+
+    order = _topo_order(roots)
+
+    for node in reversed(order):
+        cots = pending.pop(id(node), None)
+        if cots is None:
+            continue
+        final = []
+        for i, (c, aval) in enumerate(zip(cots, node.out_avals)):
+            if c is None:
+                final.append(_zeros_for(aval))
+                continue
+            ref = node.out_refs[i]
+            t_out = ref() if ref is not None else None
+            if t_out is not None:
+                c = finalize(t_out, c)
+            final.append(c)
+        arg = tuple(final) if node.n_out > 1 else final[0]
+        in_cots = node.vjp_fn(arg)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.released = True
+        for t, g in zip(node.inputs, in_cots):
+            if _is_float0(g):
+                continue
+            if t.stop_gradient:
+                continue
+            if t._node is not None and t._node.released and not retain_graph:
+                continue
+            deposit(t, g)
+
+    for t, g in leaf_acc.values():
+        if g is None:
+            continue
+        g = finalize(t, g)
+        if accumulate_into_grad and not t.stop_gradient:
+            _accum_leaf(t, g)
+
+
+def _accum_leaf(t, g):
+    """GradientAccumulator analog (imperative/gradient_accumulator.cc)."""
+    from .tensor import Tensor
+
+    if t.grad is None:
+        t.grad = Tensor._wrap(jnp.asarray(g), stop_gradient=True)
+    else:
+        t.grad = Tensor._wrap(t.grad._data + g, stop_gradient=True)

@@ -1,0 +1,116 @@
+"""Device / place management.
+
+TPU-native analog of the reference's Place + DeviceContextPool
+(reference: paddle/fluid/platform/place.h, device_context.h). Under JAX the
+device runtime is PJRT; a "place" is a jax.Device, and the context pool's job
+(streams, handles) is owned by XLA. What remains for the framework is device
+*selection* for eager ops and host/device transfer policy.
+"""
+from __future__ import annotations
+
+import jax
+
+_current_device = None  # None -> jax default device
+
+
+class Place:
+    """Lightweight place tag mirroring paddle.CPUPlace()/CUDAPlace(i).
+
+    reference: paddle/fluid/platform/place.h — a tagged union over device
+    kinds. Here it resolves to a concrete jax.Device.
+    """
+
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _kind_of(d) == self.kind]
+        if not devs:
+            # fall back to any device of requested kind on other backends
+            try:
+                devs = jax.devices(self.kind)
+            except RuntimeError:
+                devs = []
+        if not devs:
+            raise RuntimeError(f"No {self.kind} device available")
+        return devs[min(self.index, len(devs) - 1)]
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self.index == other.index
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+
+def _kind_of(dev) -> str:
+    plat = dev.platform
+    if plat in ("tpu", "axon"):
+        return "tpu"
+    return plat
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def TPUPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+# CUDA alias kept for script parity: maps onto the accelerator device.
+def CUDAPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+def set_device(device) -> Place:
+    """paddle.set_device('tpu'|'cpu'|'tpu:0'|'gpu:0').
+
+    'gpu' is accepted for script parity and maps to the TPU chip — the point
+    of the framework is that reference training scripts run unmodified
+    (BASELINE.json north_star).
+    """
+    global _current_device
+    if isinstance(device, Place):
+        _current_device = device
+        return device
+    name = str(device)
+    if ":" in name:
+        kind, idx = name.split(":", 1)
+        idx = int(idx)
+    else:
+        kind, idx = name, 0
+    kind = {"gpu": "tpu", "cuda": "tpu", "xpu": "tpu"}.get(kind, kind)
+    place = Place(kind, idx)
+    _current_device = place
+    return place
+
+
+def get_device() -> str:
+    if _current_device is None:
+        d = jax.devices()[0]
+        return f"{_kind_of(d)}:{d.id}"
+    return f"{_current_device.kind}:{_current_device.index}"
+
+
+def current_jax_device():
+    """The jax.Device eager ops should run on (None -> jax default)."""
+    if _current_device is None:
+        return None
+    return _current_device.jax_device()
+
+
+def is_compiled_with_cuda() -> bool:
+    """Parity shim: scripts gate GPU paths on this; TPU counts as accelerator."""
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_kind_of(d) == "tpu" for d in jax.devices())

@@ -1,0 +1,144 @@
+"""Dtype registry for paddle_tpu.
+
+TPU-native analog of the reference's dtype system
+(reference: paddle/fluid/framework/framework.proto:97-120 `VarType.Type`,
+paddle/fluid/platform/float16.h, bfloat16.h). On TPU the native low-precision
+type is bfloat16; float16 is supported but bf16 is the default AMP dtype.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+# Canonical name -> jnp dtype. Mirrors paddle's supported dtypes
+# (framework.proto VarType.Type) minus GPU-only exotica.
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bfloat": "bfloat16",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+# paddle default dtype is float32 and is process-global
+# (reference: python/paddle/fluid/framework.py `set_default_dtype`).
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Normalize a user dtype spec (str / np.dtype / jnp dtype) to a jnp dtype.
+
+    When jax x64 is disabled (the TPU-appropriate default), int64/float64
+    requests quietly land on int32/float32 — paddle scripts use int64 labels
+    pervasively and the downcast is the intended TPU behavior, not an error.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        d = _NAME_TO_DTYPE[name]
+    else:
+        try:
+            d = jnp.dtype(dtype)
+        except TypeError:
+            raise ValueError(f"Cannot interpret {dtype!r} as a dtype")
+    import jax
+
+    if not jax.config.read("jax_enable_x64"):
+        if d == jnp.dtype("int64"):
+            return jnp.dtype("int32")
+        if d == jnp.dtype("float64"):
+            return jnp.dtype("float32")
+        if d == jnp.dtype("uint64"):
+            return jnp.dtype("uint32")
+        if d == jnp.dtype("complex128"):
+            return jnp.dtype("complex64")
+    return jnp.dtype(d)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical paddle-style name for a dtype."""
+    d = jnp.dtype(dtype)
+    if d == jnp.bool_:
+        return "bool"
+    return d.name
+
+
+def set_default_dtype(dtype):
+    """Set the process-global default float dtype (paddle.set_default_dtype)."""
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise TypeError("set_default_dtype only accepts floating dtypes")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    """paddle.get_default_dtype -> canonical name string."""
+    return dtype_name(_default_dtype)
+
+
+def default_float_dtype():
+    return _default_dtype
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating) or jnp.issubdtype(
+        jnp.dtype(dtype), jnp.complexfloating
+    )
+
+
+def infer_dtype_from_data(data):
+    """Infer tensor dtype for `to_tensor` from raw python/numpy data.
+
+    Python floats map to the default float dtype (paddle semantics:
+    python/paddle/tensor/creation.py `to_tensor` uses default dtype for
+    python scalars); numpy arrays keep their dtype except float64 which
+    paddle keeps but we also keep (x64 may be disabled in jax -> downcast).
+    """
+    if isinstance(data, (bool, np.bool_)):
+        return jnp.bool_
+    if isinstance(data, (int, np.integer)):
+        import jax
+
+        return jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
+    if isinstance(data, (float, np.floating)):
+        return _default_dtype
+    if isinstance(data, complex):
+        return jnp.complex64
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        # jax default config disables x64; stay in float32 unless enabled.
+        import jax
+
+        if not jax.config.read("jax_enable_x64"):
+            return jnp.float32
+    if arr.dtype == np.int64:
+        import jax
+
+        if not jax.config.read("jax_enable_x64"):
+            return jnp.int32
+    return jnp.dtype(arr.dtype)

@@ -1,0 +1,61 @@
+"""Global flag registry.
+
+Analog of the reference's gflags surface (paddle/fluid/platform/flags.cc:33-...,
+exposed to Python via pybind/global_value_getter_setter.cc as
+`paddle.set_flags` / `paddle.get_flags`). Flags are settable from env with the
+FLAGS_ prefix, matching the reference convention.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = value
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags({'FLAGS_check_nan_inf': 1})."""
+    for k, v in flags.items():
+        name = k[6:] if k.startswith("FLAGS_") else k
+        if name not in _REGISTRY:
+            raise KeyError(f"Unknown flag {k}")
+        _REGISTRY[name] = v
+
+
+def get_flags(flags):
+    """paddle.get_flags(['FLAGS_check_nan_inf'])."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        name = k[6:] if k.startswith("FLAGS_") else k
+        out[k] = _REGISTRY[name]
+    return out
+
+
+def flag(name: str):
+    return _REGISTRY[name]
+
+
+# Core flags, mirroring the load-bearing subset of platform/flags.cc.
+define_flag("check_nan_inf", False, "check every op output for NaN/Inf (flags.cc:44)")
+define_flag("cudnn_deterministic", True, "determinism; default-on for TPU (flags.cc:98)")
+define_flag("eager_delete_tensor_gb", 0.0, "GC threshold analog")
+define_flag("use_bf16_matmul", True, "allow bf16 matmul precision on TPU")
+define_flag("jit_cache_size", 4096, "max cached compiled executables")
+define_flag("allreduce_combine_threshold_mb", 256, "XLA all-reduce combiner budget; analog of fuse_grad_size_in_MB")

@@ -1,0 +1,47 @@
+"""RNG state management.
+
+Analog of the reference's Generator (paddle/fluid/framework/generator.cc) and
+`paddle.seed`. JAX RNG is functional (threaded keys); eager mode needs the
+stateful convenience the reference API exposes, so we keep a process-global
+key that is split on every draw. Inside jit/to_static traces, ops draw from a
+traced key argument instead (see paddle_tpu.jit) so compiled programs stay
+pure and reproducible.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_key = jax.random.PRNGKey(0)
+_seed_value = 0
+
+
+def seed(s: int):
+    """paddle.seed(s) — reset the global generator."""
+    global _key, _seed_value
+    with _lock:
+        _seed_value = int(s)
+        _key = jax.random.PRNGKey(_seed_value)
+    return _seed_value
+
+
+def get_seed() -> int:
+    return _seed_value
+
+
+def next_key():
+    """Draw a fresh PRNG key (splits global state)."""
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+def next_keys(n: int):
+    global _key
+    with _lock:
+        keys = jax.random.split(_key, n + 1)
+        _key = keys[0]
+    return keys[1:]

@@ -1,0 +1,311 @@
+"""Eager Tensor: the dygraph VarBase analog.
+
+reference: paddle/fluid/imperative/layer.h:65 (VarBase),
+python/paddle/fluid/dygraph/varbase_patch_methods.py (backward :136,
+gradient :185), framework/tensor.h:89 (dense tensor).
+
+TPU-first design: a Tensor is a thin handle over a `jax.Array` living in TPU
+HBM (or a tracer during to_static capture). There is no framework-owned
+allocator — XLA/PJRT owns device memory (SURVEY.md §2.2 TPU note); what the
+reference's Tensor adds (dtype/shape/place bookkeeping, inplace version,
+grad linkage) lives here in Python, while the math itself is always an XLA
+op. Method surface (x.matmul, x.sum, operators) is attached by
+paddle_tpu.ops.patch — the math_op_patch analog.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd, device as device_mod
+from .dtype import convert_dtype, dtype_name, infer_dtype_from_data
+
+
+class Tensor:
+    # Make numpy defer to our reflected dunders instead of absorbing the
+    # Tensor through __array__ (which would compute on host and silently
+    # detach the autograd graph).
+    __array_priority__ = 100
+    __array_ufunc__ = None
+
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "_node",
+        "_out_idx",
+        "name",
+        "persistable",
+        "_grad_hooks",
+        "_inplace_version",
+        "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            raw = data._data
+            if dtype is not None:
+                raw = raw.astype(convert_dtype(dtype))
+        else:
+            if dtype is None:
+                dtype = infer_dtype_from_data(data)
+            raw = jnp.asarray(data, dtype=convert_dtype(dtype))
+        dev = device_mod.current_jax_device()
+        if dev is not None and isinstance(raw, jax.Array) and not _is_tracer(raw):
+            raw = jax.device_put(raw, dev)
+        self._data = raw
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._grad_hooks = []
+        self._inplace_version = 0
+
+    # -- fast construction path used by the dispatch layer ------------------
+    @classmethod
+    def _wrap(cls, raw, stop_gradient=True, node=None, out_idx=0, name=None):
+        t = cls.__new__(cls)
+        t._data = raw
+        t.stop_gradient = stop_gradient
+        t.grad = None
+        t._node = node
+        t._out_idx = out_idx
+        t.name = name
+        t.persistable = False
+        t._grad_hooks = []
+        t._inplace_version = 0
+        return t
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        if _is_tracer(self._data):
+            return "traced"
+        devs = getattr(self._data, "devices", None)
+        if devs is not None:
+            ds = list(self._data.devices())
+            if len(ds) == 1:
+                return str(ds[0])
+            return f"sharded({len(ds)} devices)"
+        return "unknown"
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        from .. import ops
+
+        return ops.creation.to_tensor(self.size, dtype="int64")
+
+    def __len__(self):
+        if self._data.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"Tensor(traced, shape={self.shape}, dtype={dtype_name(self.dtype)})"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}, "
+            f"stop_gradient={self.stop_gradient},\n{np.asarray(self._data)})"
+        )
+
+    # -- host interop -------------------------------------------------------
+    def numpy(self):
+        if _is_tracer(self._data):
+            raise RuntimeError(
+                "Tensor.numpy() inside a to_static/jit trace — the value is "
+                "symbolic. Return it from the program instead."
+            )
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        arr = np.asarray(self._data)
+        return arr.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def __int__(self):
+        return int(np.asarray(self._data))
+
+    def __bool__(self):
+        return bool(np.asarray(self._data))
+
+    def __index__(self):
+        return int(np.asarray(self._data))
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        """Run reverse autograd from this tensor (varbase_patch_methods.py:136)."""
+        autograd.run_backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def gradient(self) -> Optional[np.ndarray]:
+        """Numpy value of accumulated grad (varbase_patch_methods.py:185)."""
+        if self.grad is None:
+            return None
+        return self.grad.numpy()
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Grad hook: fn(grad_tensor) -> optional replacement."""
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(_h):
+                if hook in self._grad_hooks:
+                    self._grad_hooks.remove(hook)
+
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        return Tensor._wrap(self._data, stop_gradient=True, name=self.name)
+
+    def clone(self) -> "Tensor":
+        from . import autograd as AG
+
+        return AG.apply(lambda x: x + 0, (self,), name="clone")
+
+    # -- in-place-ish mutation (functional under the hood) ------------------
+    def set_value(self, value):
+        """Overwrite the tensor's storage (Parameter loading path).
+
+        Functional replacement: the old jax.Array is dropped, a new one takes
+        its place; the tape linkage is reset (matches paddle semantics where
+        set_value is a data operation, not a traced op).
+        """
+        if isinstance(value, Tensor):
+            raw = value._data.astype(self._data.dtype)
+        else:
+            raw = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(raw.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {raw.shape} vs {self._data.shape}"
+            )
+        dev = device_mod.current_jax_device()
+        if dev is not None and not _is_tracer(raw):
+            raw = jax.device_put(raw, dev)
+        self._data = raw
+        self._node = None
+        self._out_idx = 0
+        self._inplace_version += 1
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    # -- dtype / device movement -------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        d = convert_dtype(dtype)
+        return autograd.apply(lambda x: x.astype(d), (self,), name="cast")
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cpu(self) -> "Tensor":
+        cpu_dev = jax.devices("cpu")[0] if jax.devices("cpu") else None
+        raw = jax.device_put(self._data, cpu_dev) if cpu_dev else self._data
+        return Tensor._wrap(raw, stop_gradient=self.stop_gradient)
+
+    def tpu(self, idx: int = 0) -> "Tensor":
+        dev = device_mod.Place("tpu", idx).jax_device()
+        return Tensor._wrap(
+            jax.device_put(self._data, dev), stop_gradient=self.stop_gradient
+        )
+
+    cuda = tpu  # script parity
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def _raw(self):
+        return self._data
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor) and dtype is None and place is None:
+        t = Tensor._wrap(data._data, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (fluid/framework.py Parameter): stop_gradient=False,
+    persistable, with an optional trainable switch."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    @classmethod
+    def from_tensor(cls, t: Tensor, name=None, trainable=True):
+        p = cls.__new__(cls)
+        p._data = t._data
+        p.stop_gradient = not trainable
+        p.grad = None
+        p._node = None
+        p._out_idx = 0
+        p.name = name
+        p.persistable = True
+        p._grad_hooks = []
+        p._inplace_version = 0
+        p.trainable = trainable
+        p.optimize_attr = {"learning_rate": 1.0}
+        p.regularizer = None
+        p.need_clip = True
+        return p

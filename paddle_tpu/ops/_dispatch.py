@@ -1,0 +1,85 @@
+"""Op dispatch helpers.
+
+The analog of the reference's OperatorWithKernel dispatch + generated
+`core.ops.*` fast path (reference: paddle/fluid/framework/operator.cc:1068
+RunImpl, paddle/fluid/pybind/op_function_generator.cc:242,488). There is no
+kernel table here: every op lowers to XLA through jax, and the "kernel
+choice" (device, fusion, tiling) is the compiler's job. What this layer does
+is (a) Tensor<->raw marshalling, (b) scalar-vs-tensor argument handling with
+weak-type preservation, (c) tape recording via autograd.apply.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+
+
+def canon_shape(shape):
+    """Coerce a user shape spec (int | sequence of int/Tensor | Tensor) to a
+    tuple of python ints — the single shape-normalization point for
+    creation/manipulation ops."""
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.tolist())
+    if isinstance(shape, int):
+        return (shape,)
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def as_tensor(x, like=None):
+    """Coerce x to Tensor. Python scalars stay scalars at call sites (weak
+    typing keeps result dtype anchored to the tensor operand, matching
+    paddle's scalar-op semantics)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x)
+
+
+def unary(fn, name=None):
+    def op(x, *, _fn=fn, **kw):
+        x = as_tensor(x)
+        if kw:
+            return AG.apply(lambda a: _fn(a, **kw), (x,), name=name)
+        return AG.apply(_fn, (x,), name=name)
+
+    op.__name__ = name or fn.__name__
+    return op
+
+
+def binary(fn, name=None):
+    """Binary op accepting Tensor|scalar on either side (math_op_patch analog)."""
+
+    def op(x, y, name_=None, *, _fn=fn):
+        xt = isinstance(x, Tensor)
+        yt = isinstance(y, Tensor)
+        if xt and yt:
+            return AG.apply(_fn, (x, y), name=name)
+        if xt:
+            if isinstance(y, np.ndarray):
+                return AG.apply(_fn, (x, Tensor(y)), name=name)
+            return AG.apply(lambda a: _fn(a, y), (x,), name=name)
+        if yt:
+            if isinstance(x, np.ndarray):
+                return AG.apply(_fn, (Tensor(x), y), name=name)
+            return AG.apply(lambda b: _fn(x, b), (y,), name=name)
+        return AG.apply(_fn, (Tensor(x), Tensor(y)), name=name)
+
+    op.__name__ = name or fn.__name__
+    return op
+
+
+def nondiff(fn, name=None):
+    """Op with no gradient (comparisons, int outputs, argmax...)."""
+
+    def op(*args, _fn=fn, **kw):
+        ts = tuple(as_tensor(a) for a in args)
+        if kw:
+            return AG.apply_nondiff(lambda *r: _fn(*r, **kw), ts)
+        return AG.apply_nondiff(_fn, ts)
+
+    op.__name__ = name or fn.__name__
+    return op

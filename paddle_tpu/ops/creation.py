@@ -1,0 +1,271 @@
+"""Tensor creation ops (paddle.tensor.creation parity).
+
+reference: python/paddle/tensor/creation.py; kernel side
+paddle/fluid/operators/fill_constant_op.cc etc. All creation lowers to XLA
+constants / iota; random ops draw from the global generator
+(paddle_tpu.core.random) in eager mode.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as rnd
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.tensor import Tensor, to_tensor  # re-export to_tensor
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "tril",
+    "triu",
+    "meshgrid",
+    "assign",
+    "clone",
+    "rand",
+    "randn",
+    "randint",
+    "randperm",
+    "uniform",
+    "normal",
+    "bernoulli",
+    "multinomial",
+    "standard_normal",
+]
+
+
+from ._dispatch import canon_shape as _shape  # noqa: E402
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else default_float_dtype()
+    return convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = _infer_fill_dtype(fill_value)
+    return Tensor._wrap(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def _infer_fill_dtype(v):
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int64" if jax.config.read("jax_enable_x64") else "int32"
+    return None
+
+
+def empty(shape, dtype=None, name=None):
+    # XLA has no uninitialized memory; zeros is the honest equivalent.
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros(x._data.shape, _dt(dtype, x._data.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones(x._data.shape, _dt(dtype, x._data.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor._wrap(
+        jnp.full(x._data.shape, fill_value, _dt(dtype, x._data.dtype))
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, int) for v in (start, end, step)):
+            dtype = "int64" if jax.config.read("jax_enable_x64") else "int32"
+    return Tensor._wrap(jnp.arange(start, end, step, dtype=_dt(dtype, None)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    return Tensor._wrap(
+        jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor._wrap(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    from ..core import autograd as AG
+
+    if padding_value != 0 and x._data.ndim == 1:
+        def f(a):
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+
+        return AG.apply(f, (x,), name="diag")
+    return AG.apply(lambda a: jnp.diag(a, k=offset), (x,), name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    from ..core import autograd as AG
+
+    return AG.apply(lambda a: jnp.diagflat(a, k=offset), (x,), name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core import autograd as AG
+
+    return AG.apply(lambda a: jnp.tril(a, k=diagonal), (x,), name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core import autograd as AG
+
+    return AG.apply(lambda a: jnp.triu(a, k=diagonal), (x,), name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    from ..core import autograd as AG
+
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = AG.apply(lambda *rs: tuple(jnp.meshgrid(*rs, indexing="ij")), args)
+    return list(outs)
+
+
+def assign(x, output=None):
+    """paddle.assign — copy a value into a (new or given) tensor."""
+    src = x if isinstance(x, Tensor) else Tensor(x)
+    if output is None:
+        return src.clone()
+    output.set_value(src)
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+# -- random -----------------------------------------------------------------
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor._wrap(
+        jax.random.uniform(rnd.next_key(), _shape(shape), _dt(dtype))
+    )
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor._wrap(
+        jax.random.normal(rnd.next_key(), _shape(shape), _dt(dtype))
+    )
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt(dtype, jnp.int32)
+    return Tensor._wrap(
+        jax.random.randint(rnd.next_key(), _shape(shape), low, high, dtype=d)
+    )
+
+
+def randperm(n, dtype=None, name=None):
+    d = _dt(dtype, jnp.int32)
+    return Tensor._wrap(
+        jax.random.permutation(rnd.next_key(), jnp.arange(n)).astype(d)
+    )
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else rnd.next_key()
+    return Tensor._wrap(
+        jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ())
+        )
+        return Tensor._wrap(
+            jax.random.normal(rnd.next_key(), shp, default_float_dtype()) * s + m
+        )
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor._wrap(
+        jax.random.normal(rnd.next_key(), shp, default_float_dtype()) * std + mean
+    )
+
+
+def bernoulli(x, name=None):
+    return Tensor._wrap(
+        jax.random.bernoulli(rnd.next_key(), x._data).astype(x._data.dtype)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if x._data.ndim == 1:
+        out = jax.random.choice(
+            rnd.next_key(),
+            x._data.shape[-1],
+            shape=(num_samples,),
+            replace=replacement,
+            p=x._data / x._data.sum(),
+        )
+    else:
+        keys = jax.random.split(rnd.next_key(), x._data.shape[0])
+        out = jnp.stack(
+            [
+                jax.random.choice(
+                    k,
+                    x._data.shape[-1],
+                    shape=(num_samples,),
+                    replace=replacement,
+                    p=row / row.sum(),
+                )
+                for k, row in zip(keys, x._data)
+            ]
+        )
+    return Tensor._wrap(out.astype(jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32))

@@ -1,0 +1,233 @@
+"""Linear algebra ops (paddle.tensor.linalg parity).
+
+reference: python/paddle/tensor/linalg.py over matmul_v2_op, mul_op,
+operators/math/blas.h. On TPU matmuls feed the MXU; keep them batched and in
+bf16/f32 — precision is controlled by jax default_matmul_precision and the
+use_bf16_matmul flag.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ._dispatch import as_tensor
+
+__all__ = ["addmm", "bincount", "bmm", "cholesky", "corrcoef", "cov", "cross", "det", "dist", "dot", "eigh", "eigvalsh", "einsum", "histogram", "inverse", "lstsq", "matmul", "matrix_power", "matrix_rank", "mm", "multi_dot", "mv", "norm", "pinv", "qr", "slogdet", "solve", "svd", "triangular_solve"]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return AG.apply(f, (as_tensor(x), as_tensor(y)), name="matmul")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return AG.apply(jnp.matmul, (x, y), name="bmm")
+
+
+def mv(x, vec, name=None):
+    return AG.apply(jnp.matmul, (x, vec), name="mv")
+
+
+def dot(x, y, name=None):
+    return AG.apply(
+        lambda a, b: jnp.sum(a * b, axis=-1), (x, y), name="dot"
+    )
+
+
+def einsum(equation, *operands):
+    ts = tuple(as_tensor(o) for o in operands)
+    return AG.apply(
+        lambda *rs: jnp.einsum(equation, *rs), ts, name="einsum"
+    )
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == "fro":
+            if axis is None:
+                r = jnp.sqrt(jnp.sum(a * a))
+                if keepdim:
+                    r = jnp.reshape(r, (1,) * a.ndim)
+                return r
+            return jnp.linalg.norm(
+                a, ord="fro" if isinstance(axis, (list, tuple)) else None,
+                axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                keepdims=keepdim,
+            )
+        if p == float("inf") or p == "inf":
+            ordv = jnp.inf
+        elif p == float("-inf"):
+            ordv = -jnp.inf
+        else:
+            ordv = p
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=ordv, keepdims=keepdim)
+        return jnp.linalg.norm(
+            a,
+            ord=ordv,
+            axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+            keepdims=keepdim,
+        )
+
+    return AG.apply(f, (x,), name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return AG.apply(
+        lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), (x, y), name="dist"
+    )
+
+
+def cross(x, y, axis=None, name=None):
+    ax = axis if axis is not None else -1
+    if axis is None:
+        # paddle defaults to the first axis with dim 3
+        for i, d in enumerate(x._data.shape):
+            if d == 3:
+                ax = i
+                break
+    return AG.apply(
+        lambda a, b: jnp.cross(a, b, axis=ax), (x, y), name="cross"
+    )
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return AG.apply(f, (x,), name="cholesky")
+
+
+def inverse(x, name=None):
+    return AG.apply(jnp.linalg.inv, (x,), name="inverse")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return AG.apply(
+        lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,), name="pinv"
+    )
+
+
+def slogdet(x, name=None):
+    return AG.apply(
+        lambda a: tuple(jnp.linalg.slogdet(a)), (x,), name="slogdet"
+    )
+
+
+def det(x, name=None):
+    return AG.apply(jnp.linalg.det, (x,), name="det")
+
+
+def matrix_power(x, n, name=None):
+    return AG.apply(
+        lambda a: jnp.linalg.matrix_power(a, n), (x,), name="matrix_power"
+    )
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return AG.apply_nondiff(
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol), (x,)
+    )
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = AG.apply(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        (x,),
+        name="svd",
+    )
+    return outs
+
+
+def qr(x, mode="reduced", name=None):
+    outs = AG.apply(
+        lambda a: tuple(jnp.linalg.qr(a, mode=mode)), (x,), name="qr"
+    )
+    return outs
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = AG.apply(
+        lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (x,), name="eigh"
+    )
+    return outs
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return AG.apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,), name="eigvalsh")
+
+
+def solve(x, y, name=None):
+    return AG.apply(jnp.linalg.solve, (x, y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return AG.apply(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        ),
+        (x, y),
+        name="triangular_solve",
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    outs = AG.apply_nondiff(
+        lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), (x, y)
+    )
+    return outs
+
+
+def multi_dot(tensors, name=None):
+    ts = tuple(as_tensor(t) for t in tensors)
+    return AG.apply(
+        lambda *rs: jnp.linalg.multi_dot(rs), ts, name="multi_dot"
+    )
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h
+
+    return AG.apply_nondiff(f, (x,))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights._data if isinstance(weights, Tensor) else weights
+    return AG.apply_nondiff(
+        lambda a: jnp.bincount(a, weights=w, minlength=minlength), (x,)
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return AG.apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,), name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return AG.apply(
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), (x,), name="cov"
+    )
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return AG.apply(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        (as_tensor(input), as_tensor(x), as_tensor(y)),
+        name="addmm",
+    )

@@ -1,0 +1,92 @@
+"""Comparison & logical ops (paddle.tensor.logic parity).
+
+reference: python/paddle/tensor/logic.py over compare_op.cc, logical_op.cc.
+All non-differentiable; never recorded on the tape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ._dispatch import as_tensor
+
+__all__ = ["allclose", "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor", "equal", "equal_all", "greater_equal", "greater_than", "is_empty", "is_tensor", "isclose", "isfinite", "isinf", "isnan", "less_equal", "less_than", "logical_and", "logical_not", "logical_or", "logical_xor", "not_equal"]
+
+
+def _cmp(jfn, name):
+    def op(x, y, name_=None):
+        xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xt and yt:
+            return AG.apply_nondiff(jfn, (x, y))
+        if xt:
+            return AG.apply_nondiff(lambda a: jfn(a, y), (x,))
+        if yt:
+            return AG.apply_nondiff(lambda b: jfn(x, b), (y,))
+        return AG.apply_nondiff(jfn, (as_tensor(x), as_tensor(y)))
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return AG.apply_nondiff(jnp.logical_not, (as_tensor(x),))
+
+
+def bitwise_not(x, out=None, name=None):
+    return AG.apply_nondiff(jnp.bitwise_not, (as_tensor(x),))
+
+
+def isnan(x, name=None):
+    return AG.apply_nondiff(jnp.isnan, (x,))
+
+
+def isinf(x, name=None):
+    return AG.apply_nondiff(jnp.isinf, (x,))
+
+
+def isfinite(x, name=None):
+    return AG.apply_nondiff(jnp.isfinite, (x,))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return AG.apply_nondiff(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (as_tensor(x), as_tensor(y)),
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return AG.apply_nondiff(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (as_tensor(x), as_tensor(y)),
+    )
+
+
+def equal_all(x, y, name=None):
+    return AG.apply_nondiff(
+        lambda a, b: jnp.array_equal(a, b), (as_tensor(x), as_tensor(y))
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor._wrap(jnp.asarray(int(np.prod(x._data.shape)) == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

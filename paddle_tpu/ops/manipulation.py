@@ -1,0 +1,442 @@
+"""Shape/layout manipulation ops (paddle.tensor.manipulation parity).
+
+reference: python/paddle/tensor/manipulation.py over reshape_op, transpose_op,
+concat_op, split_op, gather_op, scatter_op etc. All static-shape XLA ops;
+dynamic-shape paddle idioms (LoD) are translated to dense+mask at the data
+layer (SURVEY.md §7 hard parts).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+slice_builtin = builtins.slice
+
+__all__ = ["as_complex", "as_real", "broadcast_tensors", "broadcast_to", "cast", "chunk", "clip_by_norm", "concat", "expand", "expand_as", "flatten", "flip", "gather", "gather_nd", "index_sample", "index_select", "masked_select", "moveaxis", "nonzero", "pad", "put_along_axis", "repeat_interleave", "reshape", "reshape_", "roll", "rot90", "scatter", "scatter_nd", "scatter_nd_add", "slice", "split", "squeeze", "stack", "strided_slice", "t", "take_along_axis", "tile", "transpose", "unbind", "unique", "unsqueeze", "unstack", "where"]
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ._dispatch import as_tensor
+
+
+from ._dispatch import canon_shape as _shape_arg  # noqa: E402
+
+
+def reshape(x, shape, name=None):
+    shp = _shape_arg(shape)
+    return AG.apply(lambda a: jnp.reshape(a, shp), (x,), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    # Delegates to the Tensor method attached by ops.patch, which carries the
+    # tape-preserving in-place semantics (base-alias trick).
+    return x.reshape_(shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x._data.ndim
+    sa = start_axis % nd if nd else 0
+    so = stop_axis % nd if nd else 0
+
+    def f(a):
+        shape = a.shape
+        new = shape[:sa] + (-1,) + shape[so + 1 :]
+        return jnp.reshape(a, new)
+
+    return AG.apply(f, (x,), name="flatten")
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return AG.apply(lambda a: jnp.transpose(a, perm), (x,), name="transpose")
+
+
+def t(x, name=None):
+    return AG.apply(lambda a: a.T, (x,), name="t")
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(int(v) % a.ndim for v in ax if a.shape[int(v) % a.ndim] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+
+    return AG.apply(f, (x,), name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    ax = tuple(int(v.item()) if isinstance(v, Tensor) else int(v) for v in ax)
+    return AG.apply(lambda a: jnp.expand_dims(a, ax), (x,), name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ts = tuple(as_tensor(v) for v in x)
+    return AG.apply(
+        lambda *rs: jnp.concatenate(rs, axis=axis), ts, name="concat"
+    )
+
+
+def stack(x, axis=0, name=None):
+    ts = tuple(as_tensor(v) for v in x)
+    return AG.apply(lambda *rs: jnp.stack(rs, axis=axis), ts, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x._data.shape[axis]
+    outs = AG.apply(
+        lambda a: tuple(
+            jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis)
+        ),
+        (x,),
+        name="unstack",
+    )
+    return list(outs)
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x._data.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"paddle.split: axis {axis} length {dim} is not divisible by "
+                f"num_or_sections={num_or_sections}"
+            )
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            sizes[neg[0]] = dim - sum(s for s in sizes if s >= 0)
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+
+    outs = AG.apply(
+        lambda a: tuple(
+            jax.lax.slice_in_dim(a, offsets[i], offsets[i + 1], axis=axis)
+            for i in range(len(sizes))
+        ),
+        (x,),
+        name="split",
+    )
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return AG.apply(lambda a: jnp.tile(a, reps), (x,), name="tile")
+
+
+def expand(x, shape, name=None):
+    shp = list(_shape_arg(shape))
+
+    def f(a):
+        tgt = list(shp)
+        # -1 means keep original dim; only valid for pre-existing dims
+        # (paddle semantics — -1 in a newly added leading dim is an error)
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                if i < off:
+                    raise ValueError(
+                        "paddle.expand: -1 is only valid for dims that exist "
+                        f"in the input (got -1 at new leading dim {i})"
+                    )
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return AG.apply(f, (x,), name="expand")
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    shp = tuple(y._data.shape)
+    return AG.apply(lambda a: jnp.broadcast_to(a, shp), (x,), name="expand_as")
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = tuple(as_tensor(v) for v in inputs)
+    outs = AG.apply(
+        lambda *rs: tuple(jnp.broadcast_arrays(*rs)), ts, name="broadcast_tensors"
+    )
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return AG.apply(lambda a: jnp.flip(a, axis=ax), (x,), name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return AG.apply(
+        lambda a: jnp.roll(a, shifts, axis=axis), (x,), name="roll"
+    )
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return AG.apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,), name="rot90")
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """paddle.slice (operators/slice_op.cc)."""
+
+    def _v(v):
+        return int(v.item()) if isinstance(v, Tensor) else int(v)
+
+    axes = [int(a) for a in axes]
+    starts = [_v(s) for s in starts]
+    ends = [_v(e) for e in ends]
+
+    def f(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            st2 = max(st + dim, 0) if st < 0 else min(st, dim)
+            en2 = max(en + dim, 0) if en < 0 else min(en, dim)
+            idx[ax] = slice_builtin(st2, en2)
+        return a[tuple(idx)]
+
+    return AG.apply(f, (x,), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = slice_builtin(int(st), int(en), int(sd))
+        return a[tuple(idx)]
+
+    return AG.apply(f, (x,), name="strided_slice")
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    idx = index._data.reshape(-1) if index._data.ndim > 1 else index._data
+    return AG.apply(lambda a: jnp.take(a, idx, axis=axis), (x,), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = index._data
+
+    def f(a):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ix]
+
+    return AG.apply(f, (x,), name="gather_nd")
+
+
+def take_along_axis(x, indices, axis, name=None):
+    idx = indices._data
+    return AG.apply(
+        lambda a: jnp.take_along_axis(a, idx, axis=axis), (x,), name="take_along_axis"
+    )
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    idx = indices._data
+    vt = values if isinstance(values, Tensor) else Tensor(values)
+    axis = int(axis) % x._data.ndim
+
+    def f(a, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        dims = []
+        for d in range(a.ndim):
+            if d == axis:
+                dims.append(idx)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = a.shape[d]
+                dims.append(
+                    jnp.broadcast_to(
+                        jnp.arange(a.shape[d]).reshape(shape), idx.shape
+                    )
+                )
+        loc = tuple(dims)
+        if reduce == "assign":
+            return a.at[loc].set(v)
+        if reduce == "add":
+            return a.at[loc].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[loc].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return AG.apply(f, (x, vt), name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """paddle.scatter (operators/scatter_op.cc): row-wise scatter."""
+    idx = index._data
+
+    def f(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        base = a.at[idx].set(jnp.zeros_like(u))
+        return base.at[idx].add(u)
+
+    return AG.apply(f, (x, as_tensor(updates)), name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = index._data
+
+    def f(a, u):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ix].add(u)
+
+    return AG.apply(f, (x, as_tensor(updates)), name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = index._data
+    return AG.apply(lambda a: jnp.take(a, idx, axis=axis), (x,), name="index_select")
+
+
+def index_sample(x, index, name=None):
+    idx = index._data
+    return AG.apply(
+        lambda a: jnp.take_along_axis(a, idx, axis=1), (x,), name="index_sample"
+    )
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape — host fallback in eager; inside jit use where().
+    if AG.in_trace():
+        raise RuntimeError(
+            "masked_select has a data-dependent shape and cannot run under "
+            "to_static/jit; use paddle.where or multiply by the mask instead"
+        )
+    import numpy as np
+
+    data = np.asarray(x._data)
+    m = np.asarray(mask._data)
+    return Tensor._wrap(jnp.asarray(data[m]))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    cond = condition._data if isinstance(condition, Tensor) else jnp.asarray(condition)
+    return AG.apply(
+        lambda a, b: jnp.where(cond, a, b), (as_tensor(x), as_tensor(y)), name="where"
+    )
+
+
+def nonzero(x, as_tuple=False, name=None):
+    if AG.in_trace():
+        raise RuntimeError("nonzero has a data-dependent shape; not jittable")
+    import numpy as np
+
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor._wrap(jnp.asarray(v)) for v in nz)
+    return Tensor._wrap(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    if AG.in_trace():
+        raise RuntimeError("unique has a data-dependent shape; not jittable")
+    import numpy as np
+
+    res = np.unique(
+        np.asarray(x._data),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor._wrap(jnp.asarray(res))
+    return tuple(Tensor._wrap(jnp.asarray(v)) for v in res)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            # full-rank paddle format: [before0, after0, before1, after1, ...]? No:
+            # paddle uses per-dim pairs in order; numpy wants tuples
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spec applies to trailing spatial dims (NCHW/NCL/NCDHW)
+            k = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            # paddle pad order is reversed pairs over spatial dims (like torch)
+            for i, d in enumerate(reversed(spatial[-k:])):
+                widths[d] = (pad[2 * i], pad[2 * i + 1])
+        if mode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(a, widths, mode=jmode)
+
+    return AG.apply(f, (x,), name="pad")
+
+
+def clip_by_norm(x, max_norm, name=None):
+    def f(a):
+        n = jnp.sqrt(jnp.sum(a * a))
+        return jnp.where(n > max_norm, a * (max_norm / n), a)
+
+    return AG.apply(f, (x,), name="clip_by_norm")
+
+
+def moveaxis(x, source, destination, name=None):
+    return AG.apply(
+        lambda a: jnp.moveaxis(a, source, destination), (x,), name="moveaxis"
+    )
+
+
+def as_complex(x, name=None):
+    return AG.apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,), name="as_complex")
+
+
+def as_real(x, name=None):
+    return AG.apply(
+        lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,), name="as_real"
+    )
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return AG.apply(
+        lambda a: jnp.repeat(a, r, axis=axis), (x,), name="repeat_interleave"
+    )

@@ -1,0 +1,336 @@
+"""Elementwise math + reductions (paddle.tensor.math parity).
+
+reference: python/paddle/tensor/math.py over
+paddle/fluid/operators/elementwise/*, activation_op.*, reduce_ops/*.
+Every op is an XLA HLO; fusion of elementwise chains into surrounding
+matmuls is XLA's job (SURVEY.md §2.4 TPU mapping).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ._dispatch import binary, nondiff, unary
+
+__all__ = ["abs", "acos", "acosh", "add", "all", "amax", "amin", "angle", "any", "asin", "asinh", "atan", "atan2", "atanh", "ceil", "clip", "conj", "copysign", "cos", "cosh", "count_nonzero", "cummax", "cummin", "cumprod", "cumsum", "deg2rad", "diff", "digamma", "divide", "erf", "erfinv", "exp", "expm1", "exponential_", "floor", "floor_divide", "floor_mod", "fmax", "fmin", "frac", "gcd", "heaviside", "hypot", "imag", "increment", "inner", "kron", "lcm", "lerp", "lgamma", "log", "log10", "log1p", "log2", "logaddexp", "logit", "logsumexp", "max", "maximum", "mean", "median", "min", "minimum", "mod", "multiplex", "multiply", "nanmean", "nansum", "neg", "nextafter", "outer", "pow", "prod", "quantile", "rad2deg", "real", "reciprocal", "remainder", "round", "rsqrt", "scale", "sigmoid", "sign", "sin", "sinh", "sqrt", "square", "stanh", "std", "subtract", "sum", "tan", "tanh", "trace", "trunc", "var"]
+
+# -- binary elementwise ------------------------------------------------------
+add = binary(jnp.add, "add")
+subtract = binary(jnp.subtract, "subtract")
+multiply = binary(jnp.multiply, "multiply")
+divide = binary(jnp.divide, "divide")
+floor_divide = binary(jnp.floor_divide, "floor_divide")
+mod = binary(jnp.mod, "mod")
+remainder = mod
+floor_mod = mod
+pow = binary(jnp.power, "pow")
+maximum = binary(jnp.maximum, "maximum")
+minimum = binary(jnp.minimum, "minimum")
+fmax = binary(jnp.fmax, "fmax")
+fmin = binary(jnp.fmin, "fmin")
+atan2 = binary(jnp.arctan2, "atan2")
+hypot = binary(jnp.hypot, "hypot")
+logaddexp = binary(jnp.logaddexp, "logaddexp")
+heaviside = binary(jnp.heaviside, "heaviside")
+nextafter = binary(jnp.nextafter, "nextafter")
+copysign = binary(jnp.copysign, "copysign")
+gcd = nondiff(jnp.gcd, "gcd")
+lcm = nondiff(jnp.lcm, "lcm")
+
+# -- unary elementwise -------------------------------------------------------
+exp = unary(jnp.exp, "exp")
+expm1 = unary(jnp.expm1, "expm1")
+log = unary(jnp.log, "log")
+log2 = unary(jnp.log2, "log2")
+log10 = unary(jnp.log10, "log10")
+log1p = unary(jnp.log1p, "log1p")
+sqrt = unary(jnp.sqrt, "sqrt")
+rsqrt = unary(jax.lax.rsqrt, "rsqrt")
+square = unary(jnp.square, "square")
+abs = unary(jnp.abs, "abs")
+sign = unary(jnp.sign, "sign")
+neg = unary(jnp.negative, "neg")
+reciprocal = unary(jnp.reciprocal, "reciprocal")
+floor = unary(jnp.floor, "floor")
+ceil = unary(jnp.ceil, "ceil")
+round = unary(jnp.round, "round")
+trunc = unary(jnp.trunc, "trunc")
+frac = unary(lambda x: x - jnp.trunc(x), "frac")
+sin = unary(jnp.sin, "sin")
+cos = unary(jnp.cos, "cos")
+tan = unary(jnp.tan, "tan")
+asin = unary(jnp.arcsin, "asin")
+acos = unary(jnp.arccos, "acos")
+atan = unary(jnp.arctan, "atan")
+sinh = unary(jnp.sinh, "sinh")
+cosh = unary(jnp.cosh, "cosh")
+tanh = unary(jnp.tanh, "tanh")
+asinh = unary(jnp.arcsinh, "asinh")
+acosh = unary(jnp.arccosh, "acosh")
+atanh = unary(jnp.arctanh, "atanh")
+erf = unary(jax.scipy.special.erf, "erf")
+erfinv = unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = unary(jax.scipy.special.gammaln, "lgamma")
+digamma = unary(jax.scipy.special.digamma, "digamma")
+sigmoid = unary(jax.nn.sigmoid, "sigmoid")
+logit = unary(jax.scipy.special.logit, "logit")
+angle = unary(jnp.angle, "angle")
+conj = unary(jnp.conj, "conj")
+real = unary(jnp.real, "real")
+imag = unary(jnp.imag, "imag")
+rad2deg = unary(jnp.rad2deg, "rad2deg")
+deg2rad = unary(jnp.deg2rad, "deg2rad")
+exponential_ = unary(jnp.exp, "exponential_")  # shim
+
+
+def increment(x, value=1.0, name=None):
+    out = AG.apply(lambda a: a + value, (x,), name="increment")
+    x._data = out._data
+    return x
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """paddle.scale (operators/scale_op.cc)."""
+    s = scale._data if isinstance(scale, Tensor) else scale
+
+    def f(a):
+        if bias_after_scale:
+            r = a * s + bias
+        else:
+            r = (a + bias) * s
+        return r
+
+    out = AG.apply(f, (x,), name="scale")
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min._data if isinstance(min, Tensor) else min
+    mx = max._data if isinstance(max, Tensor) else max
+    return AG.apply(lambda a: jnp.clip(a, mn, mx), (x,), name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    w = weight._data if isinstance(weight, Tensor) else weight
+    return AG.apply(lambda a, b: a + w * (b - a), (x, y), name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return AG.apply(lambda a: scale_b * jnp.tanh(scale_a * a), (x,), name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    stacked = AG.apply(
+        lambda *rs: jnp.stack(rs, axis=0), tuple(inputs), name="multiplex_stack"
+    )
+    idx = index._data.reshape(-1)
+    return AG.apply(
+        lambda s: s[idx, jnp.arange(s.shape[1])], (stacked,), name="multiplex"
+    )
+
+
+# -- reductions --------------------------------------------------------------
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(jfn, name):
+    def op(x, axis=None, keepdim=False, name_=None, **kw):
+        ax = _axis(axis)
+        return AG.apply(
+            lambda a: jfn(a, axis=ax, keepdims=keepdim, **kw), (x,), name=name
+        )
+
+    op.__name__ = name
+    return op
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtype import convert_dtype
+
+    ax = _axis(axis)
+    d = convert_dtype(dtype) if dtype is not None else None
+    return AG.apply(
+        lambda a: jnp.sum(a, axis=ax, keepdims=keepdim, dtype=d), (x,), name="sum"
+    )
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return AG.apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), (x,), name="mean")
+
+
+prod = _reduce(jnp.prod, "prod")
+max = _reduce(jnp.max, "max")
+min = _reduce(jnp.min, "min")
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return AG.apply_nondiff(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), (x,))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return AG.apply_nondiff(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), (x,))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return AG.apply(
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        (x,),
+        name="logsumexp",
+    )
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return AG.apply(
+        lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim), (x,), name="std"
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return AG.apply(
+        lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim), (x,), name="var"
+    )
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return AG.apply(
+        lambda a: jnp.median(a, axis=ax, keepdims=keepdim), (x,), name="median"
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return AG.apply(
+        lambda a: jnp.quantile(a, q, axis=ax, keepdims=keepdim), (x,), name="quantile"
+    )
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return AG.apply(
+        lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), (x,), name="nanmean"
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtype import convert_dtype
+
+    ax = _axis(axis)
+    d = convert_dtype(dtype) if dtype is not None else None
+    return AG.apply(
+        lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim, dtype=d),
+        (x,),
+        name="nansum",
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype) if dtype is not None else None
+
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+
+    return AG.apply(f, (x,), name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype) if dtype is not None else None
+
+    def f(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=d)
+        return jnp.cumprod(a, axis=int(dim), dtype=d)
+
+    return AG.apply(f, (x,), name="cumprod")
+
+
+def cummax(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        return jax.lax.cummax(a, axis=ax)
+
+    return AG.apply(f, (x,), name="cummax")
+
+
+def cummin(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        return jax.lax.cummin(a, axis=ax)
+
+    return AG.apply(f, (x,), name="cummin")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return AG.apply_nondiff(
+        lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim), (x,)
+    )
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return AG.apply(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        (x,),
+        name="trace",
+    )
+
+
+def kron(x, y, name=None):
+    return AG.apply(jnp.kron, (x, y), name="kron")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return AG.apply(
+        lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+        (x,),
+        name="diff",
+    )
+
+
+def inner(x, y, name=None):
+    return AG.apply(jnp.inner, (x, y), name="inner")
+
+
+def outer(x, y, name=None):
+    return AG.apply(jnp.outer, (x, y), name="outer")

@@ -1,0 +1,261 @@
+"""Attach operator methods to Tensor.
+
+Analog of the reference's math_op_patch / varbase_patch_methods
+(python/paddle/fluid/dygraph/math_op_patch.py — monkey-patches arithmetic
+dunders and tensor methods onto VarBase so `x + y`, `x.sum()` work in eager
+mode and during static capture alike).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, search
+
+
+def _attach(name, fn):
+    setattr(Tensor, name, fn)
+
+
+# arithmetic dunders
+_attach("__add__", lambda self, o: math.add(self, o))
+_attach("__radd__", lambda self, o: math.add(o, self))
+_attach("__sub__", lambda self, o: math.subtract(self, o))
+_attach("__rsub__", lambda self, o: math.subtract(o, self))
+_attach("__mul__", lambda self, o: math.multiply(self, o))
+_attach("__rmul__", lambda self, o: math.multiply(o, self))
+_attach("__truediv__", lambda self, o: math.divide(self, o))
+_attach("__rtruediv__", lambda self, o: math.divide(o, self))
+_attach("__floordiv__", lambda self, o: math.floor_divide(self, o))
+_attach("__rfloordiv__", lambda self, o: math.floor_divide(o, self))
+_attach("__mod__", lambda self, o: math.mod(self, o))
+_attach("__rmod__", lambda self, o: math.mod(o, self))
+_attach("__pow__", lambda self, o: math.pow(self, o))
+_attach("__rpow__", lambda self, o: math.pow(o, self))
+_attach("__matmul__", lambda self, o: linalg.matmul(self, o))
+_attach("__rmatmul__", lambda self, o: linalg.matmul(o, self))
+_attach("__neg__", lambda self: math.neg(self))
+_attach("__abs__", lambda self: math.abs(self))
+_attach("__invert__", lambda self: logic.logical_not(self))
+
+# comparisons
+_attach("__eq__", lambda self, o: logic.equal(self, o))
+_attach("__ne__", lambda self, o: logic.not_equal(self, o))
+_attach("__lt__", lambda self, o: logic.less_than(self, o))
+_attach("__le__", lambda self, o: logic.less_equal(self, o))
+_attach("__gt__", lambda self, o: logic.greater_than(self, o))
+_attach("__ge__", lambda self, o: logic.greater_equal(self, o))
+Tensor.__hash__ = lambda self: id(self)  # __eq__ override kills default hash
+
+
+# indexing
+def _getitem(self, idx):
+    def norm(i):
+        if isinstance(i, Tensor):
+            return i._data
+        return i
+
+    if isinstance(idx, tuple):
+        jidx = tuple(norm(i) for i in idx)
+    else:
+        jidx = norm(idx)
+    return AG.apply(lambda a: a[jidx], (self,), name="getitem")
+
+
+def _setitem(self, idx, value):
+    """In-place __setitem__ via functional .at[].set.
+
+    When autograd is live and the tensor is a non-leaf in the graph, this is
+    recorded as a proper op (grad flows to untouched elements of the old
+    value and to `value` if it requires grad). On a leaf that requires grad
+    it raises, matching the reference's inplace-on-leaf restriction
+    (TensorInplaceVersion guard, framework/tensor.h:77). Otherwise it is a
+    plain data overwrite that resets the tape linkage.
+    """
+
+    def norm(i):
+        if isinstance(i, Tensor):
+            return i._data
+        return i
+
+    if isinstance(idx, tuple):
+        jidx = tuple(norm(i) for i in idx)
+    else:
+        jidx = norm(idx)
+    vt = value if isinstance(value, Tensor) else None
+    needs_tape = AG.is_grad_enabled() and (
+        not self.stop_gradient or (vt is not None and not vt.stop_gradient)
+    )
+    if needs_tape:
+        if self._node is None and not self.stop_gradient:
+            raise RuntimeError(
+                "in-place __setitem__ on a leaf Tensor that requires grad is "
+                "not supported; use .detach() or paddle.no_grad()"
+            )
+        base = Tensor._wrap(
+            self._data,
+            stop_gradient=self.stop_gradient,
+            node=self._node,
+            out_idx=self._out_idx,
+        )
+        if vt is not None:
+            out = AG.apply(
+                lambda a, v: a.at[jidx].set(_fit_value(v.astype(a.dtype), a[jidx].shape)),
+                (base, vt),
+                name="setitem",
+            )
+        else:
+            out = AG.apply(
+                lambda a: a.at[jidx].set(value), (base,), name="setitem"
+            )
+        self._data = out._data
+        self._node = out._node
+        self._out_idx = out._out_idx
+        self.stop_gradient = out.stop_gradient
+    else:
+        v = vt._data if vt is not None else value
+        if hasattr(v, "shape"):
+            v = _fit_value(jnp.asarray(v), self._data[jidx].shape)
+        self._data = self._data.at[jidx].set(v)
+        self._node = None
+        self._out_idx = 0
+    self._inplace_version += 1
+    return self
+
+
+def _fit_value(v, target_shape):
+    """numpy-style assignment shape adaptation: exact, squeeze/reshape when
+    sizes match, else broadcast."""
+    import numpy as _np
+
+    if tuple(v.shape) == tuple(target_shape):
+        return v
+    if int(_np.prod(v.shape)) == int(_np.prod(target_shape)):
+        return jnp.reshape(v, target_shape)
+    return jnp.broadcast_to(v, target_shape)
+
+
+_attach("__getitem__", _getitem)
+_attach("__setitem__", _setitem)
+
+# method forms of free functions (the subset scripts actually use)
+_METHODS = dict(
+    # math
+    add=math.add, subtract=math.subtract, multiply=math.multiply,
+    divide=math.divide, floor_divide=math.floor_divide, mod=math.mod,
+    remainder=math.mod, pow=math.pow, maximum=math.maximum, minimum=math.minimum,
+    exp=math.exp, log=math.log, log2=math.log2, log10=math.log10,
+    sqrt=math.sqrt, rsqrt=math.rsqrt, square=math.square, abs=math.abs,
+    sign=math.sign, reciprocal=math.reciprocal, floor=math.floor,
+    ceil=math.ceil, round=math.round, sin=math.sin, cos=math.cos,
+    tan=math.tan, tanh=math.tanh, sigmoid=math.sigmoid, erf=math.erf,
+    clip=math.clip, scale=math.scale, lerp=math.lerp,
+    sum=math.sum, mean=math.mean, prod=math.prod, max=math.max, min=math.min,
+    amax=math.amax, amin=math.amin, all=math.all, any=math.any,
+    logsumexp=math.logsumexp, std=math.std, var=math.var, median=math.median,
+    cumsum=math.cumsum, cumprod=math.cumprod, trace=math.trace,
+    # manipulation
+    reshape=manipulation.reshape,
+    flatten=manipulation.flatten, transpose=manipulation.transpose,
+    squeeze=manipulation.squeeze, unsqueeze=manipulation.unsqueeze,
+    split=manipulation.split, chunk=manipulation.chunk, tile=manipulation.tile,
+    expand=manipulation.expand, expand_as=manipulation.expand_as,
+    broadcast_to=manipulation.broadcast_to, flip=manipulation.flip,
+    roll=manipulation.roll, gather=manipulation.gather,
+    gather_nd=manipulation.gather_nd, scatter=manipulation.scatter,
+    index_select=manipulation.index_select, masked_select=manipulation.masked_select,
+    where=manipulation.where, unbind=manipulation.unbind,
+    take_along_axis=manipulation.take_along_axis,
+    put_along_axis=manipulation.put_along_axis,
+    repeat_interleave=manipulation.repeat_interleave,
+    unique=manipulation.unique, nonzero=manipulation.nonzero,
+    # linalg
+    matmul=linalg.matmul, mm=linalg.mm, bmm=linalg.bmm, dot=linalg.dot,
+    norm=linalg.norm, dist=linalg.dist, cholesky=linalg.cholesky,
+    inverse=linalg.inverse,
+    # logic
+    equal=logic.equal, not_equal=logic.not_equal, less_than=logic.less_than,
+    less_equal=logic.less_equal, greater_than=logic.greater_than,
+    greater_equal=logic.greater_equal, logical_and=logic.logical_and,
+    logical_or=logic.logical_or, logical_not=logic.logical_not,
+    logical_xor=logic.logical_xor, isnan=logic.isnan, isinf=logic.isinf,
+    isfinite=logic.isfinite, allclose=logic.allclose, isclose=logic.isclose,
+    equal_all=logic.equal_all,
+    # search
+    argmax=search.argmax, argmin=search.argmin, argsort=search.argsort,
+    sort=search.sort, topk=search.topk, kthvalue=search.kthvalue,
+    mode=search.mode,
+    # creation-ish
+    tril=creation.tril, triu=creation.triu,
+)
+
+for _name, _fn in _METHODS.items():
+    # default-arg closure pins the fn
+    def _make(fn):
+        def method(self, *args, **kw):
+            return fn(self, *args, **kw)
+
+        return method
+
+    _attach(_name, _make(_fn))
+
+
+# inplace variants the API promises (add_, scale_, clip_, etc.) — functional
+# under the hood: new buffer, same handle. When the tensor is a live non-leaf
+# in the autograd graph, the op is recorded against a *base* alias carrying
+# the old tape linkage, so the chain stays intact (the naive self-referential
+# form would silently drop upstream gradients). In-place on a leaf that
+# requires grad raises, like the reference/torch.
+def _make_inplace(fn):
+    def method(self, *args, **kw):
+        if AG.is_grad_enabled() and not self.stop_gradient:
+            if self._node is None:
+                raise RuntimeError(
+                    "in-place operation on a leaf Tensor that requires grad "
+                    "is not supported; use .detach() or paddle.no_grad()"
+                )
+            base = Tensor._wrap(
+                self._data,
+                stop_gradient=False,
+                node=self._node,
+                out_idx=self._out_idx,
+            )
+            out = fn(base, *args, **kw)
+            self._data = out._data
+            self._node = out._node
+            self._out_idx = out._out_idx
+            self.stop_gradient = out.stop_gradient
+        else:
+            out = fn(self.detach(), *args, **kw)
+            self._data = out._data
+            self._node = None
+            self._out_idx = 0
+        self._inplace_version += 1
+        return self
+
+    return method
+
+
+for _name in ("add", "subtract", "multiply", "scale", "clip", "floor", "ceil",
+              "exp", "sqrt", "reciprocal", "round", "rsqrt", "flatten",
+              "squeeze", "unsqueeze", "tanh", "reshape"):
+    _attach(_name + "_", _make_inplace(_METHODS[_name]))
+
+
+def _zero_(self):
+    self._data = jnp.zeros_like(self._data)
+    self._node = None
+    self._inplace_version += 1
+    return self
+
+
+def _fill_(self, value):
+    self._data = jnp.full_like(self._data, value)
+    self._node = None
+    self._inplace_version += 1
+    return self
+
+
+_attach("zero_", _zero_)
+_attach("fill_", _fill_)

@@ -1,0 +1,130 @@
+"""Search/sort ops (paddle.tensor.search parity).
+
+reference: python/paddle/tensor/search.py over arg_max_op, top_k_v2_op,
+argsort_op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["argmax", "argmin", "argsort", "index_of_max", "kthvalue", "mode", "searchsorted", "sort", "topk"]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+
+    def f(a):
+        r = jnp.argmax(a.reshape(-1) if axis is None else a, axis=0 if axis is None else axis)
+        if keepdim and axis is not None:
+            r = jnp.expand_dims(r, axis)
+        return r.astype(d)
+
+    return AG.apply_nondiff(f, (x,))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+
+    def f(a):
+        r = jnp.argmin(a.reshape(-1) if axis is None else a, axis=0 if axis is None else axis)
+        if keepdim and axis is not None:
+            r = jnp.expand_dims(r, axis)
+        return r.astype(d)
+
+    return AG.apply_nondiff(f, (x,))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        r = jnp.argsort(a, axis=axis)
+        if descending:
+            r = jnp.flip(r, axis=axis)
+        return r
+
+    return AG.apply_nondiff(f, (x,))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        r = jnp.sort(a, axis=axis)
+        if descending:
+            r = jnp.flip(r, axis=axis)
+        return r
+
+    return AG.apply(f, (x,), name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else axis
+
+    def f(a):
+        src = a if largest else -a
+        src = jnp.moveaxis(src, ax, -1)
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = AG.apply(f, (x,), name="topk")
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        si = jnp.argsort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        i = jnp.take(si, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i
+
+    vals, idx = AG.apply(f, (x,), name="kthvalue")
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value along axis. O(n^2) compare — fine for the small
+    tensors this API sees; large-tensor mode is not on any hot path."""
+
+    def f(a):
+        # count[i] = number of elements equal to a[i] along axis
+        cnt = jnp.sum(
+            jnp.expand_dims(a, axis) == jnp.expand_dims(a, axis - 1 if axis < 0 else axis + 1),
+            axis=axis,
+        )
+        # tie-break toward smallest value like paddle: sort not needed for parity here
+        best = jnp.argmax(cnt, axis=axis)
+        v = jnp.take_along_axis(a, jnp.expand_dims(best, axis), axis=axis)
+        i = jnp.expand_dims(best, axis)
+        if not keepdim:
+            v = jnp.squeeze(v, axis=axis)
+            i = jnp.squeeze(i, axis=axis)
+        return v, i
+
+    v, i = AG.apply_nondiff(f, (x,))
+    return v, i
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+
+    def f(seq, v):
+        r = jnp.searchsorted(seq, v, side=side)
+        return r.astype(jnp.int32) if out_int32 else r
+
+    return AG.apply_nondiff(f, (sorted_sequence, values))
+
+
+def index_of_max(x):
+    return argmax(x)

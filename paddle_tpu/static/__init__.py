@@ -1,0 +1,24 @@
+"""paddle_tpu.static — static-graph facade (stage 3; stub switches for now).
+
+reference: python/paddle/static/ over fluid Program/Executor. In the TPU
+build "static mode" is trace-and-compile: programs are captured by tracing
+(paddle_tpu.jit) rather than built op-desc-by-op-desc; this module will hold
+the Program/Executor-compatible API shells.
+"""
+from __future__ import annotations
+
+_STATIC_MODE = False
+
+
+def _enable():
+    global _STATIC_MODE
+    _STATIC_MODE = True
+
+
+def _disable():
+    global _STATIC_MODE
+    _STATIC_MODE = False
+
+
+def _static_mode_on() -> bool:
+    return _STATIC_MODE

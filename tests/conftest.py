@@ -1,0 +1,32 @@
+"""Test harness config.
+
+Runs the whole suite on the CPU backend with 8 virtual devices so collective
+and sharding tests exercise a real 8-way mesh without TPU hardware (the
+analog of the reference's single-host multiprocess dist tests,
+python/paddle/fluid/tests/unittests/test_dist_base.py:671 — here ranks are
+in-process XLA devices, SURVEY.md §4 TPU equivalent).
+
+Env vars must be set before jax initializes its backends, hence before any
+paddle_tpu import — conftest import order guarantees that under pytest.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# Numeric-check tests compare against float64 numpy references; use full
+# f32 matmul precision (the framework's default elsewhere is bf16-on-MXU).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+
+    paddle_tpu.seed(102)
+    np.random.seed(102)
+    yield

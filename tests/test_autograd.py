@@ -1,0 +1,244 @@
+"""Tape autograd engine — analog of reference imperative/tests/test_tracer.cc,
+test_imperative_basic.py, and OpTest.check_grad numeric-vs-analytic checks
+(python/paddle/fluid/tests/unittests/op_test.py:101,1358)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = x * x
+    loss = paddle.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.gradient(), [4.0, 6.0])
+
+
+def test_chain_and_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0 + 1.0
+    z = paddle.sum(y * y)
+    z.backward()
+    # dz/dx = 2*(3x+1)*3
+    np.testing.assert_allclose(x.gradient(), [24.0, 42.0])
+    # grads accumulate across backward calls (paddle semantics)
+    z2 = paddle.sum(x * 2.0)
+    z2.backward()
+    np.testing.assert_allclose(x.gradient(), [26.0, 44.0])
+    x.clear_grad()
+    assert x.gradient() is None
+
+
+def test_shared_input_accumulates():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x * 3.0  # x used by two ops
+    y.backward()
+    np.testing.assert_allclose(x.gradient(), [7.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient=True
+    z = paddle.sum(x * y)
+    z.backward()
+    np.testing.assert_allclose(x.gradient(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2.0).detach()
+    z = paddle.sum(y * 3.0)
+    z.backward()
+    assert x.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2.0
+    assert y.stop_gradient
+    y2 = x * 2.0
+    assert not y2.stop_gradient
+
+
+def test_matmul_grad_matches_numeric():
+    rng = np.random.RandomState(0)
+    a_np = rng.rand(3, 4).astype(np.float32)
+    b_np = rng.rand(4, 2).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    loss = paddle.sum(paddle.matmul(a, b))
+    loss.backward()
+    # analytic: dL/dA = ones @ B^T, dL/dB = A^T @ ones
+    np.testing.assert_allclose(
+        a.gradient(), np.ones((3, 2)) @ b_np.T, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        b.gradient(), a_np.T @ np.ones((3, 2)), rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize(
+    "op,ref_grad",
+    [
+        (lambda t: paddle.exp(t), lambda x: np.exp(x)),
+        (lambda t: paddle.log(t), lambda x: 1 / x),
+        (lambda t: paddle.sqrt(t), lambda x: 0.5 / np.sqrt(x)),
+        (lambda t: paddle.tanh(t), lambda x: 1 - np.tanh(x) ** 2),
+        (lambda t: paddle.sigmoid(t), lambda x: (s := 1 / (1 + np.exp(-x))) * (1 - s)),
+    ],
+)
+def test_unary_grads(op, ref_grad):
+    x_np = np.array([0.5, 1.0, 1.5], np.float32)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    paddle.sum(op(x)).backward()
+    np.testing.assert_allclose(x.gradient(), ref_grad(x_np), rtol=1e-3, atol=1e-6)
+
+
+def test_broadcast_grad_reduces():
+    x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    paddle.sum(x + b).backward()
+    np.testing.assert_allclose(b.gradient(), [3.0] * 4)  # summed over bcast dim
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6.0, dtype=np.float32), stop_gradient=False)
+    parts = paddle.split(x, 2)
+    loss = paddle.sum(parts[0] * 2.0) + paddle.sum(parts[1] * 3.0)
+    loss.backward()
+    np.testing.assert_allclose(x.gradient(), [2, 2, 2, 3, 3, 3])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # .grad untouched
+
+
+def test_grad_unused_input():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [z], retain_graph=True)
+    gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert gz is None
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.sum(x * x)
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph_allows_second_backward():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.sum(x * x)
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.gradient(), [4.0])
+
+
+def test_grad_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2.0
+
+    x.register_hook(hook)
+    paddle.sum(x * 3.0).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0])
+    np.testing.assert_allclose(x.gradient(), [6.0])  # hook doubled it
+
+
+def test_int_inputs_skip_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    idx = paddle.to_tensor([0, 2], dtype="int32")
+    g = paddle.gather(x, idx)
+    paddle.sum(g).backward()
+    np.testing.assert_allclose(x.gradient(), [1.0, 0.0, 1.0])
+
+
+def test_nonscalar_backward_seeds_ones():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2.0).backward()
+    np.testing.assert_allclose(x.gradient(), [2.0, 2.0])
+
+
+def test_deep_chain_no_recursion_limit():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x
+    for _ in range(2000):
+        y = y + 0.001
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.gradient(), [1.0])
+
+
+def test_inplace_op_preserves_chain():
+    # code-review finding: in-place on a non-leaf must keep upstream grads
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    y.add_(1.0)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.gradient(), [2.0])
+
+
+def test_inplace_on_grad_leaf_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        x.add_(1.0)
+    # but fine under no_grad (optimizer-update pattern)
+    with paddle.no_grad():
+        x.add_(1.0)
+    np.testing.assert_allclose(x.numpy(), [2.0])
+
+
+def test_setitem_grad_semantics():
+    # code-review finding: overwritten elements contribute zero grad
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2.0
+    y[0] = 100.0
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.gradient(), [0.0, 2.0])
+
+
+def test_setitem_grad_flows_to_value():
+    x = paddle.to_tensor([1.0, 2.0])
+    v = paddle.to_tensor([5.0], stop_gradient=False)
+    y = x + 0.0
+    y[0] = v * 3.0
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(v.gradient(), [3.0])
+
+
+def test_hook_fires_once_with_total():
+    # code-review finding: hooks must see the accumulated grad, not per-edge
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (x * x + x * 3.0).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [7.0])
+
+
+def test_split_nondivisible_raises():
+    with pytest.raises(ValueError):
+        paddle.split(paddle.to_tensor(np.arange(7.0)), 2)
+
+
+def test_no_internal_name_leaks():
+    import paddle_tpu
+
+    for bad in ("jax", "jnp", "AG", "binary", "as_tensor", "slice_builtin"):
+        assert not hasattr(paddle_tpu, bad), bad

@@ -1,0 +1,214 @@
+"""Tensor basics — analog of reference framework/tensor_test.cc +
+test_var_base.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert str(x.dtype) == "float32"
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_inference():
+    assert str(paddle.to_tensor(1).dtype) in ("int32", "int64")
+    assert str(paddle.to_tensor(1.0).dtype) == "float32"
+    assert str(paddle.to_tensor(True).dtype) == "bool"
+    assert str(paddle.to_tensor(np.zeros((2,), np.float64)).dtype) == "float32"
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_array_equal(paddle.full([2], 7, "int32").numpy(), [7, 7])
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.eye(3).numpy().trace() == 3
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+    )
+
+
+def test_random_ops_seeded():
+    paddle.seed(7)
+    a = paddle.rand([4, 4]).numpy()
+    paddle.seed(7)
+    b = paddle.rand([4, 4]).numpy()
+    np.testing.assert_array_equal(a, b)
+    assert paddle.randn([100]).numpy().std() > 0.5
+    r = paddle.randint(0, 10, [100]).numpy()
+    assert r.min() >= 0 and r.max() < 10
+
+
+def test_arithmetic_operators():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x - y).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((x + 1).numpy(), [2, 3, 4])
+    np.testing.assert_allclose((1 - x).numpy(), [0, -1, -2])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    assert (x + 2.0).dtype == x.dtype  # weak scalar keeps dtype
+
+
+def test_comparisons_and_logic():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal((x > 2).numpy(), [False, False, True])
+    np.testing.assert_array_equal(
+        paddle.logical_and(x > 1, x < 3).numpy(), [False, True, False]
+    )
+    assert bool(paddle.allclose(x, x))
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(12.0).reshape(3, 4))
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    x[0, 0] = 99.0
+    assert x.numpy()[0, 0] == 99.0
+
+
+def test_astype_item_len():
+    x = paddle.to_tensor([1.9, 2.1])
+    assert str(x.astype("int32").dtype) == "int32"
+    assert paddle.to_tensor(3.5).item() == 3.5
+    assert len(x) == 2
+    assert x.size == 2
+    assert x.ndim == 1
+
+
+def test_set_value_and_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    x.set_value(np.array([5.0, 6.0], np.float32))
+    np.testing.assert_allclose(x.numpy(), [5, 6])
+    with pytest.raises(ValueError):
+        x.set_value(np.zeros((3,), np.float32))
+
+
+def test_manipulation():
+    x = paddle.to_tensor(np.arange(6.0).reshape(2, 3))
+    assert paddle.reshape(x, [3, 2]).shape == [3, 2]
+    assert paddle.transpose(x, [1, 0]).shape == [3, 2]
+    assert paddle.flatten(x).shape == [6]
+    assert paddle.unsqueeze(x, 0).shape == [1, 2, 3]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0)).shape == [2, 3]
+    c = paddle.concat([x, x], axis=0)
+    assert c.shape == [4, 3]
+    s = paddle.stack([x, x])
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1]
+    parts = paddle.split(x, [1, 2], axis=1)
+    assert parts[1].shape == [2, 2]
+    assert paddle.tile(x, [2, 1]).shape == [4, 3]
+    assert paddle.expand(paddle.to_tensor([[1.0]]), [2, 3]).shape == [2, 3]
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12.0).reshape(4, 3))
+    idx = paddle.to_tensor([0, 2])
+    g = paddle.gather(x, idx)
+    np.testing.assert_allclose(g.numpy(), [[0, 1, 2], [6, 7, 8]])
+    upd = paddle.to_tensor([[9.0, 9, 9], [8, 8, 8]])
+    s = paddle.scatter(x, idx, upd)
+    np.testing.assert_allclose(s.numpy()[0], [9, 9, 9])
+    np.testing.assert_allclose(s.numpy()[2], [8, 8, 8])
+
+
+def test_where_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_array_equal(i.numpy(), [0, 2])
+    np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 2, 3])
+    np.testing.assert_array_equal(paddle.argsort(x).numpy(), [1, 2, 0])
+    w = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [3, 0, 2])
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(6.0).reshape(2, 3))
+    assert paddle.sum(x).item() == 15
+    np.testing.assert_allclose(paddle.sum(x, axis=0).numpy(), [3, 5, 7])
+    np.testing.assert_allclose(paddle.mean(x, axis=1).numpy(), [1, 4])
+    assert paddle.max(x).item() == 5
+    assert paddle.min(x).item() == 0
+    assert paddle.sum(x, axis=1, keepdim=True).shape == [2, 1]
+    assert paddle.argmax(x, axis=1).numpy().tolist() == [2, 2]
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose(
+        paddle.matmul(a, b).numpy(), a.numpy() @ b.numpy(), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        paddle.matmul(a, a, transpose_y=True).numpy(),
+        a.numpy() @ a.numpy().T,
+        rtol=1e-5,
+    )
+    c = paddle.to_tensor(np.random.rand(2, 3, 4).astype(np.float32))
+    d = paddle.to_tensor(np.random.rand(2, 4, 5).astype(np.float32))
+    assert paddle.bmm(c, d).shape == [2, 3, 5]
+
+
+def test_cast_chain_and_clip():
+    x = paddle.to_tensor([-2.0, 0.5, 3.0])
+    np.testing.assert_allclose(paddle.clip(x, 0.0, 1.0).numpy(), [0, 0.5, 1])
+    np.testing.assert_allclose(
+        paddle.scale(x, scale=2.0, bias=1.0).numpy(), [-3, 2, 7]
+    )
+
+
+def test_numpy_left_operand_keeps_tensor():
+    # code-review finding: np.ndarray + Tensor must hit reflected dunders
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    r = np.array([1.0, 2.0], np.float32) + x
+    assert isinstance(r, type(x))
+    paddle.sum(r).backward()
+    np.testing.assert_allclose(x.gradient(), [1.0, 1.0])
+    r2 = np.float32(2.0) * x
+    assert isinstance(r2, type(x))
+
+
+def test_backward_seed_length_mismatch_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y1, y2 = x * 2.0, x * 3.0
+    with pytest.raises(ValueError):
+        paddle.grad([y1, y2], [x], grad_outputs=[paddle.ones([1])])
+
+
+def test_put_along_axis_negative_axis():
+    x = paddle.zeros([2, 3])
+    idx = paddle.to_tensor(np.array([[0], [2]]))
+    out = paddle.put_along_axis(x, idx, 5.0, axis=-1)
+    np.testing.assert_allclose(out.numpy(), [[5, 0, 0], [0, 0, 5]])
+
+
+def test_expand_minus_one_new_dim_raises():
+    with pytest.raises(ValueError):
+        paddle.expand(paddle.arange(3).astype("float32"), [-1, 3])
+
+
+def test_norm_fro_keepdim():
+    x = paddle.ones([2, 3])
+    assert paddle.norm(x, p="fro", keepdim=True).shape == [1, 1]
+
+
+def test_cumsum_dtype_honored():
+    x = paddle.to_tensor([1, 2, 3], dtype="int32")
+    assert str(paddle.cumsum(x, dtype="float32").dtype) == "float32"
+
+
+def test_place_hashable():
+    d = {paddle.CPUPlace(): 1, paddle.TPUPlace(0): 2}
+    assert d[paddle.CPUPlace()] == 1
