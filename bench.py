@@ -1,0 +1,84 @@
+"""Driver benchmark: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Current benchmark: LeNet-5 MNIST-shape training throughput on the real chip
+(BASELINE.json config 1), using the jit-compiled train step (the framework's
+intended hot path). vs_baseline is against BASELINE.json's published numbers
+— the reference publishes none (BASELINE.md), so the recorded value IS the
+baseline going forward; vs_baseline reports 1.0.
+
+Upgraded across rounds toward ResNet-50/BERT throughput per BASELINE.json.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    batch = 256
+    model = LeNet()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    params = {k: v for k, v in model.state_dict().items()}
+    x_np = np.random.rand(batch, 1, 28, 28).astype(np.float32)
+    y_np = (np.arange(batch) % 10).astype(np.int32)
+
+    # jit the whole train step over raw arrays: functional forward via the
+    # layer with params swapped (the to_static hot path, built in stage 3 —
+    # here inlined so the bench exists from round 1).
+    from paddle_tpu.core import autograd as AG
+    from paddle_tpu.core.tensor import Tensor
+
+    param_list = list(model.named_parameters())
+
+    def loss_fn(param_raws, xr, yr):
+        with AG.trace_mode():
+            for (name, p), raw in zip(param_list, param_raws):
+                p._data = raw
+            logits = model(Tensor._wrap(xr))
+            loss = paddle.nn.functional.cross_entropy(
+                logits, Tensor._wrap(yr)
+            )
+            return loss._data
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    raws = [p._data for _, p in param_list]
+    x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+
+    # warmup/compile
+    loss, grads = grad_fn(raws, x, y)
+    jax.block_until_ready(loss)
+
+    steps = 30
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, grads = grad_fn(raws, x, y)
+        raws = jax.tree_util.tree_map(lambda p, g: p - 1e-3 * g, raws, grads)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = steps * batch / dt
+    print(
+        json.dumps(
+            {
+                "metric": "lenet_mnist_train_imgs_per_sec",
+                "value": round(imgs_per_sec, 1),
+                "unit": "imgs/sec",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,13 @@ from .core.flags import get_flags, set_flags  # noqa: F401
 
 # the full flat op namespace (paddle.add, paddle.matmul, ...)
 from .ops import *  # noqa: F401,F403
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from .framework.io import load, save  # noqa: F401
 from .ops import creation, linalg, logic, manipulation, math, search  # noqa: F401
 from .ops.creation import to_tensor  # noqa: F401
 from .ops.logic import is_tensor  # noqa: F401

@@ -17,6 +17,20 @@ _lock = threading.Lock()
 _key = jax.random.PRNGKey(0)
 _seed_value = 0
 
+# Inside a to_static/jit trace the global (stateful) key must not be baked
+# into the compiled program; the jit runtime registers a provider that
+# returns a *traced* key instead (split from a per-call key argument).
+_trace_key_provider = None
+
+
+def set_trace_key_provider(fn):
+    """Install (or clear, with None) the traced-RNG key source used while
+    capturing a program. Returns the previous provider."""
+    global _trace_key_provider
+    prev = _trace_key_provider
+    _trace_key_provider = fn
+    return prev
+
 
 def seed(s: int):
     """paddle.seed(s) — reset the global generator."""
@@ -32,7 +46,11 @@ def get_seed() -> int:
 
 
 def next_key():
-    """Draw a fresh PRNG key (splits global state)."""
+    """Draw a fresh PRNG key (splits global state; traced key under trace)."""
+    from . import autograd
+
+    if autograd.in_trace() and _trace_key_provider is not None:
+        return _trace_key_provider()
     global _key
     with _lock:
         _key, sub = jax.random.split(_key)

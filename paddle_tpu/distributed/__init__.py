@@ -1,0 +1,7 @@
+"""paddle_tpu.distributed — Fleet-style distributed API (SURVEY.md §2.9).
+
+Stage 4-6 build-out; env discovery lands first so io.DistributedBatchSampler
+works standalone.
+"""
+from . import env  # noqa: F401
+from .env import get_rank, get_world_size  # noqa: F401

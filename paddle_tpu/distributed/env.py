@@ -1,0 +1,35 @@
+"""Distributed environment discovery.
+
+reference: python/paddle/distributed/parallel.py:143-147 — env-var cluster
+discovery (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS).
+On TPU the real topology comes from the runtime (jax.process_index/count for
+multi-host; device mesh axes for in-host parallelism); the PADDLE_* env vars
+are honored as overrides so reference launch scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+
+
+def rank() -> int:
+    if "PADDLE_TRAINER_ID" in os.environ:
+        return int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+
+    return jax.process_index()
+
+
+def world_size() -> int:
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    import jax
+
+    return jax.process_count()
+
+
+def get_rank() -> int:
+    return rank()
+
+
+def get_world_size() -> int:
+    return world_size()

@@ -1,0 +1,66 @@
+"""paddle.save / paddle.load — state-dict persistence.
+
+reference: python/paddle/framework/io.py (save :237, load :439) over
+fluid/dygraph/checkpoint.py. Format: pickle of a pure-numpy tree (portable,
+no jax types on disk); nested dicts/lists/tuples of Tensors are supported
+like the reference. Sharded/distributed checkpoint lands with the orbax
+integration (paddle_tpu.incubate.checkpoint)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_MAGIC = b"PDTPU1\n"
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return _TensorLeaf(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_numpy_tree(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_numpy_tree(obj, return_numpy=False):
+    if isinstance(obj, _TensorLeaf):
+        return obj.array if return_numpy else Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _from_numpy_tree(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_numpy_tree(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+class _TensorLeaf:
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = np.asarray(array)
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save(state_dict, 'model.pdparams')."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load('model.pdparams')."""
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    return _from_numpy_tree(obj, return_numpy=return_numpy)

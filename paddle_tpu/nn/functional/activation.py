@@ -1,0 +1,210 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py
+over operators/activation_op.*). All fuse into neighboring ops under XLA."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as AG
+from ...ops._dispatch import unary
+
+__all__ = [
+    "relu", "relu6", "gelu", "sigmoid", "tanh", "softmax", "log_softmax",
+    "leaky_relu", "elu", "selu", "celu", "silu", "swish", "mish", "softplus",
+    "softsign", "hardtanh", "hardsigmoid", "hardswish", "hardshrink",
+    "softshrink", "tanhshrink", "thresholded_relu", "log_sigmoid", "maxout",
+    "prelu", "glu", "gumbel_softmax", "softmax_with_cross_entropy",
+]
+
+relu = unary(jax.nn.relu, "relu")
+relu6 = unary(lambda x: jnp.clip(x, 0, 6), "relu6")
+sigmoid = unary(jax.nn.sigmoid, "sigmoid")
+tanh = unary(jnp.tanh, "tanh")
+silu = unary(jax.nn.silu, "silu")
+softsign = unary(jax.nn.soft_sign, "softsign")
+log_sigmoid = unary(jax.nn.log_sigmoid, "log_sigmoid")
+tanhshrink = unary(lambda x: x - jnp.tanh(x), "tanhshrink")
+
+
+def gelu(x, approximate=False, name=None):
+    return AG.apply(
+        lambda a: jax.nn.gelu(a, approximate=approximate), (x,), name="gelu"
+    )
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+
+    d = convert_dtype(dtype) if dtype else None
+
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=axis)
+
+    return AG.apply(f, (x,), name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+
+    d = convert_dtype(dtype) if dtype else None
+
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return AG.apply(f, (x,), name="log_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return AG.apply(
+        lambda a: jax.nn.leaky_relu(a, negative_slope), (x,), name="leaky_relu"
+    )
+
+
+def elu(x, alpha=1.0, name=None):
+    return AG.apply(lambda a: jax.nn.elu(a, alpha), (x,), name="elu")
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return AG.apply(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+        (x,),
+        name="selu",
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return AG.apply(lambda a: jax.nn.celu(a, alpha), (x,), name="celu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return AG.apply(
+        lambda a: a * jnp.tanh(jax.nn.softplus(a)), (x,), name="mish"
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return AG.apply(
+        lambda a: jnp.where(
+            a * beta > threshold, a, (1.0 / beta) * jax.nn.softplus(a * beta)
+        ),
+        (x,),
+        name="softplus",
+    )
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return AG.apply(lambda a: jnp.clip(a, min, max), (x,), name="hardtanh")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return AG.apply(
+        lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), (x,), name="hardsigmoid"
+    )
+
+
+def hardswish(x, name=None):
+    return AG.apply(
+        lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, (x,), name="hardswish"
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return AG.apply(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,), name="hardshrink"
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return AG.apply(
+        lambda a: jnp.where(
+            a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)
+        ),
+        (x,),
+        name="softshrink",
+    )
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return AG.apply(
+        lambda a: jnp.where(a > threshold, a, 0.0), (x,), name="thresholded_relu"
+    )
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1 :]
+        return jnp.max(a.reshape(new_shape), axis=ax)
+
+    return AG.apply(f, (x,), name="maxout")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return AG.apply(f, (x, weight), name="prelu")
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        u, v = jnp.split(a, 2, axis=axis)
+        return u * jax.nn.sigmoid(v)
+
+    return AG.apply(f, (x,), name="glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as rnd
+
+    key = rnd.next_key()
+
+    def f(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(key, a.shape) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return AG.apply(f, (x,), name="gumbel_softmax")
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, axis=-1,
+    return_softmax=False, numeric_stable_mode=True,
+):
+    """Fused op parity (operators/softmax_with_cross_entropy_op.*)."""
+    from .loss import cross_entropy as _ce
+
+    loss = _ce(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        axis=axis, reduction="none",
+    )
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss

@@ -1,0 +1,226 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, pad,
+cosine_similarity, pixel_shuffle, unfold, label_smooth.
+
+reference: python/paddle/nn/functional/common.py, input.py, vision.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as AG
+from ...core import random as rnd
+from ...core.tensor import Tensor
+from ...ops.manipulation import pad  # re-export paddle.nn.functional.pad
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "interpolate", "upsample", "pad",
+    "cosine_similarity", "pixel_shuffle", "unfold", "label_smooth",
+    "bilinear", "class_center_sample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W shape (in, out) — paddle convention (matmul lowers to
+    the MXU; keep batch dims folded)."""
+    if bias is None:
+        return AG.apply(jnp.matmul, (x, weight), name="linear")
+    return AG.apply(
+        lambda a, w, b: jnp.matmul(a, w) + b, (x, weight, bias), name="linear"
+    )
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            # paddle downscale_in_infer: train keeps raw scale, infer scales
+            # by (1-p)
+            return AG.apply(lambda a: a * (1.0 - p), (x,), name="dropout_infer")
+        return x if isinstance(x, Tensor) else Tensor(x)
+    if p == 1.0:
+        return AG.apply(lambda a: jnp.zeros_like(a), (x,), name="dropout")
+    key = rnd.next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0)
+        return jnp.where(keep, a, 0.0)
+
+    return AG.apply(f, (x,), name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = rnd.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return AG.apply(f, (x,), name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup rows of weight (reference: operators/lookup_table_v2_op.*).
+    sparse=True (SelectedRows grads) has no TPU analog — dense grads are
+    correct and XLA scatters them efficiently."""
+    idx = x._data
+
+    def f(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return AG.apply(f, (weight,), name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return AG.apply_nondiff(
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), (x,)
+    )
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    """Subset parity: nearest & (bi)linear over NCHW/NCL (vision models use
+    these)."""
+    nd = x._data.ndim
+    channel_last = not data_format.startswith("NC")
+    n_sp = nd - 2
+    in_sp = (
+        x._data.shape[1:-1] if channel_last else x._data.shape[2:]
+    )
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sp = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * n_sp
+        out_sp = tuple(int(d * f) for d, f in zip(in_sp, sf))
+
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(a):
+        if channel_last:
+            spatial_axes = tuple(range(1, 1 + n_sp))
+        else:
+            spatial_axes = tuple(range(2, 2 + n_sp))
+        new_shape = list(a.shape)
+        for ax, s in zip(spatial_axes, out_sp):
+            new_shape[ax] = s
+        return jax.image.resize(a, tuple(new_shape), method=method)
+
+    return AG.apply(f, (x,), name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return AG.apply(f, (x1, x2), name="cosine_similarity")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return AG.apply(f, (x,), name="pixel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/unfold_op.*, math/im2col.*)."""
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        oh = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        cols = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = a[
+                    :, :,
+                    i * d[0] : i * d[0] + oh * s[0] : s[0],
+                    j * d[1] : j * d[1] + ow * s[1] : s[1],
+                ]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return AG.apply(f, (x,), name="unfold")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(a):
+        n = a.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * a + epsilon * pd
+        return (1 - epsilon) * a + epsilon / n
+
+    return AG.apply(f, (label,), name="label_smooth")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return AG.apply(f, args, name="bilinear")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample (PLSC-style) is not implemented; use full softmax"
+    )

@@ -1,0 +1,212 @@
+"""Convolution functionals.
+
+reference: python/paddle/nn/functional/conv.py over operators/conv_op.*,
+conv_transpose_op.*. TPU-first: all convs lower to
+`jax.lax.conv_general_dilated`, which XLA tiles onto the MXU; NCHW layout is
+kept at the API for paddle parity (XLA transposes internally as needed).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as AG
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        raise ValueError(f"expected length-{n} spec, got {v}")
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n):
+    """paddle padding spec -> lax pairs. Accepts int, list of ints, list of
+    pairs, or 'SAME'/'VALID' strings."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        pads = [tuple(int(x) for x in p) for p in padding]
+        if len(pads) == n + 2:  # full-rank NC... spec
+            pads = pads[2:]
+        return pads
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [
+            (int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)
+        ]
+    if len(padding) == 1:
+        return [(int(padding[0]), int(padding[0]))] * n
+    raise ValueError(f"bad padding spec {padding}")
+
+
+def _conv_nd(
+    x, weight, bias, stride, padding, dilation, groups, n, data_format, name
+):
+    spatial = "DHW"[3 - n :]
+    if data_format in (f"NC{spatial}", "NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + spatial
+    else:
+        lhs_spec = "N" + spatial + "C"
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x._data.shape),
+        tuple(weight._data.shape),
+        (lhs_spec, "OI" + spatial, lhs_spec),
+    )
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pads = _padding(padding, n)
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=strides,
+            padding=pads,
+            rhs_dilation=dil,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b:
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return AG.apply(f, args, name=name)
+
+
+def conv1d(
+    x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCL", name=None,
+):
+    return _conv_nd(
+        x, weight, bias, stride, padding, dilation, groups, 1,
+        "NCW" if data_format == "NCL" else "NWC", "conv1d",
+    )
+
+
+def conv2d(
+    x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCHW", name=None,
+):
+    return _conv_nd(
+        x, weight, bias, stride, padding, dilation, groups, 2, data_format,
+        "conv2d",
+    )
+
+
+def conv3d(
+    x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCDHW", name=None,
+):
+    return _conv_nd(
+        x, weight, bias, stride, padding, dilation, groups, 3, data_format,
+        "conv3d",
+    )
+
+
+def _conv_transpose_nd(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, n,
+    data_format, name,
+):
+    spatial = "DHW"[3 - n :]
+    lhs_spec = "NC" + spatial if data_format.startswith("NC") else "N" + spatial + "C"
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pads = _padding(padding, n)
+    opad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    # weight layout in paddle conv_transpose: (in_channels, out_channels/groups, *k)
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x._data.shape),
+        tuple(weight._data.shape),
+        (lhs_spec, "IO" + spatial, lhs_spec),
+    )
+
+    if isinstance(pads, str):
+        lax_pads = pads
+    else:
+        # conv_transpose output size: (i-1)*s - 2p + d*(k-1) + 1 + output_padding
+        # achieved as a fractionally-strided conv (lhs_dilation) with flipped
+        # kernel.
+        lax_pads = [
+            (dil[i] * (weight._data.shape[2 + i] - 1) - pads[i][0],
+             dil[i] * (weight._data.shape[2 + i] - 1) - pads[i][1] + opad[i])
+            for i in range(n)
+        ]
+
+    ch_axis = lhs_spec.index("C")
+
+    def f(a, w, *b):
+        def one(a_g, w_g):
+            return jax.lax.conv_general_dilated(
+                a_g,
+                jnp.flip(w_g, axis=tuple(range(2, 2 + n))),
+                window_strides=(1,) * n,
+                padding=lax_pads,
+                lhs_dilation=strides,
+                rhs_dilation=dil,
+                dimension_numbers=dn,
+            )
+
+        if groups == 1:
+            out = one(a, w)
+        else:
+            # grouped transposed conv: per-group fractionally-strided conv
+            # (kernel (C_in, C_out/groups, *k) splits on the I dim)
+            a_parts = jnp.split(a, groups, axis=ch_axis)
+            w_parts = jnp.split(w, groups, axis=0)
+            out = jnp.concatenate(
+                [one(ap, wp) for ap, wp in zip(a_parts, w_parts)],
+                axis=ch_axis,
+            )
+        if b:
+            shape = [1] * out.ndim
+            shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return AG.apply(f, args, name=name)
+
+
+def conv1d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+    dilation=1, output_size=None, data_format="NCL", name=None,
+):
+    return _conv_transpose_nd(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, 1,
+        "NCW" if data_format == "NCL" else "NWC", "conv1d_transpose",
+    )
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+    dilation=1, output_size=None, data_format="NCHW", name=None,
+):
+    return _conv_transpose_nd(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, 2,
+        data_format, "conv2d_transpose",
+    )
+
+
+def conv3d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+    dilation=1, output_size=None, data_format="NCDHW", name=None,
+):
+    return _conv_transpose_nd(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, 3,
+        data_format, "conv3d_transpose",
+    )

@@ -1,0 +1,289 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py over
+operators/cross_entropy_op.*, softmax_with_cross_entropy_op.*,
+math/cross_entropy.*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as AG
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "mse_loss", "l1_loss", "nll_loss", "kl_div", "smooth_l1_loss",
+    "margin_ranking_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+    "ctc_loss", "square_error_cost", "sigmoid_focal_loss", "log_loss",
+    "npair_loss", "triplet_margin_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    name=None,
+):
+    """paddle.nn.functional.cross_entropy: softmax+NLL fused (the reference's
+    softmax_with_cross_entropy kernel); XLA fuses the same way."""
+    lbl = label._data
+
+    def f(logits, *w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30)
+        )
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+        else:
+            li = lbl
+            if li.ndim == logp.ndim:  # (N, 1) hard labels
+                li = jnp.squeeze(li, axis=axis)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis
+            )
+            loss = -jnp.squeeze(picked, axis=axis)
+            if w:
+                loss = loss * jnp.take(w[0], safe)
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = (
+                    jnp.sum(jnp.take(w[0], safe) * valid)
+                    if w
+                    else jnp.maximum(jnp.sum(valid), 1)
+                )
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = (input,) + ((weight,) if weight is not None else ())
+    return AG.apply(f, args, name="cross_entropy")
+
+
+def square_error_cost(input, label):
+    return AG.apply(lambda a, b: (a - b) ** 2, (input, label), name="square_error_cost")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return AG.apply(
+        lambda a, b: _reduce((a - b) ** 2, reduction), (input, label), name="mse_loss"
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return AG.apply(
+        lambda a, b: _reduce(jnp.abs(a - b), reduction), (input, label), name="l1_loss"
+    )
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(a, b, *w):
+        eps = 1e-12
+        loss = -(b * jnp.log(jnp.maximum(a, eps)) + (1 - b) * jnp.log(jnp.maximum(1 - a, eps)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return AG.apply(f, args, name="bce")
+
+
+def binary_cross_entropy_with_logits(
+    logit, label, weight=None, reduction="mean", pos_weight=None, name=None
+):
+    pw = pos_weight._data if isinstance(pos_weight, Tensor) else pos_weight
+
+    def f(z, b, *w):
+        # numerically stable: max(z,0) - z*b + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * b + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            logsig = -jax.nn.softplus(-z)
+            log1msig = -jax.nn.softplus(z)
+            base = -(pw * b * logsig + (1 - b) * log1msig)
+        if w:
+            base = base * w[0]
+        return _reduce(base, reduction)
+
+    args = (logit, label) + ((weight,) if weight is not None else ())
+    return AG.apply(f, args, name="bce_with_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    lbl = label._data
+
+    def f(logp, *w):
+        li = lbl.astype(jnp.int32)
+        valid = li != ignore_index
+        safe = jnp.where(valid, li, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        loss = -picked
+        if w:
+            loss = loss * jnp.take(w[0], safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = (
+                jnp.sum(jnp.take(w[0], safe) * valid) if w else jnp.maximum(jnp.sum(valid), 1)
+            )
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = (input,) + ((weight,) if weight is not None else ())
+    return AG.apply(f, args, name="nll_loss")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, t):
+        loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return AG.apply(f, (input, label), name="kl_div")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return AG.apply(f, (input, label), name="smooth_l1")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return AG.apply(f, (input, other, label), name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return AG.apply(f, (input, label), name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return AG.apply(f, (input1, input2, label), name="cosine_embedding_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(a, b):
+        return -b * jnp.log(a + epsilon) - (1 - b) * jnp.log(1 - a + epsilon)
+
+    return AG.apply(f, (input, label), name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return AG.apply(f, args, name="sigmoid_focal_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p):
+        sim = jnp.matmul(a, p.T)
+        lbl = labels._data.reshape(-1)
+        tgt = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return xent + reg
+
+    return AG.apply(f, (anchor, positive), name="npair_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return AG.apply(f, (input, positive, negative), name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the classic alpha recursion in log space with lax.scan
+    (reference: operators/warpctc_op.* wrapping warp-ctc; here it is a pure
+    XLA scan — TPU-friendly, no external lib)."""
+    lbl = labels._data.astype(jnp.int32)
+    in_len = input_lengths._data.astype(jnp.int32)
+    lab_len = label_lengths._data.astype(jnp.int32)
+
+    def f(lp):
+        # lp: (T, N, C) log-probs (paddle warpctc layout)
+        T, N, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((N, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl)
+        neg_inf = -1e30
+
+        init = jnp.full((N, 2 * S + 1), neg_inf)
+        init = init.at[:, 0].set(lp[0, jnp.arange(N), blank])
+        init = init.at[:, 1].set(lp[0, jnp.arange(N), ext[:, 1]])
+
+        same = ext[:, 2:] == ext[:, :-2]  # can't skip over same label
+
+        def step(alpha, lp_t):
+            a0 = alpha
+            a1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], 1)
+            a2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], 1)
+            a2 = a2.at[:, 2:].set(jnp.where(same, neg_inf, a2[:, 2:]))
+            merged = jnp.logaddexp(jnp.logaddexp(a0, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, merged + emit
+
+        _, traj = jax.lax.scan(step, init, lp[1:])
+        traj = jnp.concatenate([init[None], traj], 0)  # (T, N, 2S+1)
+        t_idx = jnp.clip(in_len - 1, 0, T - 1)
+        alpha_T = traj[t_idx, jnp.arange(N)]  # (N, 2S+1)
+        end1 = jnp.take_along_axis(alpha_T, (2 * lab_len)[:, None], 1)[:, 0]
+        end2 = jnp.take_along_axis(
+            alpha_T, jnp.maximum(2 * lab_len - 1, 0)[:, None], 1
+        )[:, 0]
+        ll = jnp.logaddexp(end1, end2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len, 1))
+        return _reduce(loss, reduction)
+
+    return AG.apply(f, (log_probs,), name="ctc_loss")

@@ -1,0 +1,199 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py
+over operators/batch_norm_op.*, layer_norm_op.*, group_norm_op.cc).
+
+batch_norm returns the updated running stats alongside the output instead of
+mutating them inside the kernel (functional form — the Layer wrappers own the
+buffer update so the same code paths trace cleanly under jit)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import autograd as AG
+from ...core.tensor import Tensor
+
+__all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm", "normalize", "local_response_norm"]
+
+
+def _stat_axes(ndim, data_format):
+    ch = 1 if data_format.startswith("NC") else ndim - 1
+    return tuple(i for i in range(ndim) if i != ch), ch
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """Returns out; in training mode also refreshes running stats in-place on
+    the provided buffer Tensors (eager) — under trace the Layer handles stats
+    functionally via batch_norm_stats."""
+    ndim = x._data.ndim
+    axes, ch = _stat_axes(ndim, data_format)
+    use_batch_stats = training and not use_global_stats
+
+    bshape = [1] * ndim
+    bshape[ch] = x._data.shape[ch]
+
+    if use_batch_stats:
+        def f(a, *wb):
+            mean = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+            out = (a - mean.reshape(bshape)) / jnp.sqrt(
+                var.reshape(bshape) + epsilon
+            )
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(bshape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(bshape)
+            return out, mean, var
+
+        args = (x,) + tuple(p for p in (weight, bias) if p is not None)
+        out, mean_t, var_t = AG.apply(f, args, name="batch_norm")
+        mean_t.stop_gradient = True
+        var_t.stop_gradient = True
+        # EMA update (paddle: mean = mean*momentum + batch_mean*(1-m)).
+        # set_value is trace-safe: under to_static capture the buffer holds a
+        # traced value which the program wrapper threads out as extra state.
+        running_mean.set_value(
+            running_mean._data * momentum + mean_t._data * (1 - momentum)
+        )
+        running_var.set_value(
+            running_var._data * momentum + var_t._data * (1 - momentum)
+        )
+        return out
+
+    rm, rv = running_mean._data, running_var._data
+
+    def f(a, *wb):
+        out = (a - rm.reshape(bshape)) / jnp.sqrt(rv.reshape(bshape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out
+
+    args = (x,) + tuple(p for p in (weight, bias) if p is not None)
+    return AG.apply(f, args, name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+    axes = tuple(range(x._data.ndim - nd, x._data.ndim))
+
+    def f(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = (x,) + tuple(p for p in (weight, bias) if p is not None)
+    return AG.apply(f, args, name="layer_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    ndim = x._data.ndim
+    ch = 1 if data_format.startswith("NC") else ndim - 1
+    C = x._data.shape[ch]
+    if C % num_groups != 0:
+        raise ValueError("channels not divisible by num_groups")
+
+    def f(a, *wb):
+        if ch != 1:
+            a = jnp.moveaxis(a, ch, 1)
+        n = a.shape[0]
+        grouped = a.reshape((n, num_groups, -1))
+        mean = jnp.mean(grouped, axis=-1, keepdims=True)
+        var = jnp.var(grouped, axis=-1, keepdims=True)
+        out = ((grouped - mean) / jnp.sqrt(var + epsilon)).reshape(a.shape)
+        bshape = [1] * out.ndim
+        bshape[1] = C
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        if ch != 1:
+            out = jnp.moveaxis(out, 1, ch)
+        return out
+
+    args = (x,) + tuple(p for p in (weight, bias) if p is not None)
+    return AG.apply(f, args, name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    ndim = x._data.ndim
+    ch = 1 if data_format.startswith("NC") else ndim - 1
+    axes = tuple(i for i in range(ndim) if i not in (0, ch))
+    bshape = [1] * ndim
+    bshape[ch] = x._data.shape[ch]
+
+    def f(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + eps)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out
+
+    args = (x,) + tuple(p for p in (weight, bias) if p is not None)
+    return AG.apply(f, args, name="instance_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return AG.apply(f, (x,), name="normalize")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    ndim = x._data.ndim
+    ch = 1 if data_format.startswith("NC") else ndim - 1
+
+    def f(a):
+        sq = a * a
+        if ch != 1:
+            sq = jnp.moveaxis(sq, ch, 1)
+        half = size // 2
+        pad = [(0, 0)] * sq.ndim
+        pad[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad)
+        acc = sum(
+            jnp.take(padded, jnp.arange(i, i + sq.shape[1]), axis=1)
+            for i in range(size)
+        )
+        if ch != 1:
+            acc = jnp.moveaxis(acc, 1, ch)
+        return a / (k + alpha * acc) ** beta
+
+    return AG.apply(f, (x,), name="local_response_norm")

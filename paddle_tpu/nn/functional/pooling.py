@@ -1,0 +1,203 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py over
+operators/pool_op.*). Lowers to lax.reduce_window."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as AG
+
+__all__ = [
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else v * n))[:n]
+    return (int(v),) * n
+
+
+def _pool(x, kernel, stride, padding, n, kind, ceil_mode=False, exclusive=True,
+          data_format="NCHW", name=None):
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for pool: use int/list")
+    p = _tuple(padding, n)
+    channel_last = not data_format.startswith("NC")
+    spatial_off = 1 if channel_last else 2
+    in_sp = (
+        x._data.shape[spatial_off : spatial_off + n]
+    )
+    # ceil_mode: extend the high-side padding so the last partial window is
+    # kept (paddle pool ceil_mode semantics; padded cells are -inf for max /
+    # excluded from counts for avg)
+    extra = [0] * n
+    if ceil_mode:
+        for i in range(n):
+            out_floor = (in_sp[i] + 2 * p[i] - k[i]) // s[i] + 1
+            out_ceil = -(-(in_sp[i] + 2 * p[i] - k[i]) // s[i]) + 1
+            extra[i] = (out_ceil - out_floor) * s[i]
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ((0, 0),) + tuple(
+            (pi, pi + e) for pi, e in zip(p, extra)
+        ) + ((0, 0),)
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ((0, 0), (0, 0)) + tuple((pi, pi + e) for pi, e in zip(p, extra))
+
+    if kind == "max":
+        init = -jnp.inf
+
+        def f(a):
+            return jax.lax.reduce_window(
+                a, init, jax.lax.max, window, strides, pads
+            )
+
+    else:
+
+        def f(a):
+            summed = jax.lax.reduce_window(
+                a, 0.0, jax.lax.add, window, strides, pads
+            )
+            if (exclusive and any(pi > 0 for pi in p)) or any(e > 0 for e in extra):
+                counts = jax.lax.reduce_window(
+                    jnp.ones_like(a), 0.0, jax.lax.add, window, strides, pads
+                )
+                return summed / counts
+            return summed / float(np.prod(k))
+
+    return AG.apply(f, (x,), name=f"{kind}_pool{n}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode,
+                 data_format="NCW" if data_format == "NCL" else "NWC")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode,
+                 data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode,
+                 data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", ceil_mode,
+                 exclusive, "NCW" if data_format == "NCL" else "NWC")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", ceil_mode,
+                 exclusive, data_format)
+
+
+def _adaptive(x, output_size, n, kind, data_format):
+    """Adaptive pooling: reshape-and-reduce when divisible (the common case —
+    static shapes keep XLA happy), else windowed gather."""
+    channel_last = not data_format.startswith("NC")
+    spatial_off = 1 if channel_last else 2
+    in_shape = x._data.shape
+    out_sz = _tuple(output_size, n)
+    out_sz = tuple(
+        in_shape[spatial_off + i] if out_sz[i] is None else out_sz[i]
+        for i in range(n)
+    )
+
+    if all(in_shape[spatial_off + i] % out_sz[i] == 0 for i in range(n)):
+        factors = tuple(in_shape[spatial_off + i] // out_sz[i] for i in range(n))
+
+        def f(a):
+            # reshape each spatial dim D -> (out, D//out), reduce the inner
+            shape = list(a.shape[:spatial_off])
+            red_axes = []
+            for i in range(n):
+                shape.extend([out_sz[i], factors[i]])
+                red_axes.append(spatial_off + 2 * i + 1)
+            if channel_last:
+                shape.append(a.shape[-1])
+            a = a.reshape(shape)
+            if kind == "max":
+                return jnp.max(a, axis=tuple(red_axes))
+            return jnp.mean(a, axis=tuple(red_axes))
+
+        return AG.apply(f, (x,), name=f"adaptive_{kind}_pool{n}d")
+
+    # non-divisible fallback: per-output-window slices (small n expected)
+    def f(a):
+        import itertools
+
+        outs = np.empty(out_sz, dtype=object)
+        for idx in itertools.product(*(range(o) for o in out_sz)):
+            sl = [slice(None)] * a.ndim
+            for i, o in enumerate(idx):
+                d = in_shape[spatial_off + i]
+                start = (o * d) // out_sz[i]
+                end = -(-((o + 1) * d) // out_sz[i])
+                sl[spatial_off + i] = slice(start, end)
+            window = a[tuple(sl)]
+            ax = tuple(range(spatial_off, spatial_off + n))
+            outs[idx] = (
+                jnp.max(window, axis=ax) if kind == "max" else jnp.mean(window, axis=ax)
+            )
+        # stack back
+        def build(level, prefix):
+            if level == n:
+                return outs[tuple(prefix)]
+            return jnp.stack(
+                [build(level + 1, prefix + [i]) for i in range(out_sz[level])],
+                axis=spatial_off + level,
+            )
+
+        return build(0, [])
+
+    return AG.apply(f, (x,), name=f"adaptive_{kind}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", "NCDHW")

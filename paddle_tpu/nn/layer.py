@@ -1,0 +1,377 @@
+"""nn.Layer — the model-composition base class.
+
+reference: python/paddle/fluid/dygraph/layers.py:76 (`Layer`), :885
+(`__call__` with pre/post hooks), layer param management via
+LayerObjectHelper. TPU-native difference: parameters are plain Parameter
+tensors over jax.Arrays; a Layer is also trivially convertible to a pure
+functional form (params-pytree in, outputs out) which is what the jit and
+distributed paths consume (see paddle_tpu.jit.functional_call).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import autograd
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.tensor import Parameter, Tensor
+from .initializer import Constant, XavierUniform, _resolve_initializer
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/fluid/param_attr.py)."""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, bool):
+            # weight_attr=False means "no parameter" (paddle convention)
+            return attr
+        if callable(attr):  # a bare initializer
+            return ParamAttr(initializer=attr)
+        raise TypeError(f"Cannot interpret {attr!r} as ParamAttr")
+
+
+_name_counters: Dict[str, int] = collections.defaultdict(int)
+
+
+def _unique_name(prefix: str) -> str:
+    n = _name_counters[prefix]
+    _name_counters[prefix] += 1
+    return f"{prefix}_{n}"
+
+
+class Layer:
+    """Base class for all network layers (dygraph/layers.py:76)."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        self.training = True
+        self._dtype = convert_dtype(dtype) if dtype else default_float_dtype()
+        self._full_name = _unique_name(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._parameters: "collections.OrderedDict[str, Parameter]" = (
+            collections.OrderedDict()
+        )
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = (
+            collections.OrderedDict()
+        )
+        self._buffers: "collections.OrderedDict[str, Tensor]" = (
+            collections.OrderedDict()
+        )
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: "collections.OrderedDict[int, Callable]" = (
+            collections.OrderedDict()
+        )
+        self._forward_post_hooks: "collections.OrderedDict[int, Callable]" = (
+            collections.OrderedDict()
+        )
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ) -> Optional[Parameter]:
+        """LayerObjectHelper.create_parameter analog: default init is
+        Xavier-uniform for weights, zeros for biases (fluid defaults)."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) if dtype else self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        init = _resolve_initializer(init)
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, dtype=dtype, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Tensor, persistable=True):
+        """Non-parameter state (running stats etc.; layers.py register_buffer)."""
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute routing --------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name] = value
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- traversal ----------------------------------------------------------
+    def named_parameters(
+        self, prefix="", include_sublayers=True
+    ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for item in layer.named_parameters(sub_prefix, True):
+                    if id(item[1]) not in seen:
+                        seen.add(id(item[1]))
+                        yield item
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters("", include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers("", include_sublayers)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        out = [self] if include_self else []
+        for l in self._sub_layers.values():
+            if l is not None:
+                out.extend(l.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield from l.named_sublayers(p, include_self=True)
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, prefix=""):
+        """name -> Tensor map (dygraph/layers.py state_dict)."""
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self._parameters.items():
+            if p is not None:
+                dest[f"{prefix}{name}"] = p
+        for name, b in self._buffers.items():
+            if b is not None and name not in self._non_persistable_buffer_names:
+                dest[f"{prefix}{name}"] = b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is not None:
+                    layer.state_dict(dest, True, prefix=f"{prefix}{lname}.")
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Load values into existing params/buffers (checkpoint.py analog)."""
+        own = self.state_dict()
+        missing = []
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                data = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+                target.set_value(data.astype(np.dtype(target.dtype)))
+            else:
+                missing.append(name)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        hid = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[hid] = hook
+        return _HookRemoveHelper(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        hid = len(self._forward_post_hooks)
+        self._forward_post_hooks[hid] = hook
+        return _HookRemoveHelper(self._forward_post_hooks, hid)
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # -- misc ---------------------------------------------------------------
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = convert_dtype(dtype)
+            for p in self.parameters():
+                p._data = p._data.astype(d)
+            for b in self.buffers():
+                if b.dtype.kind == "f":
+                    b._data = b._data.astype(d)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self._sub_layers.items():
+            mod_str = repr(l)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        main += ")"
+        return main
+
+
+class _HookRemoveHelper:
+    def __init__(self, store, hid):
+        self._store = store
+        self._hid = hid
+
+    def remove(self):
+        self._store.pop(self._hid, None)

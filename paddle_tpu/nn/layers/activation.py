@@ -1,0 +1,86 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from ..layer import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "LogSoftmax",
+    "LeakyReLU", "ELU", "SELU", "CELU", "Silu", "Swish", "Mish", "Softplus",
+    "Softsign", "Hardtanh", "Hardsigmoid", "Hardswish", "Hardshrink",
+    "Softshrink", "Tanhshrink", "ThresholdedReLU", "LogSigmoid", "Maxout",
+    "PReLU", "GLU",
+]
+
+
+def _simple(name, fn_name=None, **defaults):
+    fn = getattr(F, fn_name or name.lower())
+
+    class _Act(Layer):
+        def __init__(self, *args, name=None, **kw):
+            super().__init__()
+            merged = dict(defaults)
+            keys = list(defaults.keys())
+            for i, a in enumerate(args):
+                merged[keys[i]] = a
+            merged.update({k: v for k, v in kw.items() if k in merged})
+            self._kw = merged
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _simple("ReLU")
+ReLU6 = _simple("ReLU6")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Tanh = _simple("Tanh")
+GELU = _simple("GELU", "gelu", approximate=False)
+Softmax = _simple("Softmax", "softmax", axis=-1)
+LogSoftmax = _simple("LogSoftmax", "log_softmax", axis=-1)
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", negative_slope=0.01)
+ELU = _simple("ELU", "elu", alpha=1.0)
+SELU = _simple("SELU", "selu")
+CELU = _simple("CELU", "celu", alpha=1.0)
+Silu = _simple("Silu", "silu")
+Swish = _simple("Swish", "swish")
+Mish = _simple("Mish", "mish")
+Softplus = _simple("Softplus", "softplus", beta=1.0, threshold=20.0)
+Softsign = _simple("Softsign", "softsign")
+Hardtanh = _simple("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardshrink = _simple("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _simple("Softshrink", "softshrink", threshold=0.5)
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu", threshold=1.0)
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+GLU = _simple("GLU", "glu", axis=-1)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=Constant(init),
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,365 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py over
+operators/rnn_op.*, fluid/layers/rnn.py dynamic_rnn).
+
+TPU-first: the time loop is `jax.lax.scan` — one compiled fused loop, no
+per-step dispatch (the reference's CUDA path uses cuDNN RNN for the same
+reason). Gate order is [i, f, g, o] matching paddle's rnn_op convention.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as AG
+from ...core import random as rnd
+from ...core.tensor import Tensor
+from ..initializer import Uniform
+from ..layer import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM", "GRU", "BiRNN"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref._data.shape[batch_dim_idx]
+        h = Tensor(jnp.full((batch, self.hidden_size), init_value, self._dtype))
+        if getattr(self, "_is_lstm", False):
+            c = Tensor(jnp.full((batch, self.hidden_size), init_value, self._dtype))
+            return h, c
+        return h
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            new = act(x @ wi.T + bi + h @ wh.T + bh)
+            return new, new
+
+        out, h = AG.apply(
+            f, (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh), name="simple_rnn_cell")
+        return out, h
+
+
+class LSTMCell(RNNCellBase):
+    _is_lstm = True
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+        H = self.hidden_size
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(fg) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, h_new, c_new
+
+        out, h, c = AG.apply(
+            f, (inputs, h0, c0, self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh), name="lstm_cell")
+        return out, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            new = (1 - z) * c + z * h
+            return new, new
+
+        out, h = AG.apply(
+            f, (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh), name="gru_cell")
+        return out, h
+
+
+def _scan_rnn(mode, x, h0, c0, params, reverse=False):
+    """Single-layer scan. x: (B, T, I) raw; params: (wi, wh, bi, bh) raws."""
+    wi, wh, bi, bh = params
+
+    def step(carry, xt):
+        if mode == "LSTM":
+            h, c = carry
+            gates = xt @ wi.T + bi + h @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(fg) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        if mode == "GRU":
+            h = carry
+            gi = xt @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            new = (1 - z) * c + z * h
+            return new, new
+        h = carry
+        act = jax.nn.relu if mode == "RNN_RELU" else jnp.tanh
+        new = act(xt @ wi.T + bi + h @ wh.T + bh)
+        return new, new
+
+    xs = jnp.swapaxes(x, 0, 1)  # (T, B, I)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    carry = (h0, c0) if mode == "LSTM" else h0
+    carry, ys = jax.lax.scan(step, carry, xs)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return carry, jnp.swapaxes(ys, 0, 1)  # (B, T, H)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        if direction in ("forward",):
+            self.num_directions = 1
+        elif direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        else:
+            raise ValueError(f"unknown direction {direction}")
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                isz = input_size if layer == 0 else hidden_size * self.num_directions
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                for pname, shape, attr, bias in (
+                    ("weight_ih", [gate_mult * hidden_size, isz], weight_ih_attr, False),
+                    ("weight_hh", [gate_mult * hidden_size, hidden_size], weight_hh_attr, False),
+                    ("bias_ih", [gate_mult * hidden_size], bias_ih_attr, True),
+                    ("bias_hh", [gate_mult * hidden_size], bias_hh_attr, True),
+                ):
+                    p = self.create_parameter(shape, attr, is_bias=bias,
+                                              default_initializer=init)
+                    self.add_parameter(pname + sfx, p)
+                self._param_names.append(
+                    tuple(n + sfx for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"))
+                )
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = "LSTM" if self.mode == "LSTM" else (
+            "GRU" if self.mode == "GRU" else "RNN")
+        x = inputs
+        B_axis = 1 if self.time_major else 0
+        batch = x._data.shape[B_axis]
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+
+        if initial_states is None:
+            z = jnp.zeros((L * D, batch, H), x._data.dtype)
+            if self.mode == "LSTM":
+                initial_states = (Tensor(z), Tensor(z))
+            else:
+                initial_states = Tensor(z)
+        if self.mode == "LSTM":
+            h0_t, c0_t = initial_states
+        else:
+            h0_t, c0_t = initial_states, None
+
+        param_tensors = []
+        for names in self._param_names:
+            param_tensors.extend(self._parameters[n] for n in names)
+
+        time_major = self.time_major
+        # inter-layer dropout (applied to each layer's output except the
+        # last, paddle nn/layer/rnn.py semantics); keys drawn up front so the
+        # scan body stays pure
+        drop_p = self.dropout if (self.training and self.dropout > 0) else 0.0
+        drop_keys = list(rnd.next_keys(L - 1)) if drop_p > 0 and L > 1 else []
+
+        def f(xr, h0r, *rest):
+            if self.mode == "LSTM":
+                c0r = rest[0]
+                praw = rest[1:]
+            else:
+                c0r = None
+                praw = rest
+            cur = jnp.swapaxes(xr, 0, 1) if time_major else xr  # (B,T,I)
+            hs, cs = [], []
+            for layer in range(L):
+                outs = []
+                for d in range(D):
+                    idx = layer * D + d
+                    params = praw[idx * 4 : idx * 4 + 4]
+                    h_init = h0r[idx]
+                    c_init = c0r[idx] if c0r is not None else None
+                    carry, y = _scan_rnn(mode if mode != "RNN" else self.mode,
+                                         cur, h_init, c_init, params,
+                                         reverse=(d == 1))
+                    if self.mode == "LSTM":
+                        hs.append(carry[0])
+                        cs.append(carry[1])
+                    else:
+                        hs.append(carry)
+                    outs.append(y)
+                cur = jnp.concatenate(outs, axis=-1) if D == 2 else outs[0]
+                if drop_p > 0 and layer < L - 1:
+                    keep = jax.random.bernoulli(
+                        drop_keys[layer], 1.0 - drop_p, cur.shape
+                    )
+                    cur = jnp.where(keep, cur / (1.0 - drop_p), 0.0)
+            out = jnp.swapaxes(cur, 0, 1) if time_major else cur
+            h_all = jnp.stack(hs, 0)
+            if self.mode == "LSTM":
+                return out, h_all, jnp.stack(cs, 0)
+            return out, h_all
+
+        args = [x, h0_t]
+        if self.mode == "LSTM":
+            args.append(c0_t)
+        args.extend(param_tensors)
+        res = AG.apply(f, tuple(args), name=self.mode.lower())
+        if self.mode == "LSTM":
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time (fluid/layers/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # Eager reference path: python loop (short sequences / tests);
+        # jitted paths should use SimpleRNN/LSTM/GRU which scan.
+        T_axis = 0 if self.time_major else 1
+        T = inputs._data.shape[T_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        from ...ops.manipulation import stack
+
+        for t in steps:
+            xt = inputs[(t,) if self.time_major else (slice(None), t)]
+            out, states = self.cell(xt, states)
+            outs[t] = out
+        return stack(outs, axis=T_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,461 @@
+"""Optimizers (reference: python/paddle/optimizer/optimizer.py base +
+adam.py/adamw.py/momentum.py/... over operators/optimizers/*).
+
+TPU-first: each update rule is a pure jax function jitted once per
+param-shape (the analog of the reference's fused CUDA optimizer kernels);
+state lives in per-param jax arrays. The same rules power the jit/to_static
+training path (they are pure functions of (param, grad, state)) — see
+paddle_tpu.jit for whole-step fusion.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Parameter, Tensor
+from ..regularizer import WeightDecayRegularizer
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, WeightDecayRegularizer):
+            self._regularization = weight_decay
+            self._wd_coeff = 0.0
+        elif isinstance(weight_decay, (int, float)) and not isinstance(
+            weight_decay, bool
+        ):
+            from ..regularizer import L2Decay
+
+            self._regularization = L2Decay(weight_decay)
+            self._wd_coeff = weight_decay
+        else:
+            self._regularization = None
+            self._wd_coeff = 0.0
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._step_count = 0
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state --------------------------------------------------------------
+    def _acc(self, name: str, p: Parameter, init=None):
+        store = self._accumulators.setdefault(name, {})
+        if id(p) not in store:
+            store[id(p)] = (
+                jnp.zeros_like(p._data) if init is None else init
+            )
+        return store[id(p)]
+
+    def _set_acc(self, name: str, p: Parameter, value):
+        self._accumulators[name][id(p)] = value
+
+    def state_dict(self) -> Dict:
+        """Accumulators + LR state (optimizer.py state_dict parity)."""
+        out = {}
+        params = self._get_params()
+        name_of = {id(p): (p.name or f"param_{i}") for i, p in enumerate(params)}
+        for acc_name, store in self._accumulators.items():
+            for pid, arr in store.items():
+                if pid in name_of:
+                    out[f"{name_of[pid]}.{acc_name}"] = Tensor._wrap(arr)
+        out["@step"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        params = self._get_params()
+        name_of = {(p.name or f"param_{i}"): p for i, p in enumerate(params)}
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        for key, val in state.items():
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            pname, acc_name = key.rsplit(".", 1)
+            if pname in name_of:
+                p = name_of[pname]
+                raw = val._data if isinstance(val, Tensor) else jnp.asarray(val)
+                self._accumulators.setdefault(acc_name, {})[id(p)] = raw
+
+    set_dict = set_state_dict
+
+    # -- the step -----------------------------------------------------------
+    def _get_params(self) -> List[Parameter]:
+        if self._parameter_list is None:
+            raise ValueError(
+                "Optimizer constructed without parameters; pass parameters= "
+                "or use minimize(loss, parameter_list=...)"
+            )
+        return self._parameter_list
+
+    def step(self):
+        """Apply one update from accumulated .grad (dygraph step path —
+        reference: optimizer.py _apply_optimize → core.ops.adam etc.)."""
+        params = [
+            p for p in self._get_params()
+            if not p.stop_gradient or p.grad is not None
+        ]
+        params_grads = [(p, p.grad) for p in params if p.grad is not None]
+        if not params_grads:
+            return
+        # regularization (L2/L1 -> grad term; reference appends regularization
+        # ops before clip). Per-param regularizer overrides the optimizer one.
+        if self._regularization is not None or any(
+            p.regularizer is not None for p, _ in params_grads
+        ):
+            regularized = []
+            for p, g in params_grads:
+                reg = p.regularizer or self._regularization
+                if reg is not None:
+                    g = Tensor._wrap(g._data + reg.grad_term(p._data))
+                regularized.append((p, g))
+            params_grads = regularized
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        with autograd.no_grad():
+            for p, g in params_grads:
+                p_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
+                self._apply_one(p, g._data.astype(p._data.dtype), p_lr)
+
+    def _apply_one(self, p: Parameter, g, lr: float):
+        raise NotImplementedError
+
+    def clear_grad(self):
+        for p in self._get_params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """loss.backward() + step() convenience (static-graph-era API)."""
+        if parameters is not None:
+            self._parameter_list = list(parameters)
+        loss.backward()
+        self.step()
+        return None, None
+
+
+def _jit_rule(fn):
+    """Compile an update rule once per shape/dtype; scalars ride as arrays."""
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Update rules (pure; shared by eager step and jitted train steps)
+# ---------------------------------------------------------------------------
+
+
+@_jit_rule
+def _sgd_rule(p, g, lr):
+    return p - lr * g
+
+
+@_jit_rule
+def _momentum_rule(p, g, v, lr, mu, use_nesterov):
+    v_new = mu * v + g
+    p_new = jnp.where(
+        use_nesterov, p - lr * (g + mu * v_new), p - lr * v_new
+    )
+    return p_new, v_new
+
+
+@_jit_rule
+def _adam_rule(p, g, m, v, lr, beta1, beta2, eps, t):
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * (g * g)
+    mhat = m_new / (1 - beta1**t)
+    vhat = v_new / (1 - beta2**t)
+    return p - lr * mhat / (jnp.sqrt(vhat) + eps), m_new, v_new
+
+
+@_jit_rule
+def _adamw_rule(p, g, m, v, lr, beta1, beta2, eps, t, wd):
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * (g * g)
+    mhat = m_new / (1 - beta1**t)
+    vhat = v_new / (1 - beta2**t)
+    return (
+        p - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p),
+        m_new,
+        v_new,
+    )
+
+
+@_jit_rule
+def _adagrad_rule(p, g, G, lr, eps):
+    G_new = G + g * g
+    return p - lr * g / (jnp.sqrt(G_new) + eps), G_new
+
+
+@_jit_rule
+def _adadelta_rule(p, g, Eg, Ex, rho, eps):
+    Eg_new = rho * Eg + (1 - rho) * g * g
+    dx = -jnp.sqrt(Ex + eps) / jnp.sqrt(Eg_new + eps) * g
+    Ex_new = rho * Ex + (1 - rho) * dx * dx
+    return p + dx, Eg_new, Ex_new
+
+
+@_jit_rule
+def _rmsprop_rule(p, g, ms, mom, lr, rho, eps, momentum, centered, mg):
+    ms_new = rho * ms + (1 - rho) * g * g
+    denom = jnp.where(centered, ms_new - mg * mg, ms_new)
+    mom_new = momentum * mom + lr * g / jnp.sqrt(denom + eps)
+    return p - mom_new, ms_new, mom_new
+
+
+@_jit_rule
+def _adamax_rule(p, g, m, u, lr, beta1, beta2, eps, t):
+    m_new = beta1 * m + (1 - beta1) * g
+    u_new = jnp.maximum(beta2 * u, jnp.abs(g))
+    return p - lr / (1 - beta1**t) * m_new / (u_new + eps), m_new, u_new
+
+
+@_jit_rule
+def _lamb_rule(p, g, m, v, lr, beta1, beta2, eps, t, wd):
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * (g * g)
+    mhat = m_new / (1 - beta1**t)
+    vhat = v_new / (1 - beta2**t)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    trust = jnp.where(
+        (p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0
+    )
+    return p - lr * trust * r, m_new, v_new
+
+
+class SGD(Optimizer):
+    """reference: optimizer.py SGDOptimizer / operators/optimizers/sgd_op."""
+
+    def _apply_one(self, p, g, lr):
+        p._data = _sgd_rule(p._data, g, jnp.asarray(lr, p._data.dtype))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply_one(self, p, g, lr):
+        v = self._acc("velocity", p)
+        p._data, v_new = _momentum_rule(
+            p._data, g, v,
+            jnp.asarray(lr, p._data.dtype),
+            jnp.asarray(self._momentum, p._data.dtype),
+            jnp.asarray(self._nesterov),
+        )
+        self._set_acc("velocity", p, v_new)
+
+
+class Adam(Optimizer):
+    """reference: optimizer/adam.py over operators/optimizers/adam_op."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _apply_one(self, p, g, lr):
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        d = p._data.dtype
+        p._data, m_new, v_new = _adam_rule(
+            p._data, g, m, v,
+            jnp.asarray(lr, d), jnp.asarray(self._beta1, d),
+            jnp.asarray(self._beta2, d), jnp.asarray(self._epsilon, d),
+            jnp.asarray(self._step_count, d),
+        )
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py). weight_decay
+    multiplies the param directly instead of entering the moments."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        Optimizer.__init__(self, learning_rate, parameters, None, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd = float(weight_decay) if weight_decay else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_one(self, p, g, lr):
+        wd = self._wd
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        d = p._data.dtype
+        p._data, m_new, v_new = _adamw_rule(
+            p._data, g, m, v,
+            jnp.asarray(lr, d), jnp.asarray(self._beta1, d),
+            jnp.asarray(self._beta2, d), jnp.asarray(self._epsilon, d),
+            jnp.asarray(self._step_count, d), jnp.asarray(wd, d),
+        )
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _apply_one(self, p, g, lr):
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        d = p._data.dtype
+        p._data, m_new, u_new = _adamax_rule(
+            p._data, g, m, u,
+            jnp.asarray(lr, d), jnp.asarray(self._beta1, d),
+            jnp.asarray(self._beta2, d), jnp.asarray(self._epsilon, d),
+            jnp.asarray(self._step_count, d),
+        )
+        self._set_acc("moment", p, m_new)
+        self._set_acc("inf_norm", p, u_new)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_one(self, p, g, lr):
+        G = self._acc(
+            "moment", p, jnp.full_like(p._data, self._init_acc)
+        )
+        d = p._data.dtype
+        p._data, G_new = _adagrad_rule(
+            p._data, g, G, jnp.asarray(lr, d), jnp.asarray(self._epsilon, d)
+        )
+        self._set_acc("moment", p, G_new)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _apply_one(self, p, g, lr):
+        Eg = self._acc("avg_squared_grad", p)
+        Ex = self._acc("avg_squared_update", p)
+        d = p._data.dtype
+        p._data, Eg_new, Ex_new = _adadelta_rule(
+            p._data, g, Eg, Ex,
+            jnp.asarray(self._rho, d), jnp.asarray(self._epsilon, d),
+        )
+        self._set_acc("avg_squared_grad", p, Eg_new)
+        self._set_acc("avg_squared_update", p, Ex_new)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _apply_one(self, p, g, lr):
+        ms = self._acc("mean_square", p)
+        mom = self._acc("momentum", p)
+        d = p._data.dtype
+        mg = self._acc("mean_grad", p) if self._centered else jnp.zeros((), d)
+        if self._centered:
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_acc("mean_grad", p, mg)
+        p._data, ms_new, mom_new = _rmsprop_rule(
+            p._data, g, ms, mom,
+            jnp.asarray(lr, d), jnp.asarray(self._rho, d),
+            jnp.asarray(self._epsilon, d), jnp.asarray(self._momentum, d),
+            jnp.asarray(self._centered), mg,
+        )
+        self._set_acc("mean_square", p, ms_new)
+        self._set_acc("momentum", p, mom_new)
+
+
+class Lamb(Optimizer):
+    """reference: optimizer.py LambOptimizer over optimizers/lamb_op."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, p, g, lr):
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        d = p._data.dtype
+        p._data, m_new, v_new = _lamb_rule(
+            p._data, g, m, v,
+            jnp.asarray(lr, d), jnp.asarray(self._beta1, d),
+            jnp.asarray(self._beta2, d), jnp.asarray(self._epsilon, d),
+            jnp.asarray(self._step_count, d), jnp.asarray(wd, d),
+        )
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)

@@ -1,0 +1,24 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py).
+Applied to gradients at optimizer.step time (append_regularization_ops
+analog)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def grad_term(self, p_raw):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def grad_term(self, p_raw):
+        return self._coeff * p_raw
+
+
+class L1Decay(WeightDecayRegularizer):
+    def grad_term(self, p_raw):
+        import jax.numpy as jnp
+
+        return self._coeff * jnp.sign(p_raw)

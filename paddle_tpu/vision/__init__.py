@@ -1,0 +1,2 @@
+"""paddle_tpu.vision (reference: python/paddle/vision/)."""
+from . import datasets, models, transforms  # noqa: F401

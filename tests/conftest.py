@@ -12,7 +12,10 @@ paddle_tpu import — conftest import order guarantees that under pytest.
 import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# JAX_PLATFORM_NAME (not JAX_PLATFORMS) — the axon TPU plugin's sitecustomize
+# re-pins JAX_PLATFORMS=axon, but PLATFORM_NAME wins at backend selection.
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,336 @@
+"""nn.Layer system + layers — analog of reference test_layers.py /
+test_imperative_basic.py subsets."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_forward_backward():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    loss = paddle.mean(y)
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert layer.weight.grad.shape == [4, 3]
+    assert layer.bias.grad is not None
+
+
+def test_layer_param_registration():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert len(net.parameters()) == 4
+    y = net(paddle.randn([3, 4]))
+    assert y.shape == [3, 2]
+
+
+def test_state_dict_roundtrip():
+    net1 = nn.Linear(3, 3)
+    net2 = nn.Linear(3, 3)
+    sd = net1.state_dict()
+    assert set(sd.keys()) == {"weight", "bias"}
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.weight.numpy(), net1.weight.numpy())
+    x = paddle.randn([2, 3])
+    np.testing.assert_allclose(net1(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_train_eval_mode_dropout():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+    d.train()
+    out = d(x).numpy()
+    assert (out == 0).mean() > 0.3  # roughly half dropped
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, 2.0)  # upscale_in_train
+
+
+def test_conv2d_matches_reference():
+    import jax
+
+    conv = nn.Conv2D(2, 3, kernel_size=3, padding=1, stride=1)
+    x = paddle.randn([1, 2, 8, 8])
+    y = conv(x)
+    assert y.shape == [1, 3, 8, 8]
+    # numpy reference for one output position (valid interior)
+    w = conv.weight.numpy()
+    b = conv.bias.numpy()
+    xn = x.numpy()
+    patch = xn[0, :, 2:5, 3:6]
+    want = (w[1] * patch).sum() + b[1]
+    np.testing.assert_allclose(y.numpy()[0, 1, 3, 4], want, rtol=1e-4)
+    paddle.mean(y).backward()
+    assert conv.weight.grad is not None
+
+
+def test_conv2d_stride_groups():
+    conv = nn.Conv2D(4, 4, kernel_size=3, stride=2, padding=1, groups=2)
+    y = conv(paddle.randn([2, 4, 8, 8]))
+    assert y.shape == [2, 4, 4, 4]
+
+
+def test_conv2d_transpose_shape():
+    convt = nn.Conv2DTranspose(3, 2, kernel_size=4, stride=2, padding=1)
+    y = convt(paddle.randn([1, 3, 8, 8]))
+    assert y.shape == [1, 2, 16, 16]
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16.0, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2, 2)
+    np.testing.assert_allclose(
+        mp(x).numpy()[0, 0], [[5, 7], [13, 15]]
+    )
+    ap = nn.AvgPool2D(2, 2)
+    np.testing.assert_allclose(
+        ap(x).numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]]
+    )
+    aap = nn.AdaptiveAvgPool2D(1)
+    np.testing.assert_allclose(aap(x).numpy()[0, 0], [[7.5]])
+
+
+def test_batch_norm_train_and_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 3.0 + 1.0
+    bn.train()
+    y = bn(x)
+    # normalized output: near zero mean, unit var per channel
+    yn = y.numpy()
+    assert abs(yn.mean()) < 0.1
+    assert abs(yn.std() - 1.0) < 0.1
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 5, 5]
+
+
+def test_layer_norm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8]) * 5 + 2
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor([[1, 2], [3, 4]], dtype="int32")
+    y = emb(idx)
+    assert y.shape == [2, 2, 4]
+    np.testing.assert_allclose(y.numpy()[0, 0], emb.weight.numpy()[1])
+    paddle.sum(y).backward()
+    g = emb.weight.gradient()
+    assert g[1].sum() != 0 and g[0].sum() == 0
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = seq(paddle.randn([2, 4]))
+    assert y.shape == [2, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll.parameters()) == 6
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 1.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 1])
+    np.testing.assert_allclose(
+        F.leaky_relu(x, 0.1).numpy(), [-0.1, 0, 1], rtol=1e-6
+    )
+    sm = F.softmax(paddle.to_tensor([[1.0, 2.0, 3.0]]))
+    np.testing.assert_allclose(sm.numpy().sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        F.gelu(paddle.to_tensor([1.0])).numpy(), [0.8413], rtol=1e-3
+    )
+
+
+def test_cross_entropy_matches_numpy():
+    logits = paddle.to_tensor(
+        np.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]], np.float32),
+        stop_gradient=False,
+    )
+    labels = paddle.to_tensor([0, 1], dtype="int32")
+    loss = F.cross_entropy(logits, labels)
+    ln = logits.numpy()
+    ref = -np.log(np.exp(ln[[0, 1], [0, 1]]) / np.exp(ln).sum(-1))
+    np.testing.assert_allclose(loss.item(), ref.mean(), rtol=1e-5)
+    loss.backward()
+    assert logits.grad is not None
+
+
+def test_cross_entropy_soft_label_and_ignore():
+    logits = paddle.randn([4, 5])
+    soft = F.softmax(paddle.randn([4, 5]))
+    l1 = F.cross_entropy(logits, soft, soft_label=True)
+    assert l1.ndim == 0
+    labels = paddle.to_tensor([0, 1, -100, 3], dtype="int32")
+    l2 = F.cross_entropy(logits, labels, ignore_index=-100)
+    # mean over 3 valid entries only
+    l_none = F.cross_entropy(logits, labels, ignore_index=-100, reduction="none")
+    np.testing.assert_allclose(
+        l2.item(), l_none.numpy().sum() / 3, rtol=1e-5
+    )
+
+
+def test_mse_and_bce():
+    a = paddle.to_tensor([0.2, 0.8])
+    b = paddle.to_tensor([0.0, 1.0])
+    np.testing.assert_allclose(
+        F.mse_loss(a, b).item(), ((0.2) ** 2 + (0.2) ** 2) / 2, rtol=1e-5
+    )
+    bce = F.binary_cross_entropy(a, b)
+    ref = -(np.log(0.8) + np.log(0.8)) / 2
+    np.testing.assert_allclose(bce.item(), ref, rtol=1e-3)
+
+
+def test_lstm_gru_shapes():
+    lstm = nn.LSTM(input_size=4, hidden_size=8, num_layers=2)
+    x = paddle.randn([3, 5, 4])  # B, T, I
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 5, 8]
+    assert h.shape == [2, 3, 8]
+    assert c.shape == [2, 3, 8]
+    paddle.mean(out).backward()
+    assert lstm._parameters["weight_ih_l0"].grad is not None
+
+    gru = nn.GRU(input_size=4, hidden_size=8, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [3, 5, 16]
+    assert h.shape == [2, 3, 8]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 5, 16])
+    out = mha(q, q, q)
+    assert out.shape == [2, 5, 16]
+    # causal mask changes output
+    mask = paddle.nn.Transformer.generate_square_subsequent_mask(5)
+    out2 = mha(q, q, q, attn_mask=mask)
+    assert not np.allclose(out.numpy(), out2.numpy())
+    paddle.mean(out2).backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    y = enc(x)
+    assert y.shape == [2, 6, 16]
+    paddle.mean(y).backward()
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+    src = paddle.randn([2, 4, 16])
+    tgt = paddle.randn([2, 3, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 16]
+
+
+def test_layer_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    layer(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    layer(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_no_grad_params_frozen():
+    layer = nn.Linear(2, 2)
+    layer.weight.stop_gradient = True
+    y = layer(paddle.randn([1, 2]))
+    paddle.mean(y).backward()
+    assert layer.weight.grad is None
+    assert layer.bias.grad is not None
+
+
+def test_clear_gradients():
+    layer = nn.Linear(2, 2)
+    paddle.mean(layer(paddle.randn([1, 2]))).backward()
+    assert layer.weight.grad is not None
+    layer.clear_gradients()
+    assert layer.weight.grad is None
+
+
+def test_conv_transpose_groups():
+    # code-review finding: grouped transposed conv crashed
+    convt = nn.Conv2DTranspose(4, 4, 3, stride=2, padding=1, groups=2)
+    y = convt(paddle.randn([1, 4, 5, 5]))
+    assert y.shape == [1, 4, 9, 9]
+    paddle.mean(y).backward()
+
+
+def test_pool_ceil_mode():
+    # code-review finding: ceil_mode was ignored
+    x = paddle.randn([1, 1, 5, 5])
+    assert F.max_pool2d(x, 2, 2, ceil_mode=True).shape == [1, 1, 3, 3]
+    assert F.max_pool2d(x, 2, 2, ceil_mode=False).shape == [1, 1, 2, 2]
+    xa = paddle.ones([1, 1, 5, 5])
+    out = F.avg_pool2d(xa, 2, 2, ceil_mode=True)
+    # partial windows average only the valid cells
+    np.testing.assert_allclose(out.numpy()[0, 0, 2, 2], 1.0, rtol=1e-6)
+
+
+def test_dropout_downscale_in_infer():
+    x = paddle.ones([4])
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), [0.5] * 4)
+
+
+def test_metric_auc():
+    from paddle_tpu.metric import Auc
+
+    auc = Auc()
+    preds = np.concatenate([np.random.rand(500) * 0.5, 0.5 + np.random.rand(500) * 0.5])
+    labels = np.concatenate([np.zeros(500), np.ones(500)])
+    auc.update(preds, labels)
+    assert auc.accumulate() > 0.95
+
+
+def test_optimizer_int_weight_decay():
+    from paddle_tpu import optimizer as opt_mod
+
+    p = paddle.Parameter(np.ones(2, np.float32))
+    opt = opt_mod.SGD(learning_rate=0.1, parameters=[p], weight_decay=1)
+    paddle.sum(p * 0.0).backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-5)
+
+
+def test_lstm_interlayer_dropout_active():
+    paddle.seed(5)
+    lstm = nn.LSTM(4, 8, num_layers=2, dropout=0.5)
+    lstm.train()
+    x = paddle.randn([2, 6, 4])
+    a = lstm(x)[0].numpy()
+    b = lstm(x)[0].numpy()
+    assert not np.allclose(a, b)  # stochastic between calls
+    lstm.eval()
+    c = lstm(x)[0].numpy()
+    d = lstm(x)[0].numpy()
+    np.testing.assert_allclose(c, d)

@@ -1,0 +1,182 @@
+"""Optimizers + LR schedulers — analog of reference test_sgd_op.py /
+test_adam_op.py / test_lr_scheduler.py (numpy-reference updates)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quadratic_setup():
+    p = paddle.Parameter(np.array([1.0, -2.0], np.float32))
+    return p
+
+
+def test_sgd_matches_numpy():
+    p = _quadratic_setup()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = paddle.sum(p * p)
+    loss.backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1 - 0.1 * 2, -2 + 0.1 * 4], rtol=1e-6)
+
+
+def test_momentum_matches_numpy():
+    p = _quadratic_setup()
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    v = np.zeros(2)
+    x = p.numpy().copy()
+    for _ in range(3):
+        paddle.sum(p * p).backward()
+        opt.step()
+        opt.clear_grad()
+        g = 2 * x
+        v = 0.9 * v + g
+        x = x - 0.1 * v
+    np.testing.assert_allclose(p.numpy(), x, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    p = _quadratic_setup()
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    x = p.numpy().astype(np.float64)
+    m = np.zeros(2)
+    v = np.zeros(2)
+    for t in range(1, 4):
+        paddle.sum(p * p).backward()
+        opt.step()
+        opt.clear_grad()
+        g = 2 * x
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9**t)
+        vh = v / (1 - 0.999**t)
+        x = x - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), x, rtol=1e-4)
+
+
+def test_adamw_decouples_decay():
+    p1 = paddle.Parameter(np.ones(2, np.float32))
+    p2 = paddle.Parameter(np.ones(2, np.float32))
+    adam = optimizer.Adam(learning_rate=0.01, parameters=[p1])
+    adamw = optimizer.AdamW(learning_rate=0.01, weight_decay=0.1,
+                            parameters=[p2])
+    for opt, p in ((adam, p1), (adamw, p2)):
+        paddle.sum(p * 0.0).backward()  # zero grads
+        opt.step()
+    # adamw still decays weights with zero grad; adam does not
+    np.testing.assert_allclose(p1.numpy(), 1.0, atol=1e-6)
+    assert (p2.numpy() < 1.0).all()
+
+
+def test_training_converges_linear_regression():
+    paddle.seed(3)
+    net = nn.Linear(3, 1)
+    opt = optimizer.Adam(learning_rate=0.1, parameters=net.parameters())
+    w_true = np.array([[1.0], [2.0], [3.0]], np.float32)
+    X = np.random.RandomState(0).rand(64, 3).astype(np.float32)
+    Y = X @ w_true
+    x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+    for _ in range(400):
+        loss = paddle.nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert loss.item() < 2e-2
+    np.testing.assert_allclose(net.weight.numpy(), w_true, atol=0.3)
+
+
+def test_grad_clip_global_norm():
+    p = paddle.Parameter(np.array([10.0], np.float32))
+    clip = paddle.nn.ClipGradByGlobalNorm(1.0)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    paddle.sum(p * p).backward()  # grad = 20
+    opt.step()
+    # clipped grad has norm 1 -> p = 10 - 1
+    np.testing.assert_allclose(p.numpy(), [9.0], rtol=1e-4)
+
+
+def test_weight_decay_l2():
+    from paddle_tpu.regularizer import L2Decay
+
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p],
+                        weight_decay=L2Decay(0.5))
+    paddle.sum(p * 0.0).backward()
+    opt.step()
+    # g = 0 + 0.5*p -> p = 1 - 0.1*0.5 = 0.95
+    np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-6)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = _quadratic_setup()
+    p.name = "w0"
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    paddle.sum(p * p).backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert any("moment1" in k for k in sd)
+
+    p2 = paddle.Parameter(np.array([1.0, -2.0], np.float32))
+    p2.name = "w0"
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=[p2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    m1 = opt._accumulators["moment1"][id(p)]
+    m2 = opt2._accumulators["moment1"][id(p2)]
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2))
+
+
+def test_lr_scheduler_with_optimizer():
+    from paddle_tpu.optimizer.lr import StepDecay
+
+    sched = StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    p = _quadratic_setup()
+    opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+    assert opt.get_lr() == 0.1
+    sched.step()
+    sched.step()
+    assert opt.get_lr() == pytest.approx(0.05)
+
+
+@pytest.mark.parametrize("cls,kw,expected0", [
+    ("ExponentialDecay", dict(learning_rate=1.0, gamma=0.5), 1.0),
+    ("CosineAnnealingDecay", dict(learning_rate=1.0, T_max=10), 1.0),
+    ("PolynomialDecay", dict(learning_rate=1.0, decay_steps=10), 1.0),
+    ("MultiStepDecay", dict(learning_rate=1.0, milestones=[2, 4]), 1.0),
+    ("NaturalExpDecay", dict(learning_rate=1.0, gamma=0.1), 1.0),
+    ("InverseTimeDecay", dict(learning_rate=1.0, gamma=0.1), 1.0),
+    ("PiecewiseDecay", dict(boundaries=[2, 4], values=[1.0, 0.5, 0.1]), 1.0),
+])
+def test_schedules_start_and_decay(cls, kw, expected0):
+    from paddle_tpu.optimizer import lr as lr_mod
+
+    sched = getattr(lr_mod, cls)(**kw)
+    assert sched() == pytest.approx(expected0)
+    for _ in range(5):
+        sched.step()
+    assert sched() <= expected0
+
+
+def test_linear_warmup():
+    from paddle_tpu.optimizer.lr import LinearWarmup
+
+    s = LinearWarmup(learning_rate=0.5, warmup_steps=5, start_lr=0.0,
+                     end_lr=0.5)
+    vals = [s()]
+    for _ in range(6):
+        s.step()
+        vals.append(s())
+    assert vals[0] == 0.0
+    assert vals[5] == pytest.approx(0.5)
+    assert vals[6] == pytest.approx(0.5)
+
+
+def test_reduce_on_plateau():
+    from paddle_tpu.optimizer.lr import ReduceOnPlateau
+
+    s = ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+    s.step(1.0)
+    s.step(1.0)  # bad 1
+    s.step(1.0)  # bad 2 -> reduce
+    assert s() == pytest.approx(0.5)
