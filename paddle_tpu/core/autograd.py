@@ -80,6 +80,18 @@ def in_trace() -> bool:
     return _state.trace_depth > 0
 
 
+def _maybe_amp_cast(name, raws):
+    """AMP input casting hook (AutoCastInputs analog, tracer.cc:159-161);
+    no-op unless paddle_tpu.amp.auto_cast is active."""
+    try:
+        from ..amp import _state as amp_state, cast_if_amp
+    except ImportError:
+        return raws
+    if not amp_state.enabled:
+        return raws
+    return cast_if_amp(name, raws)
+
+
 class TapeNode:
     """One recorded op on the tape (OpBase/GradOpNode analog, op_base.h:33)."""
 
@@ -87,16 +99,18 @@ class TapeNode:
         "vjp_fn",
         "inputs",
         "n_out",
+        "multi",
         "out_avals",
         "out_refs",
         "name",
         "released",
     )
 
-    def __init__(self, vjp_fn, inputs, n_out, out_avals, name=None):
+    def __init__(self, vjp_fn, inputs, n_out, out_avals, name=None, multi=False):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # tuple[Tensor] — strong refs, like VarBase grad graph
         self.n_out = n_out
+        self.multi = multi  # original output was a tuple (even of length 1)
         self.out_avals = out_avals  # [(shape, dtype)] per output
         self.out_refs = [None] * n_out  # weakrefs to wrapped output Tensors
         self.name = name or "op"
@@ -113,6 +127,7 @@ def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
     from .tensor import Tensor  # late import; Tensor depends on ops at patch time
 
     raws = tuple(t._data for t in tensors)
+    raws = _maybe_amp_cast(name, raws)
     need_grad = (
         _state.trace_depth == 0
         and _state.grad_enabled
@@ -133,6 +148,7 @@ def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
         len(outs),
         [(o.shape, o.dtype) for o in outs],
         name=name,
+        multi=multi,
     )
     wrapped = tuple(
         Tensor._wrap(o, stop_gradient=False, node=node, out_idx=i)
@@ -140,6 +156,47 @@ def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
     )
     node.out_refs = [weakref.ref(w) for w in wrapped]
     return wrapped if multi else wrapped[0]
+
+
+def apply_aux(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
+    """Like apply(), for raw_fn returning (outputs, aux): outputs participate
+    in autograd, aux (non-differentiable side state, e.g. updated batch-norm
+    buffers or RNG carry from a traced program) is returned raw.
+
+    The run_program-op analog (reference: operators/run_program_op.cc runs a
+    whole captured program as one differentiable op with side state).
+    """
+    from .tensor import Tensor
+
+    raws = tuple(t._data for t in tensors)
+    need_grad = (
+        _state.trace_depth == 0
+        and _state.grad_enabled
+        and any(not t.stop_gradient for t in tensors)
+    )
+    if not need_grad:
+        out, aux = raw_fn(*raws)
+        if isinstance(out, (tuple, list)):
+            return tuple(Tensor._wrap(o, stop_gradient=True) for o in out), aux
+        return Tensor._wrap(out, stop_gradient=True), aux
+
+    out, vjp_fn, aux = jax.vjp(raw_fn, *raws, has_aux=True)
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+    node = TapeNode(
+        vjp_fn,
+        tuple(tensors),
+        len(outs),
+        [(o.shape, o.dtype) for o in outs],
+        name=name,
+        multi=multi,
+    )
+    wrapped = tuple(
+        Tensor._wrap(o, stop_gradient=False, node=node, out_idx=i)
+        for i, o in enumerate(outs)
+    )
+    node.out_refs = [weakref.ref(w) for w in wrapped]
+    return (wrapped if multi else wrapped[0]), aux
 
 
 def apply_nondiff(raw_fn: Callable, tensors: Sequence):
@@ -368,7 +425,7 @@ def _run_engine(
             if t_out is not None:
                 c = finalize(t_out, c)
             final.append(c)
-        arg = tuple(final) if node.n_out > 1 else final[0]
+        arg = tuple(final) if node.multi else final[0]
         in_cots = node.vjp_fn(arg)
         if not retain_graph:
             node.vjp_fn = None

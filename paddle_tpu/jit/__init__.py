@@ -1,0 +1,12 @@
+"""paddle_tpu.jit (reference: python/paddle/jit/__init__.py over
+fluid/dygraph/jit.py and dygraph_to_static/)."""
+from .control_flow import case, cond, scan, switch_case, while_loop  # noqa: F401
+from .program import InputSpec, StaticFunction, declarative, to_static  # noqa: F401
+from .recompute import recompute  # noqa: F401
+from .save_load import TranslatedLayer, load, save  # noqa: F401
+
+
+def not_to_static(fn):
+    """Marker parity shim: function is left eager inside to_static programs
+    (everything traced here is already eager-compatible)."""
+    return fn

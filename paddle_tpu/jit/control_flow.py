@@ -1,0 +1,133 @@
+"""Traceable control flow.
+
+reference: the dygraph_to_static converted-operator runtime
+(python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py:
+convert_ifelse, convert_while_loop) and static ops
+(fluid/layers/control_flow.py cond/while_loop over
+operators/controlflow/conditional_block_op.cc, while_op.cc).
+
+In eager mode these run plain Python; under to_static capture they lower to
+lax.cond / lax.while_loop / lax.scan so data-dependent control flow compiles
+(SURVEY.md §3.5 TPU mapping: jit+lax conversion helpers replace AST
+rewriting).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "scan", "case", "switch_case"]
+
+
+def _unwrap(tree):
+    if isinstance(tree, Tensor):
+        return tree._data
+    if isinstance(tree, (list, tuple)):
+        t = [_unwrap(v) for v in tree]
+        return type(tree)(t) if not isinstance(tree, tuple) else tuple(t)
+    if isinstance(tree, dict):
+        return {k: _unwrap(v) for k, v in tree.items()}
+    return tree
+
+
+def _wrap(tree):
+    if isinstance(tree, (jax.Array,)) or hasattr(tree, "dtype") and hasattr(tree, "shape"):
+        return Tensor._wrap(tree)
+    if isinstance(tree, (list, tuple)):
+        t = [_wrap(v) for v in tree]
+        return tuple(t) if isinstance(tree, tuple) else t
+    if isinstance(tree, dict):
+        return {k: _wrap(v) for k, v in tree.items()}
+    return tree
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """paddle.static.nn.cond / lax.cond hybrid."""
+    if isinstance(pred, Tensor):
+        if not AG.in_trace():
+            return true_fn(*operands) if bool(pred) else false_fn(*operands)
+
+        def tf(ops):
+            return _unwrap(true_fn(*_wrap(list(ops))))
+
+        def ff(ops):
+            return _unwrap(false_fn(*_wrap(list(ops))))
+
+        out = jax.lax.cond(pred._data, tf, ff, tuple(_unwrap(list(operands))))
+        return _wrap(out)
+    return true_fn(*operands) if pred else false_fn(*operands)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence):
+    """paddle.static.nn.while_loop; lax.while_loop under capture."""
+    if not AG.in_trace():
+        vars_ = list(loop_vars)
+        while bool(cond_fn(*vars_)):
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    def cf(carry):
+        r = cond_fn(*_wrap(list(carry)))
+        return r._data if isinstance(r, Tensor) else r
+
+    def bf(carry):
+        out = body_fn(*_wrap(list(carry)))
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(_unwrap(list(out)))
+
+    out = jax.lax.while_loop(cf, bf, tuple(_unwrap(list(loop_vars))))
+    return list(_wrap(out))
+
+
+def scan(body_fn: Callable, init, xs, length=None):
+    """lax.scan surfaced at the paddle level (no direct reference analog —
+    the TPU-idiomatic replacement for fluid dynamic_rnn loops)."""
+
+    def bf(carry, x):
+        c, y = body_fn(_wrap(carry), _wrap(x))
+        return _unwrap(c), _unwrap(y)
+
+    carry, ys = jax.lax.scan(bf, _unwrap(init), _unwrap(xs), length=length)
+    return _wrap(carry), _wrap(ys)
+
+
+def case(pred_fn_pairs, default=None):
+    """fluid/layers/control_flow.py case."""
+    for pred, fn in pred_fn_pairs:
+        flag = bool(pred) if not AG.in_trace() else None
+        if AG.in_trace():
+            raise NotImplementedError(
+                "case under to_static: use nested paddle_tpu.jit.cond"
+            )
+        if flag:
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("no branch taken and no default provided")
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    if AG.in_trace():
+        idx = branch_index._data if isinstance(branch_index, Tensor) else branch_index
+        fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+        keys = sorted(fns)
+        branches = [lambda _, f=fns[k]: _unwrap(f()) for k in keys]
+        pos = sum(
+            jnp.where(idx == k, i, 0) for i, k in enumerate(keys)
+        )
+        out = jax.lax.switch(pos, branches, None)
+        return _wrap(out)
+    idx = int(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    raise ValueError(f"no branch for index {idx}")

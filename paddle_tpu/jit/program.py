@@ -1,0 +1,310 @@
+"""Program capture: the to_static engine.
+
+reference mapping (SURVEY.md §3.5):
+  - `@declarative`/ProgramTranslator (python/paddle/fluid/dygraph/
+    dygraph_to_static/program_translator.py:233,582,689) ≙ `StaticFunction`
+    here: per-input-spec ProgramCache of traced+compiled programs. No AST
+    rewriting is needed — eager ops already run on jax, so tracing the
+    Python function under `trace_mode` captures the whole computation; data-
+    dependent Python control flow must use paddle_tpu.jit.cond/while_loop
+    (≙ the reference's convert_ifelse/convert_while runtime).
+  - `PartialProgramLayer` + run_program op (partial_program.py:206,
+    operators/run_program_op.cc) ≙ `_CompiledProgram.__call__`: the whole
+    compiled program executes as ONE eager tape op (autograd.apply_aux), so
+    the per-op tape overhead vanishes and XLA sees one fused graph.
+
+State handling: Parameters and buffers of every involved Layer are lifted to
+program inputs; buffers mutated during capture (batch-norm running stats)
+come back as aux outputs and are written back after each call. RNG inside
+the program draws from a per-call key argument via the trace-key provider
+(core/random.py), keeping compiled programs pure and the eager/global seed
+semantics intact.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core import random as rnd
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer import Layer
+
+
+class InputSpec:
+    """Input signature (reference: python/paddle/static/input.py InputSpec).
+    Dynamic (None) dims are allowed in the spec; compilation caches on the
+    concrete shapes seen (XLA needs static shapes — each new concrete shape
+    is one more cached executable)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _collect_layers(obj, fn) -> List[Layer]:
+    """Find Layers whose params/buffers must be lifted to program inputs."""
+    layers = []
+    if isinstance(obj, Layer):
+        layers.append(obj)
+    # plain function: scan closure + globals one level for Layers
+    if fn is not None and not isinstance(obj, Layer):
+        seen = set()
+        candidates = []
+        if getattr(fn, "__closure__", None):
+            candidates.extend(
+                c.cell_contents
+                for c in fn.__closure__
+                if c.cell_contents is not None
+            )
+        for v in list(getattr(fn, "__globals__", {}).values()):
+            candidates.append(v)
+        for v in candidates:
+            if isinstance(v, Layer) and id(v) not in seen:
+                seen.add(id(v))
+                layers.append(v)
+    return layers
+
+
+class _CompiledProgram:
+    """One (input-spec, training-mode) entry of the ProgramCache."""
+
+    def __init__(self, fn, layers: List[Layer], n_tensor_args: int,
+                 static_kwargs: Dict[str, Any], arg_template: Tuple):
+        self.fn = fn
+        self.layers = layers
+        self.static_kwargs = static_kwargs
+        self.arg_template = arg_template
+        # stable param/buffer order
+        self.params: List[Parameter] = []
+        seen = set()
+        for l in layers:
+            for _, p in l.named_parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    self.params.append(p)
+        self.buffers: List[Tensor] = []
+        for l in layers:
+            for _, b in l.named_buffers():
+                if id(b) not in seen:
+                    seen.add(id(b))
+                    self.buffers.append(b)
+
+        self._jitted = jax.jit(self._program)
+        self.out_treedef = None  # set at first call (trace)
+
+    # -- the pure program ----------------------------------------------------
+    def _program(self, param_raws, buffer_raws, key, input_raws):
+        saved_p = [p._data for p in self.params]
+        saved_b = [b._data for b in self.buffers]
+        counter = [0]
+
+        def key_provider():
+            counter[0] += 1
+            return jax.random.fold_in(key, counter[0])
+
+        prev_provider = rnd.set_trace_key_provider(key_provider)
+        try:
+            with AG.trace_mode():
+                for p, raw in zip(self.params, param_raws):
+                    p._data = raw
+                for b, raw in zip(self.buffers, buffer_raws):
+                    b._data = raw
+                args = self._rebuild_args(input_raws)
+                out = self.fn(*args, **self.static_kwargs)
+                out_raws, treedef = _flatten_out(out)
+                self.out_treedef = treedef
+                new_buf = [b._data for b in self.buffers]
+            return tuple(out_raws), tuple(new_buf)
+        finally:
+            rnd.set_trace_key_provider(prev_provider)
+            for p, raw in zip(self.params, saved_p):
+                p._data = raw
+            for b, raw in zip(self.buffers, saved_b):
+                b._data = raw
+
+    def _rebuild_args(self, input_raws):
+        """Reinsert traced tensors into the original arg structure."""
+        raws = list(input_raws)
+        args = []
+        for kind, val in self.arg_template:
+            if kind == "tensor":
+                args.append(Tensor._wrap(raws.pop(0)))
+            else:
+                args.append(val)
+        return args
+
+    # -- eager entry ---------------------------------------------------------
+    def __call__(self, tensor_args: Sequence[Tensor]):
+        key = rnd.next_key()
+        buffer_raws = tuple(b._data for b in self.buffers)
+
+        def raw_fn(*all_raws):
+            n_in = len(tensor_args)
+            input_raws = all_raws[:n_in]
+            param_raws = all_raws[n_in:]
+            outs, new_buf = self._jitted(
+                tuple(param_raws), buffer_raws, key, tuple(input_raws)
+            )
+            return outs, new_buf
+
+        all_inputs = list(tensor_args) + self.params
+        outs, new_buf = AG.apply_aux(raw_fn, all_inputs, name="run_program")
+        for b, raw in zip(self.buffers, new_buf):
+            b._data = raw
+            b._node = None
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        return _unflatten_out(list(outs), self.out_treedef)
+
+
+def _flatten_out(out):
+    """Flatten nested (tuple/list/dict/Tensor/raw) outputs -> raw list +
+    treedef for reconstruction."""
+    leaves = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            leaves.append(o._data)
+            return ("t", None)
+        if isinstance(o, (jnp.ndarray, jax.Array)) or hasattr(o, "shape"):
+            leaves.append(jnp.asarray(o))
+            return ("t", None)
+        if isinstance(o, tuple):
+            return ("tuple", [rec(v) for v in o])
+        if isinstance(o, list):
+            return ("list", [rec(v) for v in o])
+        if isinstance(o, dict):
+            return ("dict", [(k, rec(v)) for k, v in o.items()])
+        return ("const", o)
+
+    treedef = rec(out)
+    return leaves, treedef
+
+
+def _unflatten_out(leaves: List, treedef):
+    def rec(td):
+        kind, spec = td
+        if kind == "t":
+            return leaves.pop(0)
+        if kind == "tuple":
+            return tuple(rec(s) for s in spec)
+        if kind == "list":
+            return [rec(s) for s in spec]
+        if kind == "dict":
+            return {k: rec(s) for k, s in spec}
+        return spec
+
+    return rec(treedef)
+
+
+class StaticFunction:
+    """to_static wrapper (program_translator.py:233 StaticFunction)."""
+
+    def __init__(self, fn, layer: Optional[Layer] = None, input_spec=None,
+                 build_strategy=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: Dict[Tuple, _CompiledProgram] = {}
+        self._lock = threading.Lock()
+        self.__name__ = getattr(fn, "__name__", "static_fn")
+
+    def __get__(self, instance, owner):
+        # support @to_static on methods: bind per-instance
+        if instance is None:
+            return self
+        bound = StaticFunction(
+            self._fn.__get__(instance, owner), layer=instance,
+            input_spec=self._input_spec,
+        )
+        # cache the bound wrapper on the instance
+        object.__setattr__(instance, self.__name__, bound)
+        return bound
+
+    def _split_args(self, args, kwargs):
+        tensor_args = []
+        template = []
+        for a in args:
+            if isinstance(a, Tensor):
+                tensor_args.append(a)
+                template.append(("tensor", None))
+            else:
+                template.append(("const", a))
+        return tensor_args, tuple(template), dict(kwargs)
+
+    def _cache_key(self, tensor_args, template, kwargs, layers):
+        sig = tuple(
+            (tuple(t._data.shape), str(t._data.dtype)) for t in tensor_args
+        )
+        consts = tuple(
+            (k, v) for k, v in sorted(kwargs.items())
+            if not isinstance(v, Tensor)
+        )
+        modes = tuple(l.training for lay in layers for l in lay.sublayers(True))
+        tmpl_consts = tuple(
+            v if _hashable(v) else repr(v) for k, v in template if k == "const"
+        )
+        return (sig, consts, modes, tmpl_consts)
+
+    def __call__(self, *args, **kwargs):
+        tensor_args, template, kw = self._split_args(args, kwargs)
+        layers = _collect_layers(self._layer, self._fn)
+        key = self._cache_key(tensor_args, template, kw, layers)
+        prog = self._cache.get(key)
+        if prog is None:
+            with self._lock:
+                prog = self._cache.get(key)
+                if prog is None:
+                    prog = _CompiledProgram(
+                        self._fn, layers, len(tensor_args), kw, template
+                    )
+                    # prime out_treedef via a tracing dry-run happens on the
+                    # first real call (jax.jit traces lazily)
+                    self._cache[key] = prog
+        return prog(tensor_args)
+
+    @property
+    def program_cache(self):
+        return self._cache
+
+    def concrete_program(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+def _hashable(v):
+    try:
+        hash(v)
+        return True
+    except TypeError:
+        return False
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              property_=False):
+    """paddle.jit.to_static (reference: fluid/dygraph/jit.py:160
+    declarative). Works on Layer instances, methods, and functions."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            wrapped = StaticFunction(fn.forward, layer=fn,
+                                     input_spec=input_spec)
+            fn.forward = wrapped
+            return fn
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static

@@ -1,0 +1,70 @@
+"""Activation recomputation.
+
+reference: RecomputeOptimizer (python/paddle/fluid/optimizer.py:4549) and
+fleet recompute (meta_optimizers/recompute_optimizer.py:18 — re-emit
+forward subgraphs in backward via append_backward(checkpoints)).
+
+TPU-native: `jax.checkpoint` (remat) on the wrapped segment — XLA re-emits
+the forward in the backward pass, trading FLOPs for HBM (SURVEY.md §7 remat
+policies). Layer parameters touched by the segment are lifted to explicit
+checkpoint arguments so gradients flow (a closed-over param would be a
+constant to jax.checkpoint).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .program import _collect_layers
+
+
+def recompute(function, *args, preserve_rng_state=True, **kwargs):
+    """paddle.distributed.fleet.utils.recompute parity. `function` may be a
+    Layer, a bound Layer method, or a function closing over Layers."""
+    owner = None
+    fn = function
+    if isinstance(function, Layer):
+        owner = function
+        fn = function.forward
+    elif isinstance(getattr(function, "__self__", None), Layer):
+        owner = function.__self__
+    layers = _collect_layers(owner, fn)
+    params = []
+    seen = set()
+    for l in layers:
+        for p in l.parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    template = [("t", None) if isinstance(a, Tensor) else ("c", a) for a in args]
+    n_in = len(tensor_args)
+
+    def raw_fn(*raws):
+        input_raws = raws[:n_in]
+        param_raws = raws[n_in:]
+        saved = [p._data for p in params]
+        it = iter(input_raws)
+        rebuilt = [
+            Tensor._wrap(next(it)) if kind == "t" else const
+            for kind, const in template
+        ]
+        try:
+            for p, r in zip(params, param_raws):
+                p._data = r
+            with AG.trace_mode():
+                out = fn(*rebuilt, **kwargs)
+        finally:
+            for p, r in zip(params, saved):
+                p._data = r
+        if isinstance(out, Tensor):
+            return out._data
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out
+
+    ck_fn = jax.checkpoint(raw_fn)
+    return AG.apply(ck_fn, tensor_args + params, name="recompute")

@@ -1,0 +1,158 @@
+"""jit.save / jit.load — whole-model artifact persistence.
+
+reference: python/paddle/fluid/dygraph/jit.py (save :507, load :787,
+TracedLayer :1047): saves a pruned inference program (`__model__`) plus
+params, loadable from Python or C++.
+
+TPU-native artifact: serialized StableHLO via jax.export (the portable
+compiled-program format for XLA — the `__model__` ProgramDesc analog) plus
+a params .npz. `jit.load` returns a TranslatedLayer that executes the
+StableHLO artifact without the original Python source.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer import Layer
+from .program import InputSpec, StaticFunction, _CompiledProgram, _collect_layers
+
+MODEL_SUFFIX = ".pdmodel"
+PARAMS_SUFFIX = ".pdiparams"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save(layer, path, input_spec=[InputSpec(...)]).
+
+    Captures the layer's forward in eval... no — in its CURRENT mode, like
+    the reference (save for inference: callers switch to eval() first).
+    """
+    if isinstance(layer, StaticFunction):
+        fn = layer._fn
+        layers = _collect_layers(layer._layer, fn)
+        owner = layer._layer
+    elif isinstance(layer, Layer):
+        fn = layer.forward
+        fn = fn._fn if isinstance(fn, StaticFunction) else fn
+        layers = [layer]
+        owner = layer
+    else:
+        raise TypeError("jit.save expects a Layer or a to_static function")
+
+    if input_spec is None:
+        raise ValueError(
+            "jit.save requires input_spec=[InputSpec(shape, dtype), ...] "
+            "(shapes must be concrete for the exported XLA program)"
+        )
+    specs: List[InputSpec] = [
+        s if isinstance(s, InputSpec) else InputSpec(s.shape, str(s.dtype))
+        for s in input_spec
+    ]
+    from ..core.dtype import convert_dtype
+
+    example_raws = tuple(
+        jnp.zeros(tuple(int(d) if d is not None else 1 for d in s.shape),
+                  convert_dtype(s.dtype))
+        for s in specs
+    )
+
+    prog = _CompiledProgram(
+        fn, layers, len(example_raws), {},
+        tuple(("tensor", None) for _ in example_raws),
+    )
+    param_raws = tuple(p._data for p in prog.params)
+    buffer_raws = tuple(b._data for b in prog.buffers)
+    fixed_key = jax.random.PRNGKey(0)
+
+    def infer_fn(params, buffers, inputs):
+        outs, _ = prog._jitted(params, buffers, fixed_key, inputs)
+        return outs
+
+    jitted = jax.jit(infer_fn)
+    exported = jax.export.export(jitted)(param_raws, buffer_raws, example_raws)
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + MODEL_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+    state = {}
+    for i, p in enumerate(prog.params):
+        state[f"param_{i}"] = np.asarray(p._data)
+    for i, b in enumerate(prog.buffers):
+        state[f"buffer_{i}"] = np.asarray(b._data)
+    with open(path + PARAMS_SUFFIX, "wb") as f:
+        np.savez(f, **state)  # file handle: savez must not append ".npz"
+    meta = {
+        "n_params": len(prog.params),
+        "n_buffers": len(prog.buffers),
+        "input_specs": [[list(s.shape), str(s.dtype)] for s in specs],
+        "out_treedef": pickle.dumps(prog.out_treedef).hex(),
+    }
+    with open(path + ".pdmeta", "w") as f:
+        json.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """Executable loaded artifact (reference: fluid/dygraph/io.py
+    TranslatedLayer). Runs the deserialized StableHLO program."""
+
+    def __init__(self, exported, params, buffers, out_treedef):
+        super().__init__()
+        self._exported = exported
+        self._param_raws = tuple(jnp.asarray(p) for p in params)
+        self._buffer_raws = tuple(jnp.asarray(b) for b in buffers)
+        self._out_treedef = out_treedef
+        for i, p in enumerate(self._param_raws):
+            self.add_parameter(f"param_{i}", Parameter(np.asarray(p)))
+
+    def forward(self, *inputs):
+        raws = tuple(
+            x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in inputs
+        )
+        param_raws = tuple(p._data for p in self.parameters())
+
+        def raw_fn(*arg_raws):
+            n_in = len(raws)
+            in_r = arg_raws[:n_in]
+            p_r = arg_raws[n_in:]
+            return tuple(
+                self._exported.call(tuple(p_r), self._buffer_raws, tuple(in_r))
+            )
+
+        all_inputs = [
+            x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+            for x in inputs
+        ] + list(self.parameters())
+        outs = AG.apply(raw_fn, all_inputs, name="translated_layer")
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        from .program import _unflatten_out
+
+        out = _unflatten_out(list(outs), self._out_treedef)
+        if isinstance(out, (list, tuple)) and len(out) == 1:
+            return out[0]
+        return out
+
+
+def load(path, **configs) -> TranslatedLayer:
+    """paddle.jit.load(path) -> TranslatedLayer."""
+    with open(path + MODEL_SUFFIX, "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    data = np.load(path + PARAMS_SUFFIX)
+    with open(path + ".pdmeta") as f:
+        meta = json.load(f)
+    params = [data[f"param_{i}"] for i in range(meta["n_params"])]
+    buffers = [data[f"buffer_{i}"] for i in range(meta["n_buffers"])]
+    out_treedef = pickle.loads(bytes.fromhex(meta["out_treedef"]))
+    return TranslatedLayer(exported, params, buffers, out_treedef)

@@ -1,0 +1,188 @@
+"""to_static / jit path — analog of reference dygraph_to_static tests
+(test_declarative.py, test_partial_program.py, test_save_load.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit, nn, optimizer
+
+
+class SimpleNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_to_static_matches_eager():
+    paddle.seed(0)
+    net = SimpleNet()
+    x = paddle.randn([3, 4])
+    eager_out = net(x).numpy()
+    static_net = jit.to_static(net)
+    np.testing.assert_allclose(static_net(x).numpy(), eager_out, rtol=1e-5)
+
+
+def test_to_static_backward_grads_match():
+    paddle.seed(0)
+    net1 = SimpleNet()
+    net2 = SimpleNet()
+    net2.set_state_dict(net1.state_dict())
+    x = paddle.randn([3, 4])
+
+    loss1 = paddle.mean(net1(x))
+    loss1.backward()
+
+    snet = jit.to_static(net2)
+    loss2 = paddle.mean(snet(x))
+    loss2.backward()
+
+    np.testing.assert_allclose(loss1.item(), loss2.item(), rtol=1e-5)
+    np.testing.assert_allclose(
+        net1.fc1.weight.gradient(), net2.fc1.weight.gradient(), rtol=1e-4
+    )
+
+
+def test_to_static_training_converges():
+    paddle.seed(1)
+    net = jit.to_static(SimpleNet())
+    params = net.parameters()
+    opt = optimizer.Adam(learning_rate=0.05, parameters=params)
+    x = paddle.randn([16, 4])
+    y = paddle.randint(0, 2, [16])
+    losses = []
+    for _ in range(30):
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(loss.item())
+    assert losses[-1] < losses[0]
+
+
+def test_program_cache_per_shape():
+    net = SimpleNet()
+    sf = jit.to_static(net)
+    sf(paddle.randn([2, 4]))
+    sf(paddle.randn([2, 4]))
+    assert len(sf.forward.program_cache) == 1
+    sf(paddle.randn([5, 4]))
+    assert len(sf.forward.program_cache) == 2
+
+
+def test_cache_invalidated_by_train_eval():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    sf = jit.StaticFunction(net.forward, layer=net)
+    net.train()
+    sf(paddle.randn([2, 4]))
+    net.eval()
+    out1 = sf(paddle.randn([2, 4]))
+    assert len(sf.program_cache) == 2
+    # eval is deterministic even with dropout in the program
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(sf(x).numpy(), sf(x).numpy())
+
+
+def test_static_function_decorator_on_method():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        @jit.to_static
+        def forward(self, x):
+            return self.fc(x) * 2.0
+
+    net = Net()
+    x = paddle.randn([2, 4])
+    out = net(x)
+    np.testing.assert_allclose(
+        out.numpy(), (net.fc(x) * 2.0).numpy(), rtol=1e-5
+    )
+    paddle.mean(out).backward()
+    assert net.fc.weight.grad is not None
+
+
+def test_batchnorm_buffers_update_under_jit():
+    bn = nn.BatchNorm1D(4)
+    bn.train()
+    sf = jit.StaticFunction(bn.forward, layer=bn)
+    before = bn._mean.numpy().copy()
+    x = paddle.randn([8, 4, 5]) + 3.0
+    sf(x)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+
+
+def test_dropout_rng_varies_under_jit():
+    net = nn.Dropout(0.5)
+    net.train()
+    sf = jit.StaticFunction(net.forward, layer=net)
+    x = paddle.ones([32, 32])
+    a = sf(x).numpy()
+    b = sf(x).numpy()
+    assert not np.allclose(a, b)  # fresh key per call, same compiled program
+    assert len(sf.program_cache) == 1
+
+
+def test_jit_cond_and_while():
+    def f(x):
+        return jit.cond(
+            paddle.sum(x) > 0,
+            lambda a: a * 2.0,
+            lambda a: a - 1.0,
+            x,
+        )
+
+    x = paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose(f(x).numpy(), [2, 4])
+    sf = jit.to_static(f)
+    np.testing.assert_allclose(sf(x).numpy(), [2, 4])
+    np.testing.assert_allclose(
+        sf(paddle.to_tensor([-5.0, 1.0])).numpy(), [-6, 0]
+    )
+
+    def loop(n):
+        i = paddle.to_tensor(0)
+        s = paddle.to_tensor(0)
+        i, s = jit.while_loop(
+            lambda i, s: i < n, lambda i, s: (i + 1, s + i), [i, s]
+        )
+        return s
+
+    assert loop(paddle.to_tensor(5)).item() == 10
+    s_loop = jit.to_static(loop)
+    assert s_loop(paddle.to_tensor(5)).item() == 10
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    import os
+
+    net = SimpleNet()
+    net.eval()
+    x = paddle.randn([2, 4])
+    want = net(x).numpy()
+    path = os.path.join(tmp_path, "model")
+    jit.save(net, path, input_spec=[jit.InputSpec([2, 4], "float32")])
+
+    loaded = jit.load(path)
+    got = loaded(x).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_recompute_grads_match():
+    paddle.seed(0)
+    net1 = SimpleNet()
+    net2 = SimpleNet()
+    net2.set_state_dict(net1.state_dict())
+    x = paddle.randn([4, 4])
+
+    paddle.mean(net1(x)).backward()
+    paddle.mean(jit.recompute(net2, x)).backward()
+    np.testing.assert_allclose(
+        net1.fc1.weight.gradient(), net2.fc1.weight.gradient(), rtol=1e-4
+    )
