@@ -1,0 +1,333 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py:101–457 (all_reduce /
+all_gather / reduce / broadcast / scatter / barrier over ring_id'd NCCL
+comms; kernels in paddle/fluid/operators/collective/, e.g.
+c_allreduce_op.h:123–158 → ncclAllReduce).
+
+TPU-native: each collective is an XLA op over a named mesh axis. Two modes,
+one API:
+  * eager — operands follow the per-rank convention (leading axis = rank,
+    sharded over the group axis; comm.shard_rank_axis). The call jits a
+    shard_map once per (shape, dtype, op, group) — the analog of cached
+    per-comm NCCL launches — and swaps the tensor's storage in place.
+  * spmd  — inside a shard_map region (comm.spmd_region), operands are the
+    per-rank values themselves and the call lowers directly to
+    lax.psum/all_gather/ppermute; XLA fuses and schedules the collective
+    with the surrounding computation (the `use_calc_stream` semantics are
+    the default — there are no separate comm streams to sync).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import comm
+from .comm import Group
+
+
+class ReduceOp:
+    """reference: collective.py ReduceOp (SUM/MAX/MIN/PROD + AVG)."""
+
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _group(group) -> Group:
+    if group is None:
+        return comm._default_group()
+    if isinstance(group, int):
+        g = comm.get_group(group)
+        if g is None:
+            raise ValueError(f"no group with id {group}")
+        return g
+    return group
+
+
+def _raw(tensor):
+    return tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+
+
+def _psum_like(x, axis: str, op: int):
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, axis)
+    if op == ReduceOp.AVG:
+        return jax.lax.pmean(x, axis)
+    if op == ReduceOp.PROD:
+        g = jax.lax.all_gather(x, axis)
+        return jnp.prod(g, axis=0)
+    raise ValueError(f"unknown ReduceOp {op}")
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_prog(gid: int, op: int):
+    g = comm.get_group(gid)
+    ax = g.axis_name
+    return jax.jit(comm.shard_map(
+        lambda x: _psum_like(x, ax, op),
+        g.mesh, in_specs=P(ax), out_specs=P(ax),
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_prog(gid: int, op: int, dst: int):
+    g = comm.get_group(gid)
+    ax = g.axis_name
+
+    def f(x):
+        r = _psum_like(x, ax, op)
+        i = jax.lax.axis_index(ax)
+        return jnp.where(i == dst, r, x)
+
+    return jax.jit(comm.shard_map(f, g.mesh, in_specs=P(ax),
+                                  out_specs=P(ax)))
+
+
+@functools.lru_cache(maxsize=None)
+def _allgather_prog(gid: int):
+    g = comm.get_group(gid)
+    ax = g.axis_name
+    # per-rank slice [1, ...] -> every rank holds the full stack
+    return jax.jit(comm.shard_map(
+        lambda x: jax.lax.all_gather(x, ax, axis=0, tiled=True),
+        g.mesh, in_specs=P(ax), out_specs=P(),
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _broadcast_prog(gid: int, src: int):
+    g = comm.get_group(gid)
+    ax = g.axis_name
+
+    def f(x):
+        full = jax.lax.all_gather(x, ax, axis=0, tiled=True)
+        return jax.lax.dynamic_slice_in_dim(full, src, 1, 0)
+
+    return jax.jit(comm.shard_map(f, g.mesh, in_specs=P(ax),
+                                  out_specs=P(ax)))
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_scatter_prog(gid: int, op: int):
+    g = comm.get_group(gid)
+    ax = g.axis_name
+    if op == ReduceOp.SUM:
+        fn = lambda x: jax.lax.psum_scatter(  # noqa: E731
+            x, ax, scatter_dimension=1, tiled=True
+        )
+    else:
+        def fn(x):
+            r = _psum_like(x, ax, op)  # [1, nranks*chunk]
+            i = jax.lax.axis_index(ax)
+            chunk = r.shape[1] // g.nranks
+            return jax.lax.dynamic_slice_in_dim(r, i * chunk, chunk, 1)
+    return jax.jit(comm.shard_map(fn, g.mesh, in_specs=P(ax),
+                                  out_specs=P(ax)))
+
+
+@functools.lru_cache(maxsize=None)
+def _alltoall_prog(gid: int):
+    g = comm.get_group(gid)
+    ax = g.axis_name
+    # local [1, nranks, ...] -> receives [1, nranks, ...] of everyone's slice
+    return jax.jit(comm.shard_map(
+        lambda x: jax.lax.all_to_all(x, ax, split_axis=1, concat_axis=0,
+                                     tiled=False),
+        g.mesh, in_specs=P(ax), out_specs=P(ax),
+    ))
+
+
+# ---------------------------------------------------------------------------
+# Public API (paddle.distributed.*)
+# ---------------------------------------------------------------------------
+
+
+def all_reduce(tensor, op: int = ReduceOp.SUM, group=None,
+               sync_op: bool = True, use_calc_stream: bool = True):
+    """collective.py:101 all_reduce. In-place; every rank sees the result."""
+    g = _group(group)
+    if comm.in_spmd_region(g.axis_name):
+        from ..core import autograd as AG
+
+        return AG.apply(
+            lambda x: _psum_like(x, g.axis_name, op), (_as_t(tensor),),
+            name="c_allreduce",
+        )
+    t = _as_t(tensor)
+    t._data = _allreduce_prog(g.id, op)(_ranked(t, g))
+    t._node = None
+    return t
+
+
+def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM, group=None,
+           sync_op: bool = True, use_calc_stream: bool = True):
+    """collective.py reduce: only dst's slice carries the result."""
+    g = _group(group)
+    if comm.in_spmd_region(g.axis_name):
+        from ..core import autograd as AG
+
+        def f(x):
+            r = _psum_like(x, g.axis_name, op)
+            i = jax.lax.axis_index(g.axis_name)
+            return jnp.where(i == dst, r, x)
+
+        return AG.apply(f, (_as_t(tensor),), name="c_reduce")
+    t = _as_t(tensor)
+    t._data = _reduce_prog(g.id, op, dst)(_ranked(t, g))
+    t._node = None
+    return t
+
+
+def all_gather(tensor_list: Optional[List], tensor=None, group=None,
+               sync_op: bool = True, use_calc_stream: bool = True):
+    """collective.py all_gather. Eager: per-rank stack in, list of nranks
+    tensors out (appended to tensor_list). spmd: returns gathered array."""
+    g = _group(group)
+    if tensor is None:  # all_gather(x) shorthand
+        tensor, tensor_list = tensor_list, None
+    if comm.in_spmd_region(g.axis_name):
+        from ..core import autograd as AG
+
+        out = AG.apply(
+            lambda x: jax.lax.all_gather(x, g.axis_name, axis=0, tiled=False),
+            (_as_t(tensor),), name="c_allgather",
+        )
+        if tensor_list is not None:
+            tensor_list.extend(out[i] for i in range(g.nranks))
+        return out
+    t = _as_t(tensor)
+    full = _allgather_prog(g.id)(_ranked(t, g))
+    parts = [
+        Tensor._wrap(jax.lax.index_in_dim(full, i, 0, keepdims=False))
+        for i in range(g.nranks)
+    ]
+    if tensor_list is not None:
+        tensor_list.extend(parts)
+    return parts
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True,
+              use_calc_stream: bool = True):
+    """collective.py broadcast: every rank gets src's value."""
+    g = _group(group)
+    if comm.in_spmd_region(g.axis_name):
+        from ..core import autograd as AG
+
+        def f(x):
+            full = jax.lax.all_gather(x, g.axis_name, axis=0, tiled=False)
+            return full[src]
+
+        return AG.apply(f, (_as_t(tensor),), name="c_broadcast")
+    t = _as_t(tensor)
+    t._data = _broadcast_prog(g.id, src)(_ranked(t, g))
+    t._node = None
+    return t
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op: int = ReduceOp.SUM,
+                   group=None, sync_op: bool = True):
+    """Each rank receives its chunk of the reduction. Eager convention:
+    input [nranks, nranks*chunk] per-rank-stacked; output [nranks, chunk]."""
+    g = _group(group)
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if comm.in_spmd_region(g.axis_name):
+        from ..core import autograd as AG
+
+        return AG.apply(
+            lambda x: jax.lax.psum_scatter(
+                x, g.axis_name, scatter_dimension=0, tiled=True
+            ) if op == ReduceOp.SUM else _psum_like(
+                x, g.axis_name, op
+            ).reshape(g.nranks, -1)[jax.lax.axis_index(g.axis_name)],
+            (_as_t(src),), name="c_reducescatter",
+        )
+    t = _as_t(src)
+    out_raw = _reduce_scatter_prog(g.id, op)(_ranked(t, g))
+    out = Tensor._wrap(out_raw)
+    if isinstance(tensor, Tensor) and tensor is not src:
+        tensor._data = out_raw
+        tensor._node = None
+        return tensor
+    t._data = out_raw
+    t._node = None
+    return t
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None,
+            sync_op: bool = True, use_calc_stream: bool = True):
+    """collective.py scatter: rank r receives the r-th chunk held at src.
+    Single-controller eager: the stacked [nranks, ...] layout already places
+    chunk r on device r, so this is a (sharded) identity + provenance note."""
+    g = _group(group)
+    if tensor_list is not None:
+        stacked = jnp.stack([_raw(t) for t in tensor_list], axis=0)
+    else:
+        stacked = _raw(tensor)
+    t = _as_t(tensor)
+    t._data = comm.shard_rank_axis(stacked, g)
+    t._node = None
+    return t
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             sync_op: bool = True):
+    """Each rank scatters its list and gathers one item from every rank."""
+    g = _group(group)
+    if comm.in_spmd_region(g.axis_name):
+        from ..core import autograd as AG
+
+        return AG.apply(
+            lambda x: jax.lax.all_to_all(x, g.axis_name, split_axis=0,
+                                         concat_axis=0, tiled=True),
+            (_as_t(in_tensor_list),), name="c_alltoall",
+        )
+    if isinstance(in_tensor_list, (list, tuple)):
+        # [nranks][nranks, ...] per-rank stacks
+        stacked = jnp.stack([_raw(t) for t in in_tensor_list], axis=1)
+    else:
+        t = _as_t(in_tensor_list)
+        stacked = t._data.reshape(
+            (g.nranks, g.nranks) + tuple(t._data.shape[1:])[1:]
+        )
+    out = _alltoall_prog(g.id)(comm.shard_rank_axis(stacked, g))
+    # out[r, s] = input rank s's item for rank r
+    parts = [Tensor._wrap(out[:, s]) for s in range(g.nranks)]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(parts)
+    return parts
+
+
+def barrier(group=None):
+    """collective ops barrier (operators/collective/barrier_op)."""
+    g = _group(group)
+    x = comm.shard_rank_axis(jnp.zeros((g.nranks, 1), jnp.int32), g)
+    jax.block_until_ready(_allreduce_prog(g.id, ReduceOp.SUM)(x))
+
+
+def _as_t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _ranked(t: Tensor, g: Group):
+    raw = t._data
+    if raw.ndim == 0 or raw.shape[0] != g.nranks:
+        raise ValueError(
+            f"eager collective over group of {g.nranks} ranks expects the "
+            f"per-rank convention: leading axis of length {g.nranks} "
+            f"(got shape {tuple(raw.shape)}). Stack per-rank values with "
+            "paddle_tpu.distributed.shard_rank_axis, or call inside an "
+            "spmd region."
+        )
+    return comm.shard_rank_axis(raw, g)

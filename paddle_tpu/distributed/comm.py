@@ -1,0 +1,229 @@
+"""Communication core: device mesh, groups, and the collective engine.
+
+Reference analog (SURVEY.md §2.9 / §5 backend table):
+  - ring_id-keyed NCCL communicators (platform/collective_helper.h:52,72;
+    gen_comm_id_helper.cc TCP bootstrap) ≙ named axes of a
+    `jax.sharding.Mesh` over ICI — a Group here IS a mesh axis; there are no
+    streams or comm-id exchanges because XLA compiles collectives into the
+    program and the PJRT runtime owns topology discovery.
+  - multi-host bootstrap (`init_parallel_env`, distributed/parallel.py:57 +
+    c_gen_nccl_id/c_comm_init ops) ≙ `jax.distributed.initialize`
+    (coordinator service) + the global device list.
+
+Single-controller SPMD model: one Python process drives all devices. A
+"per-rank value" is a global array whose leading axis is the rank axis,
+sharded over the group's mesh axis (`shard_rank_axis`). Collectives are
+shard_map'd XLA ops jitted once per (shape, dtype, op); inside an spmd
+region (shard_map trace entered via this module) they lower directly to
+`lax.psum`/`all_gather`/`ppermute` on the axis name.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
+
+
+class Group:
+    """A communicator: a set of devices bound to one mesh axis.
+
+    The ring_id/NCCLComm analog (collective_helper.h:52) — but declarative:
+    holding a Group means collectives over its axis name compile to ICI
+    collectives among exactly these devices.
+    """
+
+    _counter = 0
+
+    def __init__(self, devices: Sequence, axis_name: Optional[str] = None,
+                 gid: Optional[int] = None, ranks: Optional[List[int]] = None):
+        self.devices = list(devices)
+        self.nranks = len(self.devices)
+        self.id = Group._counter if gid is None else gid
+        Group._counter += 1
+        self.axis_name = axis_name or f"g{self.id}"
+        self.ranks = list(ranks) if ranks is not None else list(
+            range(self.nranks)
+        )
+        self.mesh = Mesh(
+            np.array(self.devices).reshape(self.nranks), (self.axis_name,)
+        )
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis='{self.axis_name}')")
+
+
+class _CommState(threading.local):
+    def __init__(self):
+        self.default_group: Optional[Group] = None
+        self.groups: Dict[int, Group] = {}
+        self.spmd_axes: Tuple[str, ...] = ()  # inside shard_map regions
+
+
+_state = _CommState()
+
+
+def _ensure_init() -> Group:
+    if _state.default_group is None:
+        init_parallel_env()
+    return _state.default_group
+
+
+def init_parallel_env(backend: Optional[str] = None) -> "ParallelEnv":
+    """Bootstrap distributed state (reference: parallel.py:57
+    init_parallel_env → NCCLParallelContext::Init + TCP comm-id exchange).
+
+    TPU-native: multi-host rendezvous is jax.distributed (coordinator env:
+    COORDINATOR_ADDRESS / PADDLE_TRAINER_ENDPOINTS honored); the default
+    group spans every device in the job over axis 'dp'.
+    """
+    import os
+
+    if (int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1
+            and os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+            and jax.process_count() == 1):
+        # Multi-host launch: endpoints list ≙ coordinator bootstrap
+        # (gen_comm_id_helper.cc:284 SendBroadCastCommID analog).
+        try:
+            coordinator = os.environ[
+                "PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            )
+        except Exception:  # already initialized or single-host fallback
+            pass
+    if _state.default_group is None:
+        devs = jax.devices()
+        _state.default_group = Group(devs, axis_name="dp", gid=0)
+        _state.groups[0] = _state.default_group
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _state.default_group is not None
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    return _state.groups.get(gid)
+
+
+def _default_group() -> Group:
+    return _ensure_init()
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
+              axis_name: Optional[str] = None) -> Group:
+    """Create a communicator over a device subset (collective.py new_group)."""
+    world = _ensure_init()
+    if ranks is None:
+        ranks = list(range(world.nranks))
+    devs = [world.devices[r] for r in ranks]
+    g = Group(devs, axis_name=axis_name, ranks=ranks)
+    _state.groups[g.id] = g
+    return g
+
+
+class ParallelEnv:
+    """Env facade (reference: fluid/dygraph/parallel.py ParallelEnv)."""
+
+    @property
+    def rank(self) -> int:
+        import os
+
+        if "PADDLE_TRAINER_ID" in os.environ:
+            return int(os.environ["PADDLE_TRAINER_ID"])
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        g = _state.default_group
+        return g.nranks if g is not None else len(jax.devices())
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank
+
+    @property
+    def dev_id(self) -> int:
+        return self.rank
+
+    @property
+    def device_id(self) -> int:
+        return self.rank
+
+
+# ---------------------------------------------------------------------------
+# spmd region tracking: inside a shard_map'd program, collectives lower to
+# bare lax ops on the axis name instead of launching their own shard_map.
+# ---------------------------------------------------------------------------
+
+
+class _SpmdRegion:
+    def __init__(self, axes: Tuple[str, ...]):
+        self.axes = axes
+
+    def __enter__(self):
+        self._prev = _state.spmd_axes
+        _state.spmd_axes = self._prev + self.axes
+        return self
+
+    def __exit__(self, *exc):
+        _state.spmd_axes = self._prev
+
+
+def spmd_region(*axes: str) -> _SpmdRegion:
+    """Mark that code runs inside a shard_map over `axes` (used by
+    DataParallel/pipeline/ring-attention internals and user rank programs)."""
+    return _SpmdRegion(tuple(axes))
+
+
+def in_spmd_region(axis_name: Optional[str] = None) -> bool:
+    if axis_name is None:
+        return bool(_state.spmd_axes)
+    return axis_name in _state.spmd_axes
+
+
+# ---------------------------------------------------------------------------
+# Sharding helpers
+# ---------------------------------------------------------------------------
+
+
+def shard_rank_axis(raw, group: Optional[Group] = None):
+    """Lay a [nranks, ...] array out with one leading-axis slice per device
+    of the group — the canonical 'per-rank value' layout."""
+    g = group or _ensure_init()
+    return jax.device_put(raw, NamedSharding(g.mesh, P(g.axis_name)))
+
+
+def replicate(raw, group: Optional[Group] = None):
+    g = group or _ensure_init()
+    return jax.device_put(raw, NamedSharding(g.mesh, P()))

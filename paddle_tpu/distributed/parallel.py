@@ -1,0 +1,90 @@
+"""Data-parallel training.
+
+Reference: python/paddle/fluid/dygraph/parallel.py:322 (`DataParallel`) +
+the C++ Reducer (paddle/fluid/imperative/reducer.cc:374–718): bucketed
+grad-allreduce hooks over NCCL rings.
+
+TPU-native design: there is no reducer. Parameters are laid out replicated
+over the mesh and the batch is sharded on the 'dp' axis, so the loss is the
+global loss and XLA's sharding propagation inserts (and fuses/buckets — the
+all-reduce combiner subsumes `last_comm_group_size_MB`) the gradient
+all-reduce wherever the program needs it: per-op in eager mode, one fused
+program in the jit/TrainStep path. N-device training is numerically the
+single-device program on the global batch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from . import comm
+
+
+class DataParallel(Layer):
+    """Wrap a Layer for data-parallel training (parallel.py:322 parity).
+
+    Usage matches the reference::
+
+        dist.init_parallel_env()
+        model = paddle.DataParallel(model)
+        out = model(dp_model.shard_input(x))   # or any dp-sharded batch
+
+    `scale_loss` / `no_sync` are kept for script parity: loss scaling is
+    identity (the global mean already divides by the global batch) and
+    no_sync is a no-op marker (grad comm is part of the compiled program,
+    deferred accumulation comes from the gradient-merge strategy instead).
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size_MB=25,
+                 last_comm_buffer_size_MB=1, find_unused_parameters=False,
+                 group: Optional[comm.Group] = None):
+        super().__init__()
+        self._layers = layers
+        self.group = group or comm._default_group()
+        self.replicate_state()
+
+    def replicate_state(self):
+        """Lay every param/buffer out replicated over the group mesh — the
+        broadcast-from-rank-0 step of reference init (parallel.py
+        sync_params_buffers)."""
+        sharding = NamedSharding(self.group.mesh, P())
+        for p in self._layers.parameters():
+            p._data = jax.device_put(p._data, sharding)
+        for b in self._layers.buffers():
+            b._data = jax.device_put(b._data, sharding)
+
+    def shard_input(self, x):
+        """Shard a global batch on the dp axis (leading dim)."""
+        raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        sharded = jax.device_put(
+            raw, NamedSharding(self.group.mesh, P(self.group.axis_name))
+        )
+        return Tensor._wrap(sharded, stop_gradient=True)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    # state passthrough: checkpoints are of the wrapped model
+    def state_dict(self, destination=None, include_sublayers=True, prefix=""):
+        return self._layers.state_dict(destination, include_sublayers, prefix)
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        out = self._layers.set_state_dict(state_dict, use_structured_name)
+        self.replicate_state()
+        return out
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict

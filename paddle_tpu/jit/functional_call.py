@@ -1,0 +1,131 @@
+"""The Layer -> pure-function bridge.
+
+Reference analog: PartialProgramLayer's parameter lifting + the run_program
+op boundary (python/paddle/fluid/dygraph/dygraph_to_static/partial_program.py:206,
+paddle/fluid/operators/run_program_op.cc): a stateful Layer becomes a pure
+program of (params, buffers, inputs) -> (outputs, new_buffers), which is the
+form every jitted/pjitted/distributed path consumes.
+
+TPU-first: the returned function is traceable by jax.jit / jax.grad /
+shard_map; parameters travel as an explicit pytree so sharding specs,
+donation, and optimizer-state fusion all apply to them directly.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core import random as rnd
+from ..core.tensor import Tensor
+
+
+def named_state(layer) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """(params, buffers): name -> Parameter/Tensor in stable traversal order."""
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+    return params, buffers
+
+
+def raw_state(layer) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """Like named_state but with raw jax arrays as values (a jit-ready pytree)."""
+    params, buffers = named_state(layer)
+    return (
+        {k: p._data for k, p in params.items()},
+        {k: b._data for k, b in buffers.items()},
+    )
+
+
+@contextlib.contextmanager
+def _swapped(tensors: Sequence[Tensor], raws: Sequence):
+    """Temporarily substitute each tensor's storage with the given raw value."""
+    saved = [t._data for t in tensors]
+    try:
+        for t, r in zip(tensors, raws):
+            t._data = r
+        yield
+    finally:
+        for t, r in zip(tensors, saved):
+            t._data = r
+
+
+@contextlib.contextmanager
+def _trace_rng(key):
+    """Route stateful RNG draws inside the trace to folds of `key`."""
+    if key is None:
+        yield
+        return
+    counter = [0]
+
+    def provider():
+        counter[0] += 1
+        return jax.random.fold_in(key, counter[0])
+
+    prev = rnd.set_trace_key_provider(provider)
+    try:
+        yield
+    finally:
+        rnd.set_trace_key_provider(prev)
+
+
+def _wrap_in(x):
+    if isinstance(x, Tensor):
+        return x
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return Tensor._wrap(jnp.asarray(x))
+    return x
+
+
+def _unwrap_out(o):
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, Tensor) else v,
+        o,
+        is_leaf=lambda v: isinstance(v, Tensor),
+    )
+
+
+def functional_call(
+    layer,
+    params: Dict[str, Any],
+    buffers: Optional[Dict[str, Any]] = None,
+    args: Sequence = (),
+    kwargs: Optional[Dict] = None,
+    *,
+    key=None,
+):
+    """Run `layer` purely: explicit state in, raw outputs + new buffers out.
+
+    params / buffers map state names (as in layer.state_dict traversal) to
+    raw jax arrays or Tensors. Missing buffer entries default to the layer's
+    current values. Returns (out, new_buffers) where `out` mirrors the
+    layer's return structure with Tensors replaced by raw arrays and
+    new_buffers carries post-call buffer values (batch-norm running stats
+    etc.). Pass `key` to make in-program RNG (dropout) a pure function of it.
+    """
+    kwargs = kwargs or {}
+    p_named, b_named = named_state(layer)
+    objs, raws = [], []
+    for name, p in p_named.items():
+        if name not in params:
+            raise KeyError(f"functional_call: missing parameter '{name}'")
+        v = params[name]
+        objs.append(p)
+        raws.append(v._data if isinstance(v, Tensor) else v)
+    b_objs = list(b_named.values())
+    for name, b in b_named.items():
+        if buffers is not None and name in buffers:
+            v = buffers[name]
+            raws.append(v._data if isinstance(v, Tensor) else v)
+        else:
+            raws.append(b._data)
+    objs.extend(b_objs)
+
+    with AG.trace_mode(), _trace_rng(key), _swapped(objs, raws):
+        call_args = [_wrap_in(a) for a in args]
+        out = layer(*call_args, **kwargs)
+        out_raw = _unwrap_out(out)
+        new_buffers = {name: b._data for name, b in b_named.items()}
+    return out_raw, new_buffers

@@ -51,27 +51,58 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
 
 
-def _collect_layers(obj, fn) -> List[Layer]:
-    """Find Layers whose params/buffers must be lifted to program inputs."""
-    layers = []
+def _collect_layers(obj, fn, explicit=None) -> List[Layer]:
+    """Find Layers whose params/buffers must be lifted to program inputs.
+
+    Preferred: pass them explicitly (`to_static(fn, layers=[...])`). The
+    implicit fallback scans the function's closure cells and globals,
+    recursing two levels into dict/list/tuple containers and object
+    __dict__s so Layers held in collections are still found (fixes the
+    silent params-as-constants failure mode of a one-level scan)."""
+    layers: List[Layer] = []
+    seen = set()
+
+    def add(l):
+        if id(l) not in seen:
+            seen.add(id(l))
+            layers.append(l)
+
+    for l in explicit or ():
+        add(l)
     if isinstance(obj, Layer):
-        layers.append(obj)
-    # plain function: scan closure + globals one level for Layers
+        add(obj)
     if fn is not None and not isinstance(obj, Layer):
-        seen = set()
-        candidates = []
+        def scan(v, depth):
+            if isinstance(v, Layer):
+                add(v)
+                return
+            if depth <= 0:
+                return
+            if isinstance(v, dict):
+                for x in v.values():
+                    scan(x, depth - 1)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    scan(x, depth - 1)
+
         if getattr(fn, "__closure__", None):
-            candidates.extend(
-                c.cell_contents
-                for c in fn.__closure__
-                if c.cell_contents is not None
-            )
+            for c in fn.__closure__:
+                try:
+                    v = c.cell_contents
+                except ValueError:
+                    continue
+                if v is not None:
+                    scan(v, 2)
+                    if not isinstance(v, Layer) and hasattr(v, "__dict__"):
+                        scan(vars(v), 1)
+        bound_self = getattr(fn, "__self__", None)
+        if bound_self is not None:
+            scan(bound_self, 1)
+            if not isinstance(bound_self, Layer) and hasattr(
+                    bound_self, "__dict__"):
+                scan(vars(bound_self), 2)
         for v in list(getattr(fn, "__globals__", {}).values()):
-            candidates.append(v)
-        for v in candidates:
-            if isinstance(v, Layer) and id(v) not in seen:
-                seen.add(id(v))
-                layers.append(v)
+            scan(v, 2)
     return layers
 
 
@@ -211,10 +242,12 @@ class StaticFunction:
     """to_static wrapper (program_translator.py:233 StaticFunction)."""
 
     def __init__(self, fn, layer: Optional[Layer] = None, input_spec=None,
-                 build_strategy=None):
+                 build_strategy=None, layers=None):
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
+        self._explicit_layers = list(layers) if layers else None
+        self._layers_found: Optional[List[Layer]] = None
         self._cache: Dict[Tuple, _CompiledProgram] = {}
         self._lock = threading.Lock()
         self.__name__ = getattr(fn, "__name__", "static_fn")
@@ -225,7 +258,7 @@ class StaticFunction:
             return self
         bound = StaticFunction(
             self._fn.__get__(instance, owner), layer=instance,
-            input_spec=self._input_spec,
+            input_spec=self._input_spec, layers=self._explicit_layers,
         )
         # cache the bound wrapper on the instance
         object.__setattr__(instance, self.__name__, bound)
@@ -258,11 +291,21 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         tensor_args, template, kw = self._split_args(args, kwargs)
-        layers = _collect_layers(self._layer, self._fn)
+        # the closure/global scan is O(globals); cache it and refresh only
+        # when a new program is about to be compiled (cache miss)
+        layers = self._layers_found
+        if layers is None:
+            layers = self._layers_found = _collect_layers(
+                self._layer, self._fn, self._explicit_layers
+            )
         key = self._cache_key(tensor_args, template, kw, layers)
         prog = self._cache.get(key)
         if prog is None:
             with self._lock:
+                layers = self._layers_found = _collect_layers(
+                    self._layer, self._fn, self._explicit_layers
+                )
+                key = self._cache_key(tensor_args, template, kw, layers)
                 prog = self._cache.get(key)
                 if prog is None:
                     prog = _CompiledProgram(
@@ -290,17 +333,19 @@ def _hashable(v):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              property_=False):
+              property_=False, layers=None):
     """paddle.jit.to_static (reference: fluid/dygraph/jit.py:160
-    declarative). Works on Layer instances, methods, and functions."""
+    declarative). Works on Layer instances, methods, and functions.
+    `layers` explicitly lists Layers whose state the program captures
+    (recommended for functions holding Layers in containers)."""
 
     def decorate(fn):
         if isinstance(fn, Layer):
             wrapped = StaticFunction(fn.forward, layer=fn,
-                                     input_spec=input_spec)
+                                     input_spec=input_spec, layers=layers)
             fn.forward = wrapped
             return fn
-        return StaticFunction(fn, input_spec=input_spec)
+        return StaticFunction(fn, input_spec=input_spec, layers=layers)
 
     if function is not None:
         return decorate(function)

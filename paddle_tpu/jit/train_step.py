@@ -1,0 +1,180 @@
+"""Fused training step: forward + loss + backward + optimizer update
+compiled as ONE XLA program.
+
+Reference analog: the hot path the generated `core.ops.*` bindings +
+run_program op give static-mode Paddle (pybind/op_function_generator.cc:488,
+operators/run_program_op.cc) — one host call per step, all math fused by the
+compiler. TPU-first: the optimizer update runs INSIDE the compiled program
+(pure rules over an explicit opt-state pytree, optimizer.py _pure_one), so a
+step is a single device program launch; parameter buffers are donated so XLA
+updates them in place in HBM.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core import random as rnd
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .functional_call import _swapped, _trace_rng
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class TrainStep:
+    """Compile model+loss+optimizer into one jitted step.
+
+    Usage::
+
+        step = paddle_tpu.jit.TrainStep(model, loss_fn, opt)
+        loss = step(inputs, labels)      # Tensors or raw arrays
+
+    loss_fn receives (model_outputs, *labels) as Tensors under trace and
+    returns a scalar loss Tensor. Parameter and optimizer-state buffers are
+    donated to XLA (in-place HBM update) except on the CPU backend.
+    Gradient clipping, per-param regularizers, and LR schedules compose
+    inside the compiled program; the LR rides as a traced scalar so schedule
+    changes never retrigger compilation.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, *,
+                 donate: bool = True, grad_post_hook: Optional[Callable] = None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.opt = optimizer
+        # grad_post_hook(list[raw_grad], list[Parameter]) -> list[raw_grad]:
+        # the seam where DataParallel/fleet strategies splice in comm or
+        # accumulation (Reducer-hook analog, imperative/reducer.cc:563).
+        self._grad_post_hook = grad_post_hook
+        if optimizer._parameter_list is None:
+            optimizer._parameter_list = list(model.parameters())
+        self._p_objs = [p for p in optimizer._get_params() if p.trainable]
+        b_named = dict(model.named_buffers())
+        self._b_names = list(b_named)
+        self._b_objs = list(b_named.values())
+        self._donate = donate and jax.default_backend() != "cpu"
+        # per-param "participates in the loss" mask, decided once by jaxpr
+        # analysis at first call: unused params keep eager semantics (no
+        # update at all) instead of receiving zero grads + decay.
+        self._used_mask = None
+        self._jitted = jax.jit(
+            self._step_fn,
+            donate_argnums=(0, 1, 2) if self._donate else (),
+        )
+
+    # -- the pure program ----------------------------------------------------
+    def _loss_of(self, p_tuple, b_raws, key, in_raws, label_raws):
+        p_objs, b_objs = self._p_objs, self._b_objs
+        with AG.trace_mode(), _trace_rng(key), \
+                _swapped(p_objs + b_objs, list(p_tuple) + list(b_raws)):
+            outs = self.model(*[Tensor._wrap(r) for r in in_raws])
+            labels = [Tensor._wrap(r) for r in label_raws]
+            loss = self.loss_fn(outs, *labels)
+            loss_raw = loss._data if isinstance(loss, Tensor) else loss
+            new_b = tuple(b._data for b in b_objs)
+        return loss_raw, new_b
+
+    def _step_fn(self, p_raws, opt_state, b_raws, key, lr, t, in_raws,
+                 label_raws):
+        (loss, new_b), grads = jax.value_and_grad(
+            lambda p: self._loss_of(p, b_raws, key, in_raws, label_raws),
+            has_aux=True,
+        )(tuple(p_raws))
+        grads = list(grads)
+        if self._used_mask is not None:
+            grads = [g if used else None
+                     for g, used in zip(grads, self._used_mask)]
+        grads = self._process_grads(list(p_raws), grads)
+        new_p, new_state = self.opt._functional_update(
+            self._p_objs, list(p_raws), grads, opt_state, lr, t
+        )
+        return loss, new_p, new_state, new_b
+
+    def _analyze_usage(self, p_raws, b_raws, key, in_raws, label_raws):
+        """Which params does the loss actually read? (one abstract trace).
+
+        Eager `.backward()` leaves `.grad` as None for params off the tape
+        and `step()` skips them; jax.grad instead returns zeros. Matching
+        the eager/reference semantics (optimizer.py step: `p.grad is not
+        None`) requires knowing reachability — read it off the jaxpr.
+        """
+        closed = jax.make_jaxpr(
+            lambda p: self._loss_of(p, b_raws, key, in_raws, label_raws)[0]
+        )(tuple(p_raws))
+        used = set()
+        for eqn in closed.jaxpr.eqns:
+            for v in eqn.invars:
+                used.add(id(v))
+        for v in closed.jaxpr.outvars:
+            used.add(id(v))
+        n_p = len(self._p_objs)
+        return tuple(id(v) in used for v in closed.jaxpr.invars[:n_p])
+
+    def _process_grads(self, p_raws, g_raws):
+        """Regularizer terms + grad clip + strategy hook, traced."""
+        opt = self.opt
+        reg = opt._regularization
+        if reg is not None or any(p.regularizer is not None
+                                  for p in self._p_objs):
+            out = []
+            for p, praw, g in zip(self._p_objs, p_raws, g_raws):
+                r = p.regularizer or reg
+                if g is None or r is None:
+                    out.append(g)
+                else:
+                    out.append(g + r.grad_term(praw))
+            g_raws = out
+        if opt._grad_clip is not None:
+            with AG.trace_mode(), _swapped(self._p_objs, p_raws):
+                pgs = [(p, Tensor._wrap(g) if g is not None else None)
+                       for p, g in zip(self._p_objs, g_raws)]
+                pgs = opt._grad_clip(pgs)
+                g_raws = [g._data if g is not None else None for _, g in pgs]
+        if self._grad_post_hook is not None:
+            g_raws = self._grad_post_hook(g_raws, self._p_objs)
+        return g_raws
+
+    # -- eager entry ---------------------------------------------------------
+    def __call__(self, inputs, labels=None):
+        opt = self.opt
+        in_raws = tuple(
+            x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in _as_list(inputs)
+        )
+        label_raws = tuple(
+            y._data if isinstance(y, Tensor) else jnp.asarray(y)
+            for y in _as_list(labels)
+        )
+        p_raws = tuple(p._data for p in self._p_objs)
+        opt_state = opt._functional_state(self._p_objs)
+        b_raws = tuple(b._data for b in self._b_objs)
+        key = rnd.next_key()
+        if self._used_mask is None:
+            self._used_mask = self._analyze_usage(
+                p_raws, b_raws, key, in_raws, label_raws
+            )
+        opt._step_count += 1
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        t = jnp.asarray(opt._step_count, jnp.float32)
+        loss, new_p, new_state, new_b = self._jitted(
+            p_raws, opt_state, b_raws, key, lr, t, in_raws, label_raws
+        )
+        for p, raw in zip(self._p_objs, new_p):
+            p._data = raw
+            p._node = None
+            p.grad = None
+        opt._load_functional_state(self._p_objs, new_state)
+        for b, raw in zip(self._b_objs, new_b):
+            b._data = raw
+            b._node = None
+        return Tensor._wrap(loss, stop_gradient=True)

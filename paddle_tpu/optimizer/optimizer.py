@@ -166,6 +166,66 @@ class Optimizer:
         self.step()
         return None, None
 
+    # -- pure-functional path (the fused/jitted train-step hot path) ---------
+    # Each optimizer exposes its update as a pure function over an explicit
+    # state pytree so the whole step (fwd+bwd+update) compiles into ONE XLA
+    # program — the TPU analog of the reference's fused optimizer kernels
+    # (paddle/fluid/operators/optimizers/*) reached through run_program.
+
+    _acc_tree_names: tuple = ()
+
+    def _acc_init(self, name: str, p: Parameter):
+        return jnp.zeros_like(p._data)
+
+    def _functional_state(self, params: List[Parameter]):
+        """State pytree: {acc_name: tuple aligned with params}. Seeds from /
+        shares storage with the eager accumulators so the two paths interop."""
+        state = {}
+        for name in self._acc_tree_names:
+            store = self._accumulators.setdefault(name, {})
+            vals = []
+            for p in params:
+                if id(p) not in store:
+                    store[id(p)] = self._acc_init(name, p)
+                vals.append(store[id(p)])
+            state[name] = tuple(vals)
+        return state
+
+    def _load_functional_state(self, params: List[Parameter], state):
+        for name in self._acc_tree_names:
+            store = self._accumulators.setdefault(name, {})
+            for p, v in zip(params, state[name]):
+                store[id(p)] = v
+
+    def _pure_one(self, p, p_raw, g_raw, accs: dict, lr, t):
+        """One param's pure update: (new_p, new_accs). lr/t are traced arrays;
+        `p` is the Parameter object for host-side metadata only."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no pure update rule"
+        )
+
+    def _functional_update(self, params: List[Parameter], p_raws, g_raws,
+                           state, lr, t):
+        """Apply the update across the param list. Returns (new_p_raws,
+        new_state). `params` supplies host-side metadata (per-param lr
+        multipliers, weight-decay exclusions); math sees only raws."""
+        new_ps, new_state = [], {n: [] for n in self._acc_tree_names}
+        for i, (p, praw, graw) in enumerate(zip(params, p_raws, g_raws)):
+            d = praw.dtype
+            mult = p.optimize_attr.get("learning_rate", 1.0)
+            p_lr = lr.astype(d) * jnp.asarray(mult, d)
+            accs = {n: state[n][i] for n in self._acc_tree_names}
+            if graw is None:
+                new_p, new_accs = praw, accs
+            else:
+                new_p, new_accs = self._pure_one(
+                    p, praw, graw.astype(d), accs, p_lr, t.astype(d)
+                )
+            new_ps.append(new_p)
+            for n in self._acc_tree_names:
+                new_state[n].append(new_accs[n])
+        return tuple(new_ps), {n: tuple(v) for n, v in new_state.items()}
+
 
 def _jit_rule(fn):
     """Compile an update rule once per shape/dtype; scalars ride as arrays."""
@@ -263,6 +323,9 @@ class SGD(Optimizer):
     def _apply_one(self, p, g, lr):
         p._data = _sgd_rule(p._data, g, jnp.asarray(lr, p._data.dtype))
 
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        return p_raw - lr * g_raw, accs
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -281,6 +344,16 @@ class Momentum(Optimizer):
             jnp.asarray(self._nesterov),
         )
         self._set_acc("velocity", p, v_new)
+
+    _acc_tree_names = ("velocity",)
+
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        d = p_raw.dtype
+        p_new, v_new = _momentum_rule(
+            p_raw, g_raw, accs["velocity"], lr,
+            jnp.asarray(self._momentum, d), jnp.asarray(self._nesterov),
+        )
+        return p_new, {"velocity": v_new}
 
 
 class Adam(Optimizer):
@@ -307,6 +380,17 @@ class Adam(Optimizer):
         )
         self._set_acc("moment1", p, m_new)
         self._set_acc("moment2", p, v_new)
+
+    _acc_tree_names = ("moment1", "moment2")
+
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        d = p_raw.dtype
+        new_p, m_new, v_new = _adam_rule(
+            p_raw, g_raw, accs["moment1"], accs["moment2"],
+            lr, jnp.asarray(self._beta1, d), jnp.asarray(self._beta2, d),
+            jnp.asarray(self._epsilon, d), t,
+        )
+        return new_p, {"moment1": m_new, "moment2": v_new}
 
 
 class AdamW(Adam):
@@ -340,6 +424,19 @@ class AdamW(Adam):
         self._set_acc("moment1", p, m_new)
         self._set_acc("moment2", p, v_new)
 
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        wd = self._wd
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            wd = 0.0
+        d = p_raw.dtype
+        new_p, m_new, v_new = _adamw_rule(
+            p_raw, g_raw, accs["moment1"], accs["moment2"],
+            lr, jnp.asarray(self._beta1, d), jnp.asarray(self._beta2, d),
+            jnp.asarray(self._epsilon, d), t, jnp.asarray(wd, d),
+        )
+        return new_p, {"moment1": m_new, "moment2": v_new}
+
 
 class Adamax(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
@@ -363,6 +460,17 @@ class Adamax(Optimizer):
         self._set_acc("moment", p, m_new)
         self._set_acc("inf_norm", p, u_new)
 
+    _acc_tree_names = ("moment", "inf_norm")
+
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        d = p_raw.dtype
+        new_p, m_new, u_new = _adamax_rule(
+            p_raw, g_raw, accs["moment"], accs["inf_norm"],
+            lr, jnp.asarray(self._beta1, d), jnp.asarray(self._beta2, d),
+            jnp.asarray(self._epsilon, d), t,
+        )
+        return new_p, {"moment": m_new, "inf_norm": u_new}
+
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
@@ -381,6 +489,18 @@ class Adagrad(Optimizer):
             p._data, g, G, jnp.asarray(lr, d), jnp.asarray(self._epsilon, d)
         )
         self._set_acc("moment", p, G_new)
+
+    _acc_tree_names = ("moment",)
+
+    def _acc_init(self, name, p):
+        return jnp.full_like(p._data, self._init_acc)
+
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        d = p_raw.dtype
+        new_p, G_new = _adagrad_rule(
+            p_raw, g_raw, accs["moment"], lr, jnp.asarray(self._epsilon, d)
+        )
+        return new_p, {"moment": G_new}
 
 
 class Adadelta(Optimizer):
@@ -401,6 +521,20 @@ class Adadelta(Optimizer):
         )
         self._set_acc("avg_squared_grad", p, Eg_new)
         self._set_acc("avg_squared_update", p, Ex_new)
+
+    _acc_tree_names = ("avg_squared_grad", "avg_squared_update")
+
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        d = p_raw.dtype
+        new_p, Eg_new, Ex_new = _adadelta_rule(
+            p_raw, g_raw, accs["avg_squared_grad"],
+            accs["avg_squared_update"],
+            jnp.asarray(self._rho, d), jnp.asarray(self._epsilon, d),
+        )
+        return new_p, {
+            "avg_squared_grad": Eg_new,
+            "avg_squared_update": Ex_new,
+        }
 
 
 class RMSProp(Optimizer):
@@ -429,6 +563,24 @@ class RMSProp(Optimizer):
         )
         self._set_acc("mean_square", p, ms_new)
         self._set_acc("momentum", p, mom_new)
+
+    _acc_tree_names = ("mean_square", "momentum", "mean_grad")
+
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        d = p_raw.dtype
+        rho = jnp.asarray(self._rho, d)
+        mg = accs["mean_grad"]
+        if self._centered:
+            mg = rho * mg + (1 - rho) * g_raw
+        p_new, ms_new, mom_new = _rmsprop_rule(
+            p_raw, g_raw, accs["mean_square"], accs["momentum"],
+            lr, rho, jnp.asarray(self._epsilon, d),
+            jnp.asarray(self._momentum, d),
+            jnp.asarray(self._centered), mg,
+        )
+        return p_new, {
+            "mean_square": ms_new, "momentum": mom_new, "mean_grad": mg,
+        }
 
 
 class Lamb(Optimizer):
@@ -459,3 +611,17 @@ class Lamb(Optimizer):
         )
         self._set_acc("moment1", p, m_new)
         self._set_acc("moment2", p, v_new)
+
+    _acc_tree_names = ("moment1", "moment2")
+
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        d = p_raw.dtype
+        new_p, m_new, v_new = _lamb_rule(
+            p_raw, g_raw, accs["moment1"], accs["moment2"],
+            lr, jnp.asarray(self._beta1, d), jnp.asarray(self._beta2, d),
+            jnp.asarray(self._epsilon, d), t, jnp.asarray(wd, d),
+        )
+        return new_p, {"moment1": m_new, "moment2": v_new}

@@ -1,0 +1,253 @@
+"""functional_call + fused TrainStep (the Layer -> pure-fn bridge).
+
+Test model: the reference exercises its run_program/fused path via
+test_imperative vs to_static equivalence suites; here we assert the fused
+step is numerically identical to the eager tape + Optimizer.step path.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import TrainStep, functional_call, named_state, raw_state
+
+
+def _mlp():
+    return nn.Sequential(
+        nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3),
+    )
+
+
+def _copy_model(src, dst):
+    dst.set_state_dict({k: v.numpy() for k, v in src.state_dict().items()})
+
+
+class TestFunctionalCall:
+    def test_matches_eager_forward(self):
+        m = _mlp()
+        x = paddle.to_tensor(np.random.rand(4, 6).astype(np.float32))
+        eager = m(x).numpy()
+        params, buffers = raw_state(m)
+        out, new_b = functional_call(m, params, buffers, (x,))
+        np.testing.assert_allclose(np.asarray(out), eager, rtol=1e-6)
+
+    def test_pure_wrt_params(self):
+        """Zeroed params must change the output; layer state is untouched."""
+        m = _mlp()
+        x = paddle.to_tensor(np.random.rand(4, 6).astype(np.float32))
+        params, buffers = raw_state(m)
+        before = {k: np.asarray(v) for k, v in params.items()}
+        zeroed = {k: jnp.zeros_like(v) for k, v in params.items()}
+        out, _ = functional_call(m, zeroed, buffers, (x,))
+        np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+        for k, p in named_state(m)[0].items():
+            np.testing.assert_array_equal(np.asarray(p._data), before[k])
+
+    def test_jax_grad_flows(self):
+        m = _mlp()
+        x = jnp.asarray(np.random.rand(4, 6).astype(np.float32))
+        params, buffers = raw_state(m)
+
+        def loss(params):
+            out, _ = functional_call(m, params, buffers, (x,))
+            return jnp.sum(out ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert set(grads) == set(params)
+        assert all(np.asarray(g).shape == np.asarray(params[k]).shape
+                   for k, g in grads.items())
+        assert any(np.abs(np.asarray(g)).sum() > 0 for g in grads.values())
+
+    def test_buffer_update_returned(self):
+        """BatchNorm running stats come back as new_buffers, not mutation."""
+        m = nn.BatchNorm1D(5)
+        m.train()
+        x = np.random.rand(8, 5).astype(np.float32) * 3 + 1
+        params, buffers = raw_state(m)
+        before_mean = np.asarray(buffers["_mean"]).copy()
+        out, new_b = functional_call(m, params, buffers, (paddle.to_tensor(x),))
+        assert not np.allclose(np.asarray(new_b["_mean"]), before_mean)
+        # layer's own buffer storage restored (pure call)
+        np.testing.assert_array_equal(
+            np.asarray(dict(m.named_buffers())["_mean"]._data), before_mean
+        )
+
+    def test_missing_param_raises(self):
+        m = _mlp()
+        params, buffers = raw_state(m)
+        params.popitem()
+        with pytest.raises(KeyError):
+            functional_call(m, params, buffers, (paddle.ones([2, 6]),))
+
+
+def _run_eager(model, opt_fn, data, n_steps):
+    opt = opt_fn(model.parameters())
+    losses = []
+    for i in range(n_steps):
+        x, y = data[i]
+        out = model(paddle.to_tensor(x))
+        loss = paddle.nn.functional.cross_entropy(out, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def _run_fused(model, opt_fn, data, n_steps):
+    opt = opt_fn(model.parameters())
+    step = TrainStep(
+        model, lambda out, y: paddle.nn.functional.cross_entropy(out, y), opt
+    )
+    return [float(step(data[i][0], data[i][1]).numpy())
+            for i in range(n_steps)]
+
+
+def _make_data(n, batch=8, feat=6, classes=3):
+    rng = np.random.RandomState(7)
+    return [
+        (
+            rng.rand(batch, feat).astype(np.float32),
+            (rng.randint(0, classes, size=(batch,))).astype(np.int64),
+        )
+        for _ in range(n)
+    ]
+
+
+@pytest.mark.parametrize(
+    "opt_fn",
+    [
+        lambda ps: optimizer.SGD(learning_rate=0.1, parameters=ps),
+        lambda ps: optimizer.Momentum(learning_rate=0.05, parameters=ps),
+        lambda ps: optimizer.Adam(learning_rate=0.01, parameters=ps),
+        lambda ps: optimizer.AdamW(
+            learning_rate=0.01, weight_decay=0.01, parameters=ps
+        ),
+        lambda ps: optimizer.Lamb(learning_rate=0.01, parameters=ps),
+    ],
+    ids=["sgd", "momentum", "adam", "adamw", "lamb"],
+)
+def test_train_step_matches_eager(opt_fn):
+    data = _make_data(4)
+    paddle.seed(3)
+    m1 = _mlp()
+    m2 = _mlp()
+    _copy_model(m1, m2)
+    eager_losses = _run_eager(m1, opt_fn, data, 4)
+    fused_losses = _run_fused(m2, opt_fn, data, 4)
+    np.testing.assert_allclose(eager_losses, fused_losses, rtol=2e-4)
+    for (k, p1), (_, p2) in zip(
+        m1.state_dict().items(), m2.state_dict().items()
+    ):
+        np.testing.assert_allclose(
+            p1.numpy(), p2.numpy(), rtol=2e-4, atol=1e-5, err_msg=k
+        )
+
+
+def test_train_step_with_clip_and_regularizer():
+    data = _make_data(3)
+    paddle.seed(5)
+    m1, m2 = _mlp(), _mlp()
+    _copy_model(m1, m2)
+
+    def opt_fn(ps):
+        return optimizer.Momentum(
+            learning_rate=0.05,
+            parameters=ps,
+            weight_decay=0.01,
+            grad_clip=nn.ClipGradByGlobalNorm(0.5),
+        )
+
+    eager_losses = _run_eager(m1, opt_fn, data, 3)
+    fused_losses = _run_fused(m2, opt_fn, data, 3)
+    np.testing.assert_allclose(eager_losses, fused_losses, rtol=2e-4)
+    for (k, p1), (_, p2) in zip(
+        m1.state_dict().items(), m2.state_dict().items()
+    ):
+        np.testing.assert_allclose(
+            p1.numpy(), p2.numpy(), rtol=2e-4, atol=1e-5, err_msg=k
+        )
+
+
+def test_train_step_lr_schedule_no_recompile():
+    data = _make_data(3)
+    m = _mlp()
+    sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+    opt = optimizer.SGD(learning_rate=sched, parameters=m.parameters())
+    step = TrainStep(
+        m, lambda out, y: paddle.nn.functional.cross_entropy(out, y), opt
+    )
+    for i in range(3):
+        step(data[i][0], data[i][1])
+        sched.step()
+    # one compiled program despite three different LRs
+    assert step._jitted._cache_size() == 1
+
+
+def test_train_step_updates_bn_buffers():
+    m = nn.Sequential(nn.Linear(6, 5), nn.BatchNorm1D(5))
+    m.train()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    step = TrainStep(m, lambda out, y: (out * 0 + out.mean()).sum(), opt)
+    mean_before = np.asarray(
+        dict(m.named_buffers())["1._mean"]._data
+    ).copy()
+    x = np.random.rand(8, 6).astype(np.float32) + 2.0
+    step(x, np.zeros((8,), np.int64))
+    mean_after = np.asarray(dict(m.named_buffers())["1._mean"]._data)
+    assert not np.allclose(mean_before, mean_after)
+
+
+def test_train_step_skips_unused_params():
+    """A head not feeding the loss must stay untouched (eager semantics:
+    optimizer.step skips params with .grad None)."""
+
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.trunk = nn.Linear(6, 8)
+            self.used = nn.Linear(8, 3)
+            self.unused = nn.Linear(8, 3)
+
+        def forward(self, x):
+            h = self.trunk(x)
+            return self.used(h)
+
+    m = TwoHead()
+    before = m.unused.weight.numpy().copy()
+    opt = optimizer.AdamW(
+        learning_rate=0.05, weight_decay=0.5, parameters=m.parameters()
+    )
+    step = TrainStep(
+        m, lambda out, y: paddle.nn.functional.cross_entropy(out, y), opt
+    )
+    data = _make_data(3)
+    for i in range(3):
+        step(data[i][0], data[i][1])
+    np.testing.assert_array_equal(m.unused.weight.numpy(), before)
+    assert not np.allclose(m.used.weight.numpy(), before.shape and 0)
+
+
+def test_collect_layers_in_containers():
+    """Layers held in a dict/list closure are lifted (no silent constants)."""
+    from paddle_tpu.jit import to_static
+
+    parts = {"fc1": nn.Linear(4, 4), "rest": [nn.Linear(4, 2)]}
+
+    @to_static
+    def fwd(x):
+        h = parts["fc1"](x)
+        return parts["rest"][0](h)
+
+    x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    x.stop_gradient = False
+    out = fwd(x)
+    loss = out.sum()
+    loss.backward()
+    for l in (parts["fc1"], parts["rest"][0]):
+        for p in l.parameters():
+            assert p.grad is not None, "param missed by _collect_layers"
