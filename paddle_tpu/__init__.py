@@ -46,6 +46,8 @@ from . import metric  # noqa: F401
 from . import vision  # noqa: F401
 from . import amp  # noqa: F401
 from . import jit  # noqa: F401
+from . import distributed  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from .ops import creation, linalg, logic, manipulation, math, search  # noqa: F401
 from .ops.creation import to_tensor  # noqa: F401

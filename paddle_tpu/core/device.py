@@ -107,6 +107,44 @@ def current_jax_device():
     return _current_device.jax_device()
 
 
+def force_cpu_devices(n: int = 8):
+    """Force the CPU backend with `n` virtual devices — the sharding test
+    harness (SURVEY.md §4: ranks ≙ in-process XLA devices).
+
+    Works in both environments: plain hosts (env vars before first backend
+    init) and axon TPU hosts, whose sitecustomize imports jax at interpreter
+    start capturing JAX_PLATFORMS=axon — there jax.config.update still wins
+    until the first backend query, and XLA_FLAGS is read lazily at backend
+    init. Note hosts may export XLA_FLAGS="" (empty): append, don't
+    setdefault. Raises if jax already initialized with fewer devices.
+    """
+    import os
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            f"--xla_force_host_platform_device_count={n}", flags,
+        )
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    ndev = len(jax.devices())
+    if ndev < n:
+        raise RuntimeError(
+            f"need {n} CPU devices but jax already initialized with {ndev}; "
+            "call force_cpu_devices before any jax backend query"
+        )
+
+
 def is_compiled_with_cuda() -> bool:
     """Parity shim: scripts gate GPU paths on this; TPU counts as accelerator."""
     return False

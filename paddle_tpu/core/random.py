@@ -14,8 +14,17 @@ import threading
 import jax
 
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(0)
+# Lazy: creating a PRNGKey initializes a jax backend; keep imports free of
+# backend queries so harnesses can force platform/device-count first.
+_key = None
 _seed_value = 0
+
+
+def _ensure_key():
+    global _key
+    if _key is None:
+        _key = jax.random.PRNGKey(_seed_value)
+    return _key
 
 # Inside a to_static/jit trace the global (stateful) key must not be baked
 # into the compiled program; the jit runtime registers a provider that
@@ -53,13 +62,13 @@ def next_key():
         return _trace_key_provider()
     global _key
     with _lock:
-        _key, sub = jax.random.split(_key)
+        _key, sub = jax.random.split(_ensure_key())
     return sub
 
 
 def next_keys(n: int):
     global _key
     with _lock:
-        keys = jax.random.split(_key, n + 1)
+        keys = jax.random.split(_ensure_key(), n + 1)
         _key = keys[0]
     return keys[1:]

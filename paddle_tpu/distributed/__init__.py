@@ -1,7 +1,34 @@
-"""paddle_tpu.distributed — Fleet-style distributed API (SURVEY.md §2.9).
+"""paddle_tpu.distributed — the distributed API (SURVEY.md §2.9, L10).
 
-Stage 4-6 build-out; env discovery lands first so io.DistributedBatchSampler
-works standalone.
+Reference surface: python/paddle/distributed/__init__.py (collectives,
+init_parallel_env, ParallelEnv, DataParallel re-export, fleet, spawn).
+TPU-native core: one device mesh + named-axis XLA collectives (comm.py)
+instead of ring-id'd NCCL communicators; see comm.py / collective.py /
+parallel.py docstrings for the mapping.
 """
 from . import env  # noqa: F401
 from .env import get_rank, get_world_size  # noqa: F401
+from .comm import (  # noqa: F401
+    Group,
+    ParallelEnv,
+    get_group,
+    init_parallel_env,
+    is_initialized,
+    new_group,
+    replicate,
+    shard_rank_axis,
+    spmd_region,
+    in_spmd_region,
+)
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    reduce,
+    reduce_scatter,
+    scatter,
+)
+from .parallel import DataParallel  # noqa: F401

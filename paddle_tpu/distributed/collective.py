@@ -120,6 +120,21 @@ def _broadcast_prog(gid: int, src: int):
 
 
 @functools.lru_cache(maxsize=None)
+def _alltoall_prog(gid: int):
+    g = comm.get_group(gid)
+    ax = g.axis_name
+    from jax.sharding import NamedSharding
+
+    # out[s] stays rank-stacked along its (new) leading axis; sharding the
+    # transposed stack's second axis keeps every parts[s] slice laid out
+    # over the group, so the exchange compiles to one all-to-all.
+    return jax.jit(
+        lambda A: jnp.swapaxes(A, 0, 1),
+        out_shardings=NamedSharding(g.mesh, P(None, ax)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
 def _reduce_scatter_prog(gid: int, op: int):
     g = comm.get_group(gid)
     ax = g.axis_name
@@ -135,18 +150,6 @@ def _reduce_scatter_prog(gid: int, op: int):
             return jax.lax.dynamic_slice_in_dim(r, i * chunk, chunk, 1)
     return jax.jit(comm.shard_map(fn, g.mesh, in_specs=P(ax),
                                   out_specs=P(ax)))
-
-
-@functools.lru_cache(maxsize=None)
-def _alltoall_prog(gid: int):
-    g = comm.get_group(gid)
-    ax = g.axis_name
-    # local [1, nranks, ...] -> receives [1, nranks, ...] of everyone's slice
-    return jax.jit(comm.shard_map(
-        lambda x: jax.lax.all_to_all(x, ax, split_axis=1, concat_axis=0,
-                                     tiled=False),
-        g.mesh, in_specs=P(ax), out_specs=P(ax),
-    ))
 
 
 # ---------------------------------------------------------------------------
@@ -293,17 +296,17 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
                                          concat_axis=0, tiled=True),
             (_as_t(in_tensor_list),), name="c_alltoall",
         )
+    # Eager single-controller: with A = stack(in_list) (A[s, r] = rank r's
+    # item destined to rank s), rank r's received list is out_r[s] =
+    # A[r, s], i.e. the stacked output is swapaxes(A, 0, 1). ONE jitted
+    # transpose+reshard program — XLA emits the actual all-to-all when the
+    # swapped layout lands back on the rank axis.
     if isinstance(in_tensor_list, (list, tuple)):
-        # [nranks][nranks, ...] per-rank stacks
-        stacked = jnp.stack([_raw(t) for t in in_tensor_list], axis=1)
+        A = jnp.stack([_raw(t) for t in in_tensor_list], axis=0)
     else:
-        t = _as_t(in_tensor_list)
-        stacked = t._data.reshape(
-            (g.nranks, g.nranks) + tuple(t._data.shape[1:])[1:]
-        )
-    out = _alltoall_prog(g.id)(comm.shard_rank_axis(stacked, g))
-    # out[r, s] = input rank s's item for rank r
-    parts = [Tensor._wrap(out[:, s]) for s in range(g.nranks)]
+        A = _raw(in_tensor_list)
+    B = _alltoall_prog(g.id)(comm.shard_rank_axis(A, g))
+    parts = [Tensor._wrap(B[s]) for s in range(g.nranks)]
     if out_tensor_list is not None:
         out_tensor_list.extend(parts)
     return parts

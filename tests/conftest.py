@@ -6,16 +6,17 @@ analog of the reference's single-host multiprocess dist tests,
 python/paddle/fluid/tests/unittests/test_dist_base.py:671 — here ranks are
 in-process XLA devices, SURVEY.md §4 TPU equivalent).
 
-Env vars must be set before jax initializes its backends, hence before any
-paddle_tpu import — conftest import order guarantees that under pytest.
+Backend forcing must survive two environments: (a) plain hosts, where env
+vars before the first jax import suffice; (b) axon TPU hosts, where the
+sitecustomize imports jax at interpreter start, so env defaults are already
+captured — there, jax.config.update("jax_platforms") before the first
+backend query still wins, and XLA_FLAGS is read lazily at backend init so
+appending the device-count flag here works. Note the host may export
+XLA_FLAGS="" (empty), so append rather than setdefault.
 """
-import os
+from paddle_tpu.core.device import force_cpu_devices
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-# JAX_PLATFORM_NAME (not JAX_PLATFORMS) — the axon TPU plugin's sitecustomize
-# re-pins JAX_PLATFORMS=axon, but PLATFORM_NAME wins at backend selection.
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["JAX_PLATFORM_NAME"] = "cpu"
+force_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,257 @@
+"""Distributed core: collectives + groups + DataParallel on the 8-device
+CPU mesh.
+
+Test model: the reference's collective op tests
+(python/paddle/fluid/tests/unittests/test_collective_base.py:141,212 —
+launch 2 ranks, compare tensor results against numpy) and TestDistBase
+(test_dist_base.py:671 — N-proc vs 1-proc loss deltas). Here ranks are mesh
+devices in one process (SURVEY.md §4 TPU equivalent).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit import TrainStep
+
+N = 8  # conftest forces 8 virtual CPU devices
+
+
+@pytest.fixture(autouse=True)
+def _env():
+    dist.init_parallel_env()
+    yield
+
+
+def _per_rank(shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.rand(N, *shape).astype(np.float32)
+
+
+class TestCollectives:
+    def test_all_reduce_sum(self):
+        x = _per_rank((3, 4))
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t)
+        want = np.broadcast_to(x.sum(0, keepdims=True), x.shape)
+        np.testing.assert_allclose(t.numpy(), want, rtol=1e-6)
+
+    def test_all_reduce_max_min_prod_avg(self):
+        x = _per_rank((2, 3), seed=1) + 0.5
+        for op, ref in [
+            (dist.ReduceOp.MAX, x.max(0)),
+            (dist.ReduceOp.MIN, x.min(0)),
+            (dist.ReduceOp.PROD, x.prod(0)),
+            (dist.ReduceOp.AVG, x.mean(0)),
+        ]:
+            t = paddle.to_tensor(x)
+            dist.all_reduce(t, op=op)
+            np.testing.assert_allclose(
+                t.numpy(), np.broadcast_to(ref, x.shape), rtol=1e-5
+            )
+
+    def test_all_gather(self):
+        x = _per_rank((2, 2), seed=2)
+        parts = dist.all_gather(None, paddle.to_tensor(x))
+        assert len(parts) == N
+        for r in range(N):
+            np.testing.assert_allclose(parts[r].numpy(), x[r], rtol=1e-6)
+
+    def test_broadcast(self):
+        x = _per_rank((4,), seed=3)
+        t = paddle.to_tensor(x)
+        dist.broadcast(t, src=3)
+        want = np.broadcast_to(x[3:4], x.shape)
+        np.testing.assert_allclose(t.numpy(), want, rtol=1e-6)
+
+    def test_reduce_only_dst(self):
+        x = _per_rank((3,), seed=4)
+        t = paddle.to_tensor(x)
+        dist.reduce(t, dst=2)
+        got = t.numpy()
+        np.testing.assert_allclose(got[2], x.sum(0), rtol=1e-5)
+        for r in range(N):
+            if r != 2:
+                np.testing.assert_allclose(got[r], x[r], rtol=1e-6)
+
+    def test_reduce_scatter(self):
+        chunk = 3
+        x = _per_rank((N * chunk,), seed=5)
+        t = paddle.to_tensor(x)
+        dist.reduce_scatter(t)
+        got = t.numpy()
+        s = x.sum(0)  # [N*chunk]
+        for r in range(N):
+            np.testing.assert_allclose(
+                got[r], s[r * chunk:(r + 1) * chunk], rtol=1e-5
+            )
+
+    def test_alltoall(self):
+        # X[s, r] = rank r's item destined to rank s (stacked convention);
+        # rank r receives out[s][r] = X[r, s]  ->  out[s] = X[:, s]
+        X = np.arange(N * N * 2, dtype=np.float32).reshape(N, N, 2)
+        in_list = [paddle.to_tensor(X[s]) for s in range(N)]
+        out = dist.alltoall(in_list)
+        assert len(out) == N
+        for s in range(N):
+            np.testing.assert_allclose(out[s].numpy(), X[:, s], rtol=1e-6)
+
+    def test_barrier(self):
+        dist.barrier()
+
+    def test_scatter(self):
+        x = [np.full((2,), float(r), np.float32) for r in range(N)]
+        t = paddle.to_tensor(np.zeros((N, 2), np.float32))
+        dist.scatter(t, [paddle.to_tensor(v) for v in x], src=0)
+        for r in range(N):
+            np.testing.assert_allclose(t.numpy()[r], x[r])
+
+    def test_new_group_subset(self):
+        g = dist.new_group(ranks=[0, 2, 4, 6])
+        assert g.nranks == 4
+        x = np.arange(4 * 3, dtype=np.float32).reshape(4, 3)
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, group=g)
+        np.testing.assert_allclose(
+            t.numpy(), np.broadcast_to(x.sum(0), x.shape), rtol=1e-6
+        )
+
+    def test_eager_shape_guard(self):
+        t = paddle.to_tensor(np.zeros((3, 2), np.float32))  # 3 != 8 ranks
+        with pytest.raises(ValueError, match="per-rank convention"):
+            dist.all_reduce(t)
+
+    def test_spmd_region_collective(self):
+        """dist.* inside a shard_map program lowers to bare lax collectives."""
+        from jax.sharding import PartitionSpec as P
+
+        g = dist.get_group(0)
+        x = _per_rank((2,), seed=6)
+
+        def rank_fn(xr):
+            with dist.spmd_region(g.axis_name):
+                t = paddle.Tensor._wrap(xr)
+                out = dist.all_reduce(t)
+                return out._data
+
+        f = jax.jit(
+            dist.comm.shard_map(
+                rank_fn, g.mesh, in_specs=P(g.axis_name),
+                out_specs=P(g.axis_name),
+            )
+        )
+        got = np.asarray(f(jnp.asarray(x)))
+        np.testing.assert_allclose(
+            got, np.broadcast_to(x.sum(0), x.shape), rtol=1e-6
+        )
+
+
+class _SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(12, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+class TestDataParallel:
+    def test_dp_matches_single_device(self):
+        """TestDistBase-style: N-device DataParallel training == 1-device
+        training on the same global batch (test_dist_base.py:671 analog)."""
+        rng = np.random.RandomState(11)
+        data = [
+            (
+                rng.rand(16, 12).astype(np.float32),
+                rng.randint(0, 4, (16,)).astype(np.int64),
+            )
+            for _ in range(4)
+        ]
+
+        paddle.seed(42)
+        single = _SmallNet()
+        paddle.seed(42)
+        wrapped = _SmallNet()
+        wrapped.set_state_dict(
+            {k: v.numpy() for k, v in single.state_dict().items()}
+        )
+        dp = paddle.DataParallel(wrapped)
+
+        loss_fn = lambda out, y: paddle.nn.functional.cross_entropy(out, y)  # noqa: E731
+        opt_s = optimizer.Momentum(
+            learning_rate=0.1, parameters=single.parameters()
+        )
+        opt_d = optimizer.Momentum(
+            learning_rate=0.1, parameters=dp.parameters()
+        )
+        step_s = TrainStep(single, loss_fn, opt_s)
+        step_d = TrainStep(dp, loss_fn, opt_d)
+
+        for x, y in data:
+            ls = step_s(x, y)
+            ld = step_d(dp.shard_input(x), dp.shard_input(y))
+            np.testing.assert_allclose(
+                float(ls.numpy()), float(ld.numpy()), rtol=1e-5
+            )
+        for (k, ps), (_, pd) in zip(
+            single.state_dict().items(), dp.state_dict().items()
+        ):
+            np.testing.assert_allclose(
+                ps.numpy(), pd.numpy(), rtol=1e-4, atol=1e-6, err_msg=k
+            )
+
+    def test_dp_param_sharding_is_replicated(self):
+        dp = paddle.DataParallel(_SmallNet())
+        for p in dp.parameters():
+            sh = p._data.sharding
+            assert sh.is_fully_replicated
+
+    def test_dp_input_sharded_over_dp_axis(self):
+        dp = paddle.DataParallel(_SmallNet())
+        x = dp.shard_input(np.zeros((16, 12), np.float32))
+        assert not x._data.sharding.is_fully_replicated
+
+    def test_dp_eager_backward_grads_match(self):
+        """Eager tape over dp-sharded batch: grads == single-device grads."""
+        rng = np.random.RandomState(3)
+        x = rng.rand(16, 12).astype(np.float32)
+        y = rng.randint(0, 4, (16,)).astype(np.int64)
+
+        paddle.seed(1)
+        m1 = _SmallNet()
+        m2 = _SmallNet()
+        m2.set_state_dict({k: v.numpy() for k, v in m1.state_dict().items()})
+        dp = paddle.DataParallel(m2)
+
+        loss1 = paddle.nn.functional.cross_entropy(
+            m1(paddle.to_tensor(x)), paddle.to_tensor(y)
+        )
+        loss1.backward()
+        loss2 = paddle.nn.functional.cross_entropy(
+            dp(dp.shard_input(x)), dp.shard_input(y)
+        )
+        loss2.backward()
+        np.testing.assert_allclose(
+            float(loss1.numpy()), float(loss2.numpy()), rtol=1e-6
+        )
+        g1 = {k: p.grad.numpy() for k, p in m1.named_parameters()}
+        g2 = {k: p.grad.numpy() for k, p in m2.named_parameters()}
+        for k in g1:
+            np.testing.assert_allclose(g1[k], g2[k], rtol=1e-5, err_msg=k)
+
+
+class TestEnv:
+    def test_parallel_env(self):
+        import os
+
+        env = dist.init_parallel_env()
+        assert env.world_size == N
+        assert env.rank == 0
+        assert dist.get_world_size() == int(
+            os.environ.get("PADDLE_TRAINERS_NUM", 1)
+        )
