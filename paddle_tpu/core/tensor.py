@@ -282,7 +282,9 @@ class Parameter(Tensor):
     """Trainable tensor (fluid/framework.py Parameter): stop_gradient=False,
     persistable, with an optional trainable switch."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+    __slots__ = (
+        "trainable", "optimize_attr", "regularizer", "need_clip", "_tp_spec"
+    )
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -291,6 +293,7 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.need_clip = True
+        self._tp_spec = None  # model-parallel PartitionSpec (meta_parallel)
 
     @classmethod
     def from_tensor(cls, t: Tensor, name=None, trainable=True):
@@ -308,4 +311,5 @@ class Parameter(Tensor):
         p.optimize_attr = {"learning_rate": 1.0}
         p.regularizer = None
         p.need_clip = True
+        p._tp_spec = None
         return p

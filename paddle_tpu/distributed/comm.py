@@ -81,6 +81,7 @@ class _CommState(threading.local):
         self.default_group: Optional[Group] = None
         self.groups: Dict[int, Group] = {}
         self.spmd_axes: Tuple[str, ...] = ()  # inside shard_map regions
+        self.hybrid_mesh: Optional[Mesh] = None
 
 
 _state = _CommState()
@@ -210,6 +211,52 @@ def in_spmd_region(axis_name: Optional[str] = None) -> bool:
     if axis_name is None:
         return bool(_state.spmd_axes)
     return axis_name in _state.spmd_axes
+
+
+# ---------------------------------------------------------------------------
+# Hybrid topology: one mesh, axes = parallelism dimensions
+# ---------------------------------------------------------------------------
+
+
+def init_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1,
+                     sp: int = 1) -> Mesh:
+    """Build the job-wide hybrid mesh (dp, pp, sp, mp axes; mp innermost for
+    ICI locality — model-parallel collectives are the latency-critical ones).
+
+    The analog of the reference's per-strategy comm-ring construction
+    (fleet meta_optimizers/common.py CollectiveHelper ring setup): here ONE
+    declaration; each strategy consumes its axis by sharding on it.
+    """
+    _ensure_init()
+    devs = jax.devices()
+    need = dp * mp * pp * sp
+    if len(devs) < need:
+        raise ValueError(
+            f"hybrid topology dp={dp} x pp={pp} x sp={sp} x mp={mp} needs "
+            f"{need} devices, have {len(devs)}"
+        )
+    arr = np.array(devs[:need]).reshape(dp, pp, sp, mp)
+    mesh = Mesh(arr, ("dp", "pp", "sp", "mp"))
+    _state.hybrid_mesh = mesh
+    return mesh
+
+
+def hybrid_mesh() -> Optional[Mesh]:
+    return _state.hybrid_mesh
+
+
+def mp_mesh() -> Mesh:
+    """Mesh tensor-parallel params shard over ('mp' axis of the hybrid
+    mesh). Declared by fleet.init(strategy with hybrid_configs mp_degree)
+    or comm.init_hybrid_mesh."""
+    if _state.hybrid_mesh is None:
+        raise RuntimeError(
+            "model-parallel layers need a hybrid mesh: call "
+            "fleet.init(strategy=DistributedStrategy with "
+            "hybrid_configs={'mp_degree': N}) or "
+            "distributed.comm.init_hybrid_mesh(mp=N) first"
+        )
+    return _state.hybrid_mesh
 
 
 # ---------------------------------------------------------------------------

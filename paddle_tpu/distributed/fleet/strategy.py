@@ -1,0 +1,137 @@
+"""DistributedStrategy — the single distributed-config surface.
+
+Reference: python/paddle/distributed/fleet/base/distributed_strategy.py:104
+(protobuf-backed, distributed_strategy.proto:122) with per-feature bool +
+`*_configs` dict pairs, prototxt save/load (:145,:163).
+
+TPU-native: a plain config object (SURVEY.md §5 config tiers — dataclass
+configs). Feature flags select sharding/transform passes applied by
+fleet.distributed_model / distributed_optimizer over the one hybrid mesh;
+fields that configure NCCL ring mechanics (nccl_comm_num, fuse sizes) are
+accepted for script parity and ignored — XLA's collective combiner owns
+bucketing.
+"""
+from __future__ import annotations
+
+import copy
+import json
+
+
+_DEFAULTS = {
+    # feature flags + configs (reference field names)
+    "amp": False,
+    "amp_configs": {
+        "init_loss_scaling": 32768.0,
+        "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2,
+        "incr_ratio": 2.0,
+        "decr_ratio": 0.5,
+        "use_dynamic_loss_scaling": True,
+        "custom_white_list": [],
+        "custom_black_list": [],
+        "use_pure_fp16": False,
+        "use_bf16": True,  # TPU-first default
+    },
+    "recompute": False,
+    "recompute_configs": {"checkpoints": []},
+    "sharding": False,
+    "sharding_configs": {
+        "sharding_degree": 8, "stage": 1, "fuse_broadcast_MB": 32.0,
+        "hybrid_dp": False,
+    },
+    "pipeline": False,
+    "pipeline_configs": {
+        "micro_batch_size": 1, "accumulate_steps": 1, "schedule_mode": "1F1B",
+    },
+    "tensor_parallel": False,
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1},
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "localsgd": False,
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "lamb": False,
+    "lamb_configs": {"lamb_weight_decay": 0.01, "exclude_from_weight_decay": []},
+    "lars": False,
+    "lars_configs": {
+        "lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+        "epsilon": 0.0, "exclude_from_weight_decay": [],
+    },
+    "hybrid_configs": {
+        "dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sp_degree": 1,
+    },
+    "dgc": False,
+    "a_sync": False,
+    # parity-accepted, no-op on TPU (XLA owns comm fusion/scheduling)
+    "fuse_all_reduce_ops": True,
+    "fuse_grad_size_in_MB": 32,
+    "nccl_comm_num": 1,
+    "hierarchical_allreduce": False,
+    "find_unused_parameters": False,
+    "without_graph_optimization": False,
+    "last_comm_group_size_MB": 1,
+}
+
+
+class DistributedStrategy:
+    """reference: distributed_strategy.py:104."""
+
+    def __init__(self):
+        self.__dict__["_conf"] = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        conf = self.__dict__["_conf"]
+        if name in conf:
+            return conf[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        conf = self.__dict__["_conf"]
+        if name not in conf:
+            raise AttributeError(
+                f"DistributedStrategy has no field '{name}' "
+                f"(known: {sorted(conf)})"
+            )
+        if name.endswith("_configs"):
+            if not isinstance(value, dict):
+                raise TypeError(f"{name} expects a dict")
+            known = set(_DEFAULTS[name])
+            unknown = set(value) - known
+            if unknown:
+                # check_configs_key analog (distributed_strategy.py) —
+                # typos must not silently disable a parallelism mode
+                raise ValueError(
+                    f"unknown key(s) {sorted(unknown)} for {name}; "
+                    f"known: {sorted(known)}"
+                )
+            merged = dict(conf[name])
+            merged.update(value)
+            conf[name] = merged
+        else:
+            conf[name] = value
+
+    def to_dict(self):
+        return copy.deepcopy(self._conf)
+
+    # prototxt-shaped round trip (reference :145 save_to_prototxt /
+    # :163 load_from_prototxt) — json here, same contract.
+    def save_to_prototxt(self, output: str):
+        with open(output, "w") as f:
+            json.dump(self._conf, f, indent=2, sort_keys=True)
+
+    def load_from_prototxt(self, pb_file: str):
+        with open(pb_file) as f:
+            loaded = json.load(f)
+        for k, v in loaded.items():
+            if k not in self._conf:
+                continue
+            if k.endswith("_configs") and isinstance(v, dict):
+                merged = dict(self._conf[k])
+                merged.update(v)  # partial files keep defaults for the rest
+                self._conf[k] = merged
+            else:
+                self._conf[k] = v
+
+    def __repr__(self):
+        on = [k for k, v in self._conf.items()
+              if isinstance(v, bool) and v and k != "fuse_all_reduce_ops"]
+        return f"DistributedStrategy(enabled={on})"

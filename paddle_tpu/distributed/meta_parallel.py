@@ -1,0 +1,189 @@
+"""Tensor (model) parallel layers.
+
+Reference: python/paddle/distributed/collective.py:492 (`_parallel_linear`),
+:526 (`_parallel_embedding`), :566 (`split`) — weight-partitioned layers over
+a model-parallel NCCL ring with explicit c_allreduce/c_allgather calls.
+Tests: column_parallel_linear_api.py / row_parallel_linear_api.py /
+parallel_embedding_api.py.
+
+TPU-native: a partitioned weight is ONE logical parameter laid out sharded
+over the 'mp' mesh axis (each device stores 1/mp of it in HBM). The forward
+is the plain dense computation; XLA's sharding propagation inserts the
+all-reduce / all-gather exactly where the reference calls them explicitly,
+and fuses them with the matmuls. `gather_output` / `input_is_parallel`
+become output/input sharding constraints.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import XavierNormal
+from ..nn.layer import Layer
+from . import comm
+
+
+def _constrain(x: Tensor, mesh, spec) -> Tensor:
+    """Differentiable sharding constraint, usable eager and in-trace."""
+    sh = NamedSharding(mesh, spec)
+    return AG.apply(
+        lambda r: jax.lax.with_sharding_constraint(r, sh), (x,),
+        name="sharding_constraint",
+    )
+
+
+def _shard_param(p, mesh, spec):
+    p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+    p._tp_spec = spec  # consumed by fleet.distributed_model layout pass
+    return p
+
+
+class ColumnParallelLinear(Layer):
+    """Weight column-partitioned linear (collective.py:492, axis=1 path).
+
+    W: [in, out] sharded P(None, 'mp'); per-device block [in, out/mp].
+    gather_output=True replicates the output (reference: c_concat-style
+    allgather); False leaves it sharded on the feature axis for a following
+    RowParallelLinear.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, bias_attr=None,
+                 name=None):
+        super().__init__()
+        self.mesh = comm.mp_mesh()
+        mp = self.mesh.shape["mp"]
+        if out_features % mp != 0:
+            raise ValueError(
+                f"out_features={out_features} not divisible by mp={mp}"
+            )
+        self._in = in_features
+        self._out = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        _shard_param(self.weight, self.mesh, P(None, "mp"))
+        if has_bias and bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=bias_attr, is_bias=True
+            )
+            _shard_param(self.bias, self.mesh, P("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(out, self.mesh, P())
+        return _constrain(out, self.mesh, P(*([None] * (out.ndim - 1) + ["mp"])))
+
+
+class RowParallelLinear(Layer):
+    """Weight row-partitioned linear (collective.py:492, axis=0 path).
+
+    W: [in, out] sharded P('mp', None). With input_is_parallel the incoming
+    activation is already sharded on its feature axis (from a
+    gather_output=False column layer); the matmul's contraction produces
+    the partial sums whose all-reduce (reference: explicit c_allreduce_sum)
+    XLA inserts via propagation. Output replicated.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, bias_attr=None,
+                 name=None):
+        super().__init__()
+        self.mesh = comm.mp_mesh()
+        mp = self.mesh.shape["mp"]
+        if in_features % mp != 0:
+            raise ValueError(
+                f"in_features={in_features} not divisible by mp={mp}"
+            )
+        self._in = in_features
+        self._out = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        _shard_param(self.weight, self.mesh, P("mp", None))
+        if has_bias and bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=bias_attr, is_bias=True
+            )
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain(
+                x, self.mesh, P(*([None] * (x.ndim - 1) + ["mp"]))
+            )
+        out = F.linear(x, self.weight, self.bias)
+        return _constrain(out, self.mesh, P())
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab-partitioned embedding (collective.py:526 _parallel_embedding).
+
+    Weight [vocab, dim] sharded P('mp', None): each device stores a vocab
+    slice; the gather of looked-up rows (reference: masked local lookup +
+    c_allreduce_sum) is XLA's gather over the sharded operand.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.mesh = comm.mp_mesh()
+        mp = self.mesh.shape["mp"]
+        if num_embeddings % mp != 0:
+            raise ValueError(
+                f"num_embeddings={num_embeddings} not divisible by mp={mp}"
+            )
+        self._num = num_embeddings
+        self._dim = embedding_dim
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        _shard_param(self.weight, self.mesh, P("mp", None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, self.mesh, P())
+
+
+def split(x, size, operation: str, axis: int = 0, num_partitions: Optional[int] = None,
+          gather_out: bool = True, weight_attr=None, bias_attr=None,
+          name=None):
+    """paddle.distributed.split (collective.py:566): build-and-apply a
+    model-parallel layer. size=(in,out) for 'linear' (axis=0 row-, axis=1
+    column-parallel), (vocab,dim) for 'embedding'. Creates fresh parameters
+    per call — construct the *ParallelLinear layers directly inside models.
+    """
+    if operation == "linear":
+        if axis == 1:
+            layer = ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                bias_attr=bias_attr, gather_output=gather_out,
+            )
+        elif axis == 0:
+            layer = RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                bias_attr=bias_attr, input_is_parallel=not gather_out,
+            )
+        else:
+            raise ValueError("split(linear) axis must be 0 or 1")
+    elif operation == "embedding":
+        layer = VocabParallelEmbedding(
+            size[0], size[1], weight_attr=weight_attr
+        )
+    else:
+        raise ValueError(f"unknown split operation {operation!r}")
+    return layer(x)

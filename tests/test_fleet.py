@@ -1,0 +1,234 @@
+"""Fleet strategy surface + tensor parallelism on the 8-device CPU mesh.
+
+Test model: the reference TP API tests
+(unittests/column_parallel_linear_api.py, row_parallel_linear_api.py,
+parallel_embedding_api.py — parallel output vs dense output on shared
+weights) and the meta-optimizer compile-time tests (strategy config round
+trips). Ranks ≙ mesh devices (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.jit import TrainStep
+
+
+def _init_hybrid(dp=2, mp=4):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+class TestStrategy:
+    def test_defaults_and_merge(self):
+        s = DistributedStrategy()
+        assert s.amp is False
+        assert s.gradient_merge_configs["k_steps"] == 1
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 4}
+        assert s.gradient_merge_configs["k_steps"] == 4
+        assert s.gradient_merge_configs["avg"] is True  # merged, not replaced
+
+    def test_unknown_field_raises(self):
+        s = DistributedStrategy()
+        with pytest.raises(AttributeError):
+            s.no_such_flag = True
+
+    def test_prototxt_round_trip(self, tmp_path):
+        s = DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {"stage": 2}
+        f = str(tmp_path / "strategy.prototxt")
+        s.save_to_prototxt(f)
+        s2 = DistributedStrategy()
+        s2.load_from_prototxt(f)
+        assert s2.sharding is True
+        assert s2.sharding_configs["stage"] == 2
+
+
+class TestFleetInit:
+    def test_hybrid_topology(self):
+        _init_hybrid(dp=2, mp=4)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 4
+        assert hcg.get_pipe_parallel_world_size() == 1
+        assert fleet.worker_index() == 0
+        assert fleet.is_first_worker()
+
+    def test_pure_dp_defaults_to_all_devices(self):
+        fleet.init(is_collective=True)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_data_parallel_world_size() == 8
+
+    def test_ps_mode_rejected(self):
+        with pytest.raises(NotImplementedError):
+            fleet.init(is_collective=False)
+
+
+def _dense_like(parallel_layer, in_f, out_f):
+    """Dense Linear sharing the parallel layer's logical weights."""
+    dense = nn.Linear(in_f, out_f)
+    dense.weight.set_value(np.asarray(parallel_layer.weight._data))
+    if parallel_layer.bias is not None:
+        dense.bias.set_value(np.asarray(parallel_layer.bias._data))
+    return dense
+
+
+class TestTensorParallel:
+    def test_column_parallel_matches_dense(self):
+        _init_hybrid()
+        col = dist.ColumnParallelLinear(12, 16, gather_output=True)
+        dense = _dense_like(col, 12, 16)
+        x = paddle.to_tensor(np.random.rand(6, 12).astype(np.float32))
+        np.testing.assert_allclose(
+            col(x).numpy(), dense(x).numpy(), rtol=1e-5
+        )
+
+    def test_column_weight_actually_sharded(self):
+        _init_hybrid()
+        col = dist.ColumnParallelLinear(12, 16)
+        sh = col.weight._data.sharding
+        assert not sh.is_fully_replicated
+        # each device holds a [12, 16/4] block
+        shard_shapes = {
+            s.data.shape for s in col.weight._data.addressable_shards
+        }
+        assert shard_shapes == {(12, 4)}
+
+    def test_row_parallel_matches_dense(self):
+        _init_hybrid()
+        row = dist.RowParallelLinear(12, 5)
+        dense = _dense_like(row, 12, 5)
+        x = paddle.to_tensor(np.random.rand(6, 12).astype(np.float32))
+        np.testing.assert_allclose(
+            row(x).numpy(), dense(x).numpy(), rtol=1e-5
+        )
+        shard_shapes = {
+            s.data.shape for s in row.weight._data.addressable_shards
+        }
+        assert shard_shapes == {(3, 5)}
+
+    def test_megatron_mlp_col_then_row(self):
+        _init_hybrid()
+        col = dist.ColumnParallelLinear(8, 16, gather_output=False)
+        row = dist.RowParallelLinear(16, 8, input_is_parallel=True)
+        d1 = _dense_like(col, 8, 16)
+        d2 = _dense_like(row, 16, 8)
+        x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+        par = row(paddle.nn.functional.gelu(col(x)))
+        ref = d2(paddle.nn.functional.gelu(d1(x)))
+        np.testing.assert_allclose(par.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_vocab_parallel_embedding(self):
+        _init_hybrid()
+        emb = dist.VocabParallelEmbedding(16, 6)
+        dense = nn.Embedding(16, 6)
+        dense.weight.set_value(np.asarray(emb.weight._data))
+        ids = paddle.to_tensor(
+            np.random.randint(0, 16, (3, 5)).astype(np.int64)
+        )
+        np.testing.assert_allclose(
+            emb(ids).numpy(), dense(ids).numpy(), rtol=1e-6
+        )
+        shard_shapes = {
+            s.data.shape for s in emb.weight._data.addressable_shards
+        }
+        assert shard_shapes == {(4, 6)}
+
+    def test_split_api(self):
+        _init_hybrid()
+        x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+        out = dist.split(x, size=(8, 12), operation="linear", axis=1)
+        assert out.shape == [2, 12]
+        ids = paddle.to_tensor(np.array([[1, 2]], np.int64))
+        out2 = dist.split(ids, size=(8, 4), operation="embedding")
+        assert out2.shape == [1, 2, 4]
+
+    def test_not_divisible_raises(self):
+        _init_hybrid()
+        with pytest.raises(ValueError, match="divisible"):
+            dist.ColumnParallelLinear(8, 10)  # 10 % 4 != 0
+
+    def test_tp_backward_grads_flow(self):
+        _init_hybrid()
+        col = dist.ColumnParallelLinear(8, 12)
+        x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+        loss = col(x).sum()
+        loss.backward()
+        assert col.weight.grad is not None
+        assert list(col.weight.grad.shape) == [8, 12]
+
+
+class _TPNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.col = dist.ColumnParallelLinear(10, 16, gather_output=False)
+        self.row = dist.RowParallelLinear(16, 4, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.row(paddle.nn.functional.relu(self.col(x)))
+
+
+class _DenseNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(10, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+class TestFleetE2E:
+    def test_tp_training_matches_dense(self):
+        """Hybrid dp2 x mp4 TP training == single-device dense training."""
+        _init_hybrid(dp=2, mp=4)
+        paddle.seed(7)
+        tp = _TPNet()
+        dense = _DenseNet()
+        dense.fc1.weight.set_value(np.asarray(tp.col.weight._data))
+        dense.fc1.bias.set_value(np.asarray(tp.col.bias._data))
+        dense.fc2.weight.set_value(np.asarray(tp.row.weight._data))
+        dense.fc2.bias.set_value(np.asarray(tp.row.bias._data))
+
+        model = fleet.distributed_model(tp)
+        opt = fleet.distributed_optimizer(
+            optimizer.Momentum(learning_rate=0.05, parameters=tp.parameters())
+        )
+        opt_d = optimizer.Momentum(
+            learning_rate=0.05, parameters=dense.parameters()
+        )
+        loss_fn = lambda out, y: paddle.nn.functional.cross_entropy(out, y)  # noqa: E731
+        step_tp = TrainStep(model, loss_fn, opt._inner)
+        step_d = TrainStep(dense, loss_fn, opt_d)
+
+        rng = np.random.RandomState(5)
+        for _ in range(3):
+            x = rng.rand(8, 10).astype(np.float32)
+            y = rng.randint(0, 4, (8,)).astype(np.int64)
+            lt = step_tp(model.shard_input(x), model.shard_input(y))
+            ld = step_d(x, y)
+            np.testing.assert_allclose(
+                float(lt.numpy()), float(ld.numpy()), rtol=2e-5
+            )
+        np.testing.assert_allclose(
+            np.asarray(tp.col.weight._data), dense.fc1.weight.numpy(),
+            rtol=1e-4, atol=1e-6,
+        )
+
+    def test_distributed_optimizer_carries_strategy(self):
+        s = _init_hybrid()
+        opt = fleet.distributed_optimizer(
+            optimizer.Adam(parameters=_DenseNet().parameters())
+        )
+        assert opt.user_defined_strategy is s
+        assert hasattr(opt, "minimize")
