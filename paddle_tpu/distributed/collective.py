@@ -164,10 +164,11 @@ def all_reduce(tensor, op: int = ReduceOp.SUM, group=None,
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
 
-        return AG.apply(
+        out = AG.apply(
             lambda x: _psum_like(x, g.axis_name, op), (_as_t(tensor),),
             name="c_allreduce",
         )
+        return _write_back(tensor, out)
     t = _as_t(tensor)
     t._data = _allreduce_prog(g.id, op)(_ranked(t, g))
     t._node = None
@@ -186,7 +187,8 @@ def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM, group=None,
             i = jax.lax.axis_index(g.axis_name)
             return jnp.where(i == dst, r, x)
 
-        return AG.apply(f, (_as_t(tensor),), name="c_reduce")
+        return _write_back(tensor, AG.apply(f, (_as_t(tensor),),
+                                            name="c_reduce"))
     t = _as_t(tensor)
     t._data = _reduce_prog(g.id, op, dst)(_ranked(t, g))
     t._node = None
@@ -232,7 +234,8 @@ def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True,
             full = jax.lax.all_gather(x, g.axis_name, axis=0, tiled=False)
             return full[src]
 
-        return AG.apply(f, (_as_t(tensor),), name="c_broadcast")
+        return _write_back(tensor, AG.apply(f, (_as_t(tensor),),
+                                            name="c_broadcast"))
     t = _as_t(tensor)
     t._data = _broadcast_prog(g.id, src)(_ranked(t, g))
     t._node = None
@@ -248,14 +251,18 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op: int = ReduceOp.SUM,
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
 
-        return AG.apply(
-            lambda x: jax.lax.psum_scatter(
-                x, g.axis_name, scatter_dimension=0, tiled=True
-            ) if op == ReduceOp.SUM else _psum_like(
-                x, g.axis_name, op
-            ).reshape(g.nranks, -1)[jax.lax.axis_index(g.axis_name)],
-            (_as_t(src),), name="c_reducescatter",
-        )
+        def f(x):
+            if op == ReduceOp.SUM:
+                return jax.lax.psum_scatter(
+                    x, g.axis_name, scatter_dimension=0, tiled=True
+                )
+            r = _psum_like(x, g.axis_name, op)
+            i = jax.lax.axis_index(g.axis_name)
+            chunk = r.shape[0] // g.nranks
+            return jax.lax.dynamic_slice_in_dim(r, i * chunk, chunk, 0)
+
+        return _write_back(src, AG.apply(f, (_as_t(src),),
+                                         name="c_reducescatter"))
     t = _as_t(src)
     out_raw = _reduce_scatter_prog(g.id, op)(_ranked(t, g))
     out = Tensor._wrap(out_raw)
@@ -321,6 +328,16 @@ def barrier(group=None):
 
 def _as_t(x) -> Tensor:
     return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _write_back(orig, out: Tensor) -> Tensor:
+    """Honor the paddle in-place collective contract in spmd regions: the
+    caller's tensor must carry the result (they may keep using `orig`)."""
+    if isinstance(orig, Tensor) and orig is not out:
+        orig._data = out._data
+        orig._node = out._node
+        orig._out_idx = out._out_idx
+    return orig if isinstance(orig, Tensor) else out
 
 
 def _ranked(t: Tensor, g: Group):

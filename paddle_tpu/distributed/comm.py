@@ -35,9 +35,16 @@ except ImportError:  # older jax
 
 
 def shard_map(f, mesh, in_specs, out_specs):
-    return _shard_map(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
-    )
+    try:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:  # pre-0.9 jax: the flag was called check_rep
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
 
 
 class Group:

@@ -66,26 +66,186 @@ class HybridCommunicateGroup:
 
 
 class _DistributedOptimizer:
-    """Strategy-carrying optimizer wrapper (fleet_base.py:598
-    distributed_optimizer / :1070 minimize)."""
+    """Strategy-composing optimizer wrapper (fleet_base.py:598
+    distributed_optimizer / :1070 minimize + the meta-optimizer chain).
+
+    Where the reference rewrites the program per strategy
+    (sharding_optimizer.py:33 prunes non-owned states and inserts
+    broadcast/allreduce; fluid/optimizer.py:5402 GradientMerge builds a
+    cond-guarded update block), here each strategy composes into the pure
+    update that the fused TrainStep traces:
+      * sharding (ZeRO): optimizer-state (stage>=1), grad (stage>=2) and
+        param (stage 3) leaves get sharding constraints over the 'dp' axis
+        — XLA partitions storage and inserts the gather on use.
+      * gradient_merge: a grad-accumulator buffer + counter ride in the
+        functional state; the inner update applies every k-th step under
+        jnp.where selection.
+    The eager step() path honors gradient_merge by skipping inner.step()
+    on non-boundary steps (grads keep accumulating on .grad).
+    """
 
     def __init__(self, optimizer, strategy: DistributedStrategy):
-        self._inner = optimizer
-        self.user_defined_strategy = strategy
+        object.__setattr__(self, "_inner", optimizer)
+        object.__setattr__(self, "user_defined_strategy", strategy)
+        object.__setattr__(self, "_gm_calls", 0)
 
     def __getattr__(self, name):
-        return getattr(self._inner, name)
+        return getattr(object.__getattribute__(self, "_inner"), name)
 
+    def __setattr__(self, name, value):
+        if name in ("_inner", "user_defined_strategy", "_gm_calls"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._inner, name, value)  # e.g. _step_count, _lr
+
+    # -- strategy pieces -----------------------------------------------------
+    @property
+    def _gm_k(self) -> int:
+        s = self.user_defined_strategy
+        return int(s.gradient_merge_configs["k_steps"]) if s.gradient_merge \
+            else 1
+
+    @property
+    def _gm_avg(self) -> bool:
+        return bool(self.user_defined_strategy.gradient_merge_configs["avg"])
+
+    def _zero_constrain(self, x, force=False):
+        """Shard a state leaf's leading axis over dp when divisible."""
+        mesh = comm.hybrid_mesh()
+        if mesh is None:
+            return x
+        dp = mesh.shape["dp"]
+        if x.ndim == 0 or x.shape[0] % dp != 0:
+            return x
+        spec = P(*(["dp"] + [None] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    @property
+    def _sharding_stage(self) -> int:
+        s = self.user_defined_strategy
+        return int(s.sharding_configs["stage"]) if s.sharding else 0
+
+    # -- functional path hooks (consumed by jit.TrainStep) -------------------
+    def _functional_state(self, params):
+        state = self._inner._functional_state(params)
+        if self._gm_k > 1:
+            import jax.numpy as jnp
+
+            if "@gm_buf" not in self._inner._accumulators:
+                self._inner._accumulators["@gm_buf"] = {}
+            buf_store = self._inner._accumulators["@gm_buf"]
+            bufs = []
+            for p in params:
+                if id(p) not in buf_store:
+                    buf_store[id(p)] = jnp.zeros_like(p._data)
+                bufs.append(buf_store[id(p)])
+            state["@gm_buf"] = tuple(bufs)
+            state["@gm_cnt"] = jnp.asarray(self._gm_calls, jnp.int32)
+        return state
+
+    def _load_functional_state(self, params, state):
+        state = dict(state)
+        if "@gm_buf" in state:
+            buf_store = self._inner._accumulators.setdefault("@gm_buf", {})
+            for p, v in zip(params, state.pop("@gm_buf")):
+                buf_store[id(p)] = v
+            self._gm_calls = int(state.pop("@gm_cnt"))
+            # TrainStep's opt._step_count counts micro-steps; the inner
+            # optimizer's public count is applied updates
+            self._inner._step_count = self._gm_calls // self._gm_k
+        self._inner._load_functional_state(params, state)
+
+    def _functional_update(self, params, p_raws, g_raws, state, lr, t):
+        import jax.numpy as jnp
+
+        stage = self._sharding_stage
+        k = self._gm_k
+        state = dict(state)
+        gm_buf = state.pop("@gm_buf", None)
+        gm_cnt = state.pop("@gm_cnt", None)
+
+        if stage >= 2:
+            g_raws = [g if g is None else self._zero_constrain(g)
+                      for g in g_raws]
+
+        if k > 1:
+            new_buf = [
+                b if g is None else b + g for b, g in zip(gm_buf, g_raws)
+            ]
+            boundary = (gm_cnt + 1) % k == 0
+            scale = 1.0 / k if self._gm_avg else 1.0
+            merged = [
+                None if g is None else (b * scale).astype(b.dtype)
+                for g, b in zip(g_raws, new_buf)
+            ]
+            # inner step count = APPLIED updates, not micro-steps, so
+            # Adam-family bias correction matches the eager path (which
+            # calls inner.step() only at boundaries)
+            t_inner = ((gm_cnt + 1) // k).astype(t.dtype)
+            new_p, new_state = self._inner._functional_update(
+                params, p_raws, merged, state, lr, t_inner
+            )
+            # select: params/state advance only at the boundary; the buffer
+            # resets there (cond-guarded block analog, optimizer.py:5402)
+            new_p = tuple(
+                jnp.where(boundary, np_, p_)
+                for np_, p_ in zip(new_p, p_raws)
+            )
+            new_state = {
+                name: tuple(
+                    jnp.where(boundary, nv, ov)
+                    for nv, ov in zip(new_state[name], state[name])
+                )
+                for name in new_state
+            }
+            new_buf = [
+                jnp.where(boundary, jnp.zeros_like(b), b) for b in new_buf
+            ]
+            new_state["@gm_buf"] = tuple(new_buf)
+            new_state["@gm_cnt"] = gm_cnt + 1
+        else:
+            new_p, new_state = self._inner._functional_update(
+                params, p_raws, g_raws, state, lr, t
+            )
+
+        if stage >= 1:
+            new_state = {
+                name: tuple(self._zero_constrain(v) for v in vals)
+                if isinstance(vals, tuple) else vals  # @gm_cnt scalar rides
+                for name, vals in new_state.items()
+            }
+        if stage >= 3:
+            new_p = tuple(self._zero_constrain(v) for v in new_p)
+        return new_p, new_state
+
+    # -- eager path ----------------------------------------------------------
     def step(self):
+        k = self._gm_k
+        if k > 1:
+            self._gm_calls += 1
+            if self._gm_calls % k != 0:
+                return  # keep accumulating on .grad (paddle dygraph accum)
+            if self._gm_avg:
+                for p in self._inner._get_params():
+                    if p.grad is not None:
+                        p.grad._data = p.grad._data / k
+            out = self._inner.step()
+            self._inner.clear_grad()
+            return out
         return self._inner.step()
 
     def clear_grad(self):
+        if self._gm_k > 1 and self._gm_calls % self._gm_k != 0:
+            return  # mid-merge: grads must survive across steps
         return self._inner.clear_grad()
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        return self._inner.minimize(loss, startup_program, parameters,
-                                    no_grad_set)
+        if parameters is not None:
+            self._inner._parameter_list = list(parameters)
+        loss.backward()
+        self.step()
+        return None, None
 
 
 class Fleet:
@@ -202,15 +362,9 @@ class Fleet:
                 return self._layers(*a, **kw)
 
             def shard_input(self, x):
-                raw = x._data if isinstance(x, Tensor) else None
-                if raw is None:
-                    import jax.numpy as jnp
+                from ..parallel import shard_batch
 
-                    raw = jnp.asarray(x)
-                sharded = jax.device_put(
-                    raw, NamedSharding(outer._hcg.mesh, P("dp"))
-                )
-                return Tensor._wrap(sharded, stop_gradient=True)
+                return shard_batch(x, outer._hcg.mesh, "dp")
 
             def state_dict(self, destination=None, include_sublayers=True,
                            prefix=""):

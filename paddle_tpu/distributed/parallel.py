@@ -25,6 +25,16 @@ from ..nn.layer import Layer
 from . import comm
 
 
+def shard_batch(x, mesh, axis_name: str = "dp") -> Tensor:
+    """Lay a global batch out sharded over `axis_name` on its leading dim —
+    the one input-sharding helper every data-parallel surface uses."""
+    raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._wrap(
+        jax.device_put(raw, NamedSharding(mesh, P(axis_name))),
+        stop_gradient=True,
+    )
+
+
 class DataParallel(Layer):
     """Wrap a Layer for data-parallel training (parallel.py:322 parity).
 
@@ -60,11 +70,7 @@ class DataParallel(Layer):
 
     def shard_input(self, x):
         """Shard a global batch on the dp axis (leading dim)."""
-        raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-        sharded = jax.device_put(
-            raw, NamedSharding(self.group.mesh, P(self.group.axis_name))
-        )
-        return Tensor._wrap(sharded, stop_gradient=True)
+        return shard_batch(x, self.group.mesh, self.group.axis_name)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

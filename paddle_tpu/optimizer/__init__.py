@@ -8,6 +8,8 @@ from .optimizer import (  # noqa: F401
     Adamax,
     AdamW,
     Lamb,
+    Lars,
+    LarsMomentum,
     Momentum,
     Optimizer,
     RMSProp,

@@ -22,7 +22,7 @@ from .lr import LRScheduler
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
-    "Adadelta", "RMSProp", "Lamb",
+    "Adadelta", "RMSProp", "Lamb", "Lars",
 ]
 
 
@@ -300,6 +300,19 @@ def _adamax_rule(p, g, m, u, lr, beta1, beta2, eps, t):
     m_new = beta1 * m + (1 - beta1) * g
     u_new = jnp.maximum(beta2 * u, jnp.abs(g))
     return p - lr / (1 - beta1**t) * m_new / (u_new + eps), m_new, u_new
+
+
+@_jit_rule
+def _lars_rule(p, g, v, lr, mu, coeff, wd, eps):
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr,
+    )
+    v_new = mu * v + local_lr * (g + wd * p)
+    return p - v_new, v_new
 
 
 @_jit_rule
@@ -625,3 +638,54 @@ class Lamb(Optimizer):
             jnp.asarray(self._epsilon, d), t, jnp.asarray(wd, d),
         )
         return new_p, {"moment1": m_new, "moment2": v_new}
+
+
+class Lars(Optimizer):
+    """LARS momentum — layer-adaptive rate scaling for large-batch training.
+
+    reference: paddle/fluid/operators/optimizers/lars_momentum_op.cu +
+    fleet/meta_optimizers/lars_optimizer.py:19 (trust ratio
+    ||p|| / (||g|| + wd*||p||) scales the lr per layer).
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _wd_for(self, p):
+        name = p.name or ""
+        if any(tag in name for tag in self._exclude):
+            return 0.0
+        return self._wd
+
+    def _apply_one(self, p, g, lr):
+        v = self._acc("velocity", p)
+        d = p._data.dtype
+        p._data, v_new = _lars_rule(
+            p._data, g, v, jnp.asarray(lr, d),
+            jnp.asarray(self._momentum, d), jnp.asarray(self._coeff, d),
+            jnp.asarray(self._wd_for(p), d),
+            jnp.asarray(self._epsilon or 1e-9, d),
+        )
+        self._set_acc("velocity", p, v_new)
+
+    _acc_tree_names = ("velocity",)
+
+    def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
+        d = p_raw.dtype
+        new_p, v_new = _lars_rule(
+            p_raw, g_raw, accs["velocity"], lr,
+            jnp.asarray(self._momentum, d), jnp.asarray(self._coeff, d),
+            jnp.asarray(self._wd_for(p), d),
+            jnp.asarray(self._epsilon or 1e-9, d),
+        )
+        return new_p, {"velocity": v_new}
+
+
+LarsMomentum = Lars

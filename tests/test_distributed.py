@@ -150,6 +150,32 @@ class TestCollectives:
         )
 
 
+    def test_spmd_region_inplace_contract(self):
+        """Paddle collectives are in-place: statement-form all_reduce must
+        leave the result on the caller's tensor."""
+        from jax.sharding import PartitionSpec as P
+
+        g = dist.get_group(0)
+        x = _per_rank((3,), seed=8)
+
+        def rank_fn(xr):
+            with dist.spmd_region(g.axis_name):
+                t = paddle.Tensor._wrap(xr)
+                dist.all_reduce(t)  # no assignment — reference style
+                return t._data
+
+        f = jax.jit(
+            dist.comm.shard_map(
+                rank_fn, g.mesh, in_specs=P(g.axis_name),
+                out_specs=P(g.axis_name),
+            )
+        )
+        got = np.asarray(f(jnp.asarray(x)))
+        np.testing.assert_allclose(
+            got, np.broadcast_to(x.sum(0), x.shape), rtol=1e-6
+        )
+
+
 class _SmallNet(nn.Layer):
     def __init__(self):
         super().__init__()
