@@ -1,13 +1,26 @@
 """Driver benchmark: prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}.
 
-Current benchmark: LeNet-5 MNIST-shape training throughput on the real chip
-(BASELINE.json config 1), using the jit-compiled train step (the framework's
-intended hot path). vs_baseline is against BASELINE.json's published numbers
-— the reference publishes none (BASELINE.md), so the recorded value IS the
-baseline going forward; vs_baseline reports 1.0.
+Benchmarks the framework's REAL hot path — `paddle_tpu.jit.TrainStep`
+(forward + loss + backward + framework optimizer fused into one donated XLA
+program; the analog of the reference's generated `core.ops` bindings +
+run_program op, pybind/op_function_generator.cc:488) — exactly the harness
+`__graft_entry__.dryrun_multichip` drives on the virtual mesh.
 
-Upgraded across rounds toward ResNet-50/BERT throughput per BASELINE.json.
+Headline metric stays `lenet_mnist_train_imgs_per_sec` for cross-round
+comparability (BENCH_r01–r03); `extra` carries the ResNet-50 synthetic
+throughput (BASELINE.json config 2) and a per-model step-time breakdown.
+
+Why rounds 1–3 read ~660–724 imgs/sec (~354 ms/step): the old bench
+updated params with an EAGER `tree_map(p - lr*g)` outside jit — 8 separate
+device-program launches per step, each paying the tunnel's host->device
+round-trip latency, serialized against the grad program. TrainStep issues
+ONE async program per step with donated buffers, so steps pipeline and the
+tunnel latency amortizes away.
+
+vs_baseline: BASELINE.json publishes no reference numbers (BASELINE.md), so
+the recorded value IS the baseline (1.0); extra.vs_r02 carries the ratio
+against round 2's 663.6 on the same metric.
 """
 import json
 import time
@@ -15,66 +28,98 @@ import time
 import numpy as np
 
 
-def main():
+def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label):
+    """Time `steps` TrainStep calls (one donated XLA program each), async-
+    dispatched, single block at the end. Returns (imgs/sec, breakdown)."""
     import jax
-    import jax.numpy as jnp
 
     import paddle_tpu as paddle
-    from paddle_tpu import nn, optimizer
-    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
 
     paddle.seed(0)
-    batch = 256
-    model = LeNet()
-    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model = model_fn()
+    opt = opt_fn(model)
+    step = TrainStep(
+        model, lambda out, y: nn.functional.cross_entropy(out, y), opt
+    )
 
-    params = {k: v for k, v in model.state_dict().items()}
-    x_np = np.random.rand(batch, 1, 28, 28).astype(np.float32)
-    y_np = (np.arange(batch) % 10).astype(np.int32)
+    # stage the batch in HBM once (DataLoader's double-buffer analog,
+    # operators/reader/buffered_reader.cc) — the tunnel's host->device
+    # bandwidth must not be inside the timed loop
+    import jax.numpy as jnp
 
-    # jit the whole train step over raw arrays: functional forward via the
-    # layer with params swapped (the to_static hot path, built in stage 3 —
-    # here inlined so the bench exists from round 1).
-    from paddle_tpu.core import autograd as AG
-    from paddle_tpu.core.tensor import Tensor
+    x = jax.device_put(
+        jnp.asarray(np.random.rand(batch, *x_shape).astype(np.float32))
+    )
+    y = jax.device_put(jnp.asarray((np.arange(batch) % y_classes).astype(np.int32)))
+    jax.block_until_ready(x)
 
-    param_list = list(model.named_parameters())
+    t0 = time.perf_counter()
+    loss = step(x, y)  # compile + first step
+    jax.block_until_ready(loss._data)
+    compile_s = time.perf_counter() - t0
 
-    def loss_fn(param_raws, xr, yr):
-        with AG.trace_mode():
-            for (name, p), raw in zip(param_list, param_raws):
-                p._data = raw
-            logits = model(Tensor._wrap(xr))
-            loss = paddle.nn.functional.cross_entropy(
-                logits, Tensor._wrap(yr)
-            )
-            return loss._data
-
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
-
-    raws = [p._data for _, p in param_list]
-    x, y = jnp.asarray(x_np), jnp.asarray(y_np)
-
-    # warmup/compile
-    loss, grads = grad_fn(raws, x, y)
-    jax.block_until_ready(loss)
-
-    steps = 30
+    # steady state: async dispatch, one block at the end -> steps pipeline
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss, grads = grad_fn(raws, x, y)
-        raws = jax.tree_util.tree_map(lambda p, g: p - 1e-3 * g, raws, grads)
-    jax.block_until_ready(loss)
+        loss = step(x, y)
+    jax.block_until_ready(loss._data)
     dt = time.perf_counter() - t0
 
-    imgs_per_sec = steps * batch / dt
+    # one blocked step isolates device time from host dispatch overhead
+    t0 = time.perf_counter()
+    jax.block_until_ready(step(x, y)._data)
+    blocked_ms = (time.perf_counter() - t0) * 1e3
+
+    step_ms = dt / steps * 1e3
+    return steps * batch / dt, {
+        f"{label}_step_ms": round(step_ms, 2),
+        f"{label}_blocked_step_ms": round(blocked_ms, 2),
+        f"{label}_compile_s": round(compile_s, 1),
+    }
+
+
+def main():
+    from paddle_tpu import optimizer
+    from paddle_tpu.vision.models import LeNet, resnet50
+
+    extra = {}
+
+    lenet_ips, bd = _bench_train(
+        LeNet,
+        lambda m: optimizer.Adam(
+            learning_rate=1e-3, parameters=m.parameters()
+        ),
+        (1, 28, 28), 10, batch=256, steps=50, label="lenet",
+    )
+    extra.update(bd)
+
+    r50_ips, bd = _bench_train(
+        lambda: resnet50(num_classes=1000),
+        lambda m: optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9, parameters=m.parameters()
+        ),
+        (3, 224, 224), 1000, batch=64, steps=20, label="resnet50",
+    )
+    extra.update(bd)
+    extra["resnet50_synthetic_imgs_per_sec"] = round(r50_ips, 1)
+    extra["vs_r02"] = round(lenet_ips / 663.6, 1)
+    extra["note"] = (
+        "TrainStep hot path (fused fwd+bwd+opt, donated, device-staged "
+        "inputs); r1-r3's ~354ms LeNet step was the eager per-param "
+        "tree_map update: 8 device-program launches/step, each paying the "
+        "tunnel round-trip, serialized against the grad program"
+    )
+
     print(
         json.dumps(
             {
                 "metric": "lenet_mnist_train_imgs_per_sec",
-                "value": round(imgs_per_sec, 1),
+                "value": round(lenet_ips, 1),
                 "unit": "imgs/sec",
                 "vs_baseline": 1.0,
+                "extra": extra,
             }
         )
     )
