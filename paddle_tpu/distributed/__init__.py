@@ -32,6 +32,7 @@ from .collective import (  # noqa: F401
     scatter,
 )
 from .parallel import DataParallel  # noqa: F401
+from .pipeline import PipelineLayer, PipelineParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear,

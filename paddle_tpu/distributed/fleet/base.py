@@ -343,6 +343,25 @@ class Fleet:
         replicates; inputs shard over 'dp' via .shard_input."""
         self._require_init()
         mesh = self._hcg.mesh
+        from ..pipeline import PipelineLayer, PipelineParallel
+
+        if isinstance(model, PipelineLayer):
+            if mesh.shape["pp"] == 1:
+                raise ValueError(
+                    "PipelineLayer needs hybrid_configs pp_degree > 1"
+                )
+            return PipelineParallel(
+                model, mesh=mesh,
+                accumulate_steps=int(
+                    self._strategy.pipeline_configs["accumulate_steps"]
+                ),
+            )
+        if mesh.shape["pp"] > 1:
+            raise ValueError(
+                "pp_degree > 1 requires the model to be a "
+                "distributed.PipelineLayer (stage partition; the "
+                "device_guard analog)"
+            )
         for p in model.parameters():
             spec = getattr(p, "_tp_spec", None)
             if spec is not None:
