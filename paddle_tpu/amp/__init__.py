@@ -122,6 +122,26 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     return models, optimizers
 
 
+def _unscale_rule(gs, s):
+    out = tuple(g / s.astype(g.dtype) for g in gs)
+    finite = jnp.all(jnp.stack([jnp.isfinite(g).all() for g in out]))
+    return out, ~finite
+
+
+_unscale_jitted = None
+
+
+def _unscale_fused(grads, scale):
+    """One compiled program: g/scale for every grad + a single fused
+    finiteness reduction (cached per grad-shape structure by jax.jit)."""
+    global _unscale_jitted
+    if _unscale_jitted is None:
+        import jax
+
+        _unscale_jitted = jax.jit(_unscale_rule)
+    return _unscale_jitted(grads, jnp.asarray(scale, jnp.float32))
+
+
 class GradScaler:
     """Dynamic loss scaling (grad_scaler.py:20 / AmpScaler loss_scaler.py:27).
 
@@ -152,18 +172,22 @@ class GradScaler:
     def unscale_(self, optimizer):
         """check_finite_and_unscale analog (operators/amp/
         check_finite_and_unscale_op.cc): divide grads by scale, flag
-        non-finite."""
+        non-finite — ONE fused program over all grads and a single
+        device->host sync, like the reference's single kernel over the
+        whole grad list (not one launch + sync per parameter)."""
         if not self._enable:
             return
-        found = False
+        grads = [p.grad._data for p in optimizer._get_params()
+                 if p.grad is not None]
+        if not grads:
+            self._found_inf = False
+            return
+        new_grads, found = _unscale_fused(tuple(grads), self._scale)
+        it = iter(new_grads)
         for p in optimizer._get_params():
-            if p.grad is None:
-                continue
-            g = p.grad._data / self._scale
-            if not bool(jnp.all(jnp.isfinite(g))):
-                found = True
-            p.grad._data = g
-        self._found_inf = found
+            if p.grad is not None:
+                p.grad._data = next(it)
+        self._found_inf = bool(found)
 
     def step(self, optimizer):
         """Skip the update on inf/nan; update the scale (AmpScaler.minimize

@@ -401,9 +401,66 @@ class Fleet:
         return _FleetModel(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        """Compose the strategy into the optimizer (fleet_base.py:598 +
+        the meta-optimizer chain :1150-1181). Every flag is either real —
+        it changes the update/step — or raises; nothing is silently
+        dropped (strategy_compiler.py:171 behavior, made loud)."""
         self._require_init()
         if strategy is not None:
             self._strategy = strategy
+        s = self._strategy
+        if s.dgc:
+            raise NotImplementedError(
+                "dgc (top-k sparsified allreduce) is not built; the TPU "
+                "analog would be a quantized allreduce (SURVEY.md §2.9)"
+            )
+        if s.a_sync:
+            raise NotImplementedError(
+                "a_sync is parameter-server mode — out of the TPU scope"
+            )
+        from ...optimizer import Adam, AdamW, Lamb, Lars, Momentum
+
+        if s.lamb:
+            # LambOptimizer meta (_can_apply: inner must be Adam-family,
+            # fleet/meta_optimizers/lamb_optimizer.py:20)
+            if not isinstance(optimizer, (Adam, AdamW)):
+                raise ValueError(
+                    "strategy.lamb swaps an Adam/AdamW inner optimizer for "
+                    f"Lamb; got {type(optimizer).__name__}"
+                )
+            cfg = s.lamb_configs
+            excl = list(cfg["exclude_from_weight_decay"])
+            optimizer = Lamb(
+                learning_rate=optimizer._lr,
+                lamb_weight_decay=float(cfg["lamb_weight_decay"]),
+                beta1=optimizer._beta1, beta2=optimizer._beta2,
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
+                exclude_from_weight_decay_fn=(
+                    (lambda p: any(tag in (p.name or "") for tag in excl))
+                    if excl else None
+                ),
+            )
+        elif s.lars:
+            # lars_optimizer.py:19 (_can_apply: inner must be Momentum)
+            if not isinstance(optimizer, Momentum):
+                raise ValueError(
+                    "strategy.lars swaps a Momentum inner optimizer for "
+                    f"Lars; got {type(optimizer).__name__}"
+                )
+            cfg = s.lars_configs
+            optimizer = Lars(
+                learning_rate=optimizer._lr,
+                momentum=optimizer._momentum,
+                lars_coeff=float(cfg["lars_coeff"]),
+                lars_weight_decay=float(cfg["lars_weight_decay"]),
+                epsilon=float(cfg["epsilon"]),
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
+                exclude_from_weight_decay=list(
+                    cfg["exclude_from_weight_decay"]
+                ),
+            )
         return _DistributedOptimizer(optimizer, self._strategy)
 
 

@@ -1,0 +1,207 @@
+"""LocalSGD: per-worker local updates + periodic parameter averaging.
+
+Reference: fleet/meta_optimizers/localsgd_optimizer.py:23 (LocalSGD) — each
+worker steps independently and every `k_steps` the workers average their
+parameters (c_allreduce_sum / nranks), replacing the per-step gradient
+all-reduce (:194 builds the averaging comm block).
+
+TPU-native: divergent per-worker parameters are a leading `dp` axis on
+every param/state leaf, sharded over the mesh's dp axis; ONE compiled
+shard_map program runs the local forward/backward/update per worker slice
+and a `lax.pmean` over 'dp', selected by a traced `sync` flag, implements
+the periodic averaging. The host never materializes per-worker copies.
+
+Reached through the standard hot path: `jit.TrainStep(model, loss, opt)`
+delegates here when `opt.user_defined_strategy.localsgd` is on.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core import autograd as AG
+from ...core.tensor import Tensor
+from ...jit.functional_call import _swapped
+from ...nn.layer import Layer
+from .. import comm
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class LocalSGDStep:
+    """Compiled LocalSGD train step (localsgd_optimizer.py:23 analog).
+
+    `optimizer` may be the fleet wrapper; only its inner pure update rule
+    is used (LocalSGD owns the comm schedule). Parameters diverge across
+    the dp axis between syncs; `model.state_dict()` is wrapped at
+    construction to call `sync_to_model()` first, so checkpoints always
+    see the averaged weights.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, *,
+                 k_steps: int = 1, begin_step: int = 1,
+                 grad_post_hook: Callable = None):
+        mesh = comm.hybrid_mesh()
+        if mesh is not None and any(
+            mesh.shape[a] != 1 for a in ("mp", "pp", "sp")
+        ):
+            raise NotImplementedError(
+                "localsgd composes with pure data parallelism only"
+            )
+        group = comm._default_group()
+        self.mesh = group.mesh
+        self.axis = group.axis_name
+        self.dp = group.nranks
+        self.model = model
+        self.loss_fn = loss_fn
+        self.opt = optimizer
+        self._inner = getattr(optimizer, "_inner", optimizer)
+        self.k_steps = int(k_steps)
+        self.begin_step = int(begin_step)
+        self._grad_post_hook = grad_post_hook
+        self._p_objs = [p for p in self._inner._get_params() if p.trainable]
+        b_named = dict(model.named_buffers())
+        self._b_objs = list(b_named.values())
+        stack = lambda r: jax.device_put(
+            jnp.broadcast_to(r[None], (self.dp,) + r.shape),
+            NamedSharding(self.mesh, P(self.axis)),
+        )
+        self._stk_p = [stack(p._data) for p in self._p_objs]
+        self._stk_b = [stack(b._data) for b in self._b_objs]
+        state = self._inner._functional_state(self._p_objs)
+        self._stk_state = {
+            name: tuple(stack(v) for v in vals)
+            for name, vals in state.items()
+        }
+        # sync is STATIC (host-known): two cached compilations, and the
+        # non-sync program contains NO collective at all — the whole point
+        # of LocalSGD's reduced communication
+        self._jitted = jax.jit(self._step_fn, static_argnums=7)
+        self._dirty = False
+        # checkpoint consumers must see averaged weights: state_dict pulls
+        # the replicas back into the Layer first
+        orig_state_dict = model.state_dict
+
+        def _synced_state_dict(*a, **kw):
+            self.sync_to_model()
+            return orig_state_dict(*a, **kw)
+
+        model.state_dict = _synced_state_dict
+
+    # -- the pure spmd program ----------------------------------------------
+    def _step_fn(self, stk_p, stk_state, stk_b, in_raws, label_raws, lr, t,
+                 sync):
+        spec_of = lambda tree: jax.tree_util.tree_map(
+            lambda _: P(self.axis), tree
+        )
+        f = comm.shard_map(
+            lambda p, st, b, i, l, lr_, t_: self._worker(
+                p, st, b, i, l, lr_, t_, sync
+            ),
+            self.mesh,
+            in_specs=(
+                spec_of(stk_p), spec_of(stk_state), spec_of(stk_b),
+                spec_of(list(in_raws)), spec_of(list(label_raws)),
+                P(), P(),
+            ),
+            out_specs=(
+                P(), spec_of(stk_p), spec_of(stk_state), spec_of(stk_b),
+            ),
+        )
+        return f(stk_p, stk_state, stk_b, list(in_raws), list(label_raws),
+                 lr, t)
+
+    def _worker(self, p_stk, st_stk, b_stk, ins, labels, lr, t, sync):
+        p_loc = [q[0] for q in p_stk]
+        b_loc = [q[0] for q in b_stk]
+        st_loc = jax.tree_util.tree_map(lambda v: v[0], st_stk)
+
+        def loss_of(p_tuple):
+            with AG.trace_mode(), comm.spmd_region(self.axis), \
+                    _swapped(self._p_objs + self._b_objs,
+                             list(p_tuple) + b_loc):
+                outs = self.model(*[Tensor._wrap(r) for r in ins])
+                loss = self.loss_fn(
+                    outs, *[Tensor._wrap(r) for r in labels]
+                )
+                loss_raw = loss._data if isinstance(loss, Tensor) else loss
+                new_b = tuple(b._data for b in self._b_objs)
+            return loss_raw, new_b
+
+        (loss, new_b), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(tuple(p_loc))
+        from ...jit.train_step import process_grads
+
+        grads = process_grads(
+            self._inner, self._p_objs, p_loc, list(grads),
+            self._grad_post_hook,
+        )
+        new_p, new_st = self._inner._functional_update(
+            self._p_objs, p_loc, grads, st_loc, lr, t
+        )
+        # the periodic c_allreduce_sum/nranks of params (:194); `sync` is
+        # static, so non-sync steps compile with no collective at all
+        if sync:
+            new_p = [jax.lax.pmean(v, self.axis) for v in new_p]
+            new_b = [jax.lax.pmean(v, self.axis) for v in new_b]
+        loss_mean = jax.lax.pmean(loss, self.axis)
+        return (
+            loss_mean,
+            [v[None] for v in new_p],
+            jax.tree_util.tree_map(lambda v: v[None], new_st),
+            [v[None] for v in new_b],
+        )
+
+    # -- eager entry ---------------------------------------------------------
+    def __call__(self, inputs, labels=None):
+        in_raws = tuple(
+            x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in _as_list(inputs)
+        )
+        label_raws = tuple(
+            y._data if isinstance(y, Tensor) else jnp.asarray(y)
+            for y in _as_list(labels)
+        )
+        opt = self._inner
+        opt._step_count += 1
+        t = opt._step_count
+        sync = t >= self.begin_step and t % self.k_steps == 0
+        loss, self._stk_p, self._stk_state, self._stk_b = self._jitted(
+            self._stk_p, self._stk_state, self._stk_b,
+            in_raws, label_raws,
+            jnp.asarray(opt.get_lr(), jnp.float32),
+            jnp.asarray(t, jnp.float32),
+            bool(sync),
+        )
+        self._dirty = True
+        return Tensor._wrap(loss, stop_gradient=True)
+
+    def sync_to_model(self):
+        """Average the per-worker replicas back into the Layer's params
+        (what a checkpoint/state_dict consumer must see)."""
+        if not self._dirty:
+            return
+        for p, stk in zip(self._p_objs, self._stk_p):
+            p._data = jnp.mean(stk, axis=0).astype(stk.dtype)
+            p._node = None
+            p.grad = None
+        for b, stk in zip(self._b_objs, self._stk_b):
+            b._data = jnp.mean(stk, axis=0).astype(stk.dtype)
+        state = {
+            name: tuple(
+                jnp.mean(v, axis=0).astype(v.dtype) for v in vals
+            )
+            for name, vals in self._stk_state.items()
+        }
+        self._inner._load_functional_state(self._p_objs, state)
+        self._dirty = False

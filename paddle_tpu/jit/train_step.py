@@ -11,6 +11,7 @@ updates them in place in HBM.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, Optional
 
 import jax
@@ -29,6 +30,31 @@ def _as_list(x):
     if isinstance(x, (list, tuple)):
         return list(x)
     return [x]
+
+
+def process_grads(opt, p_objs, p_raws, g_raws, grad_post_hook=None):
+    """Regularizer terms + grad clip + strategy hook, traced. Shared by
+    TrainStep and LocalSGDStep so strategy/optimizer extras never silently
+    drop in an alternate step."""
+    reg = opt._regularization
+    if reg is not None or any(p.regularizer is not None for p in p_objs):
+        out = []
+        for p, praw, g in zip(p_objs, p_raws, g_raws):
+            r = p.regularizer or reg
+            if g is None or r is None:
+                out.append(g)
+            else:
+                out.append(g + r.grad_term(praw))
+        g_raws = out
+    if opt._grad_clip is not None:
+        with AG.trace_mode(), _swapped(p_objs, p_raws):
+            pgs = [(p, Tensor._wrap(g) if g is not None else None)
+                   for p, g in zip(p_objs, g_raws)]
+            pgs = opt._grad_clip(pgs)
+            g_raws = [g._data if g is not None else None for _, g in pgs]
+    if grad_post_hook is not None:
+        g_raws = grad_post_hook(g_raws, p_objs)
+    return g_raws
 
 
 class TrainStep:
@@ -58,6 +84,54 @@ class TrainStep:
         self._grad_post_hook = grad_post_hook
         if optimizer._parameter_list is None:
             optimizer._parameter_list = list(model.parameters())
+        # -- DistributedStrategy consumption (the strategy-compiler seam,
+        # reference fleet_base.py:1150-1181 meta-optimizer chain): flags
+        # change THIS compiled program, or route to a different step.
+        self._amp_ctx = None          # amp.auto_cast kwargs for the trace
+        self._loss_scale_cfg = None   # fp16 dynamic loss scaling config
+        self._scaler_state = ()       # (scale, good, bad) traced state
+        self._recompute = False
+        self._delegate = None         # localsgd routes to LocalSGDStep
+        strategy = getattr(optimizer, "user_defined_strategy", None)
+        if strategy is not None:
+            if strategy.localsgd:
+                if strategy.amp or strategy.recompute:
+                    raise NotImplementedError(
+                        "localsgd does not compose with amp/recompute yet"
+                    )
+                from ..distributed.fleet.localsgd import LocalSGDStep
+
+                cfg = strategy.localsgd_configs
+                self._delegate = LocalSGDStep(
+                    model, loss_fn, optimizer,
+                    k_steps=int(cfg["k_steps"]),
+                    begin_step=int(cfg["begin_step"]),
+                    grad_post_hook=grad_post_hook,
+                )
+                return
+            if strategy.amp:
+                ac = strategy.amp_configs
+                dtype = "float16" if ac["use_pure_fp16"] or not ac["use_bf16"] \
+                    else "bfloat16"
+                self._amp_ctx = dict(
+                    enable=True,
+                    level="O2" if ac["use_pure_fp16"] else "O1",
+                    dtype=dtype,
+                    custom_white_list=ac["custom_white_list"],
+                    custom_black_list=ac["custom_black_list"],
+                )
+                if dtype == "float16" and ac["use_dynamic_loss_scaling"]:
+                    # fused check_finite_and_unscale + update_loss_scaling
+                    # (operators/amp/*.cc) INSIDE the compiled step
+                    self._loss_scale_cfg = dict(ac)
+                    self._scaler_state = (
+                        jnp.asarray(ac["init_loss_scaling"], jnp.float32),
+                        jnp.asarray(0, jnp.int32),   # good steps
+                        jnp.asarray(0, jnp.int32),   # bad steps
+                        jnp.asarray(0, jnp.int32),   # APPLIED updates (t)
+                    )
+            if strategy.recompute:
+                self._recompute = True
         self._p_objs = [p for p in optimizer._get_params() if p.trainable]
         b_named = dict(model.named_buffers())
         self._b_names = list(b_named)
@@ -73,32 +147,118 @@ class TrainStep:
         )
 
     # -- the pure program ----------------------------------------------------
-    def _loss_of(self, p_tuple, b_raws, key, in_raws, label_raws):
+    def _amp_guard(self):
+        if self._amp_ctx is None:
+            return contextlib.nullcontext()
+        from .. import amp
+
+        return amp.auto_cast(**self._amp_ctx)
+
+    def _fwd_segment(self, p_tuple, b_raws, key, in_raws):
+        """Model forward as a pure pytree function — the jax.checkpoint
+        (remat) boundary when strategy.recompute is on (RecomputeOptimizer
+        analog, fluid/optimizer.py:4549)."""
         p_objs, b_objs = self._p_objs, self._b_objs
-        with AG.trace_mode(), _trace_rng(key), \
+        with AG.trace_mode(), _trace_rng(key), self._amp_guard(), \
                 _swapped(p_objs + b_objs, list(p_tuple) + list(b_raws)):
             outs = self.model(*[Tensor._wrap(r) for r in in_raws])
+            out_raw = jax.tree_util.tree_map(
+                lambda v: v._data if isinstance(v, Tensor) else v,
+                outs, is_leaf=lambda v: isinstance(v, Tensor),
+            )
+            new_b = tuple(b._data for b in b_objs)
+        return out_raw, new_b
+
+    def _loss_of(self, p_tuple, b_raws, key, in_raws, label_raws):
+        # disjoint RNG streams for the two trace regions (the fwd segment
+        # may be recomputed in backward and must redraw identically)
+        fwd_key = None if key is None else jax.random.fold_in(key, 0)
+        loss_key = None if key is None else jax.random.fold_in(key, 1)
+        fwd = jax.checkpoint(self._fwd_segment) if self._recompute \
+            else self._fwd_segment
+        out_raw, new_b = fwd(tuple(p_tuple), b_raws, fwd_key, in_raws)
+        outs = jax.tree_util.tree_map(Tensor._wrap, out_raw)
+        # loss_fn sees the TRACED params/post-forward buffers (it may read
+        # model.parameters() for a penalty term) and its own RNG stream
+        with AG.trace_mode(), _trace_rng(loss_key), self._amp_guard(), \
+                _swapped(self._p_objs + self._b_objs,
+                         list(p_tuple) + list(new_b)):
             labels = [Tensor._wrap(r) for r in label_raws]
             loss = self.loss_fn(outs, *labels)
             loss_raw = loss._data if isinstance(loss, Tensor) else loss
-            new_b = tuple(b._data for b in b_objs)
         return loss_raw, new_b
 
-    def _step_fn(self, p_raws, opt_state, b_raws, key, lr, t, in_raws,
-                 label_raws):
-        (loss, new_b), grads = jax.value_and_grad(
-            lambda p: self._loss_of(p, b_raws, key, in_raws, label_raws),
-            has_aux=True,
-        )(tuple(p_raws))
+    def _step_fn(self, p_raws, opt_state, b_raws, key, lr, t, scaler_state,
+                 in_raws, label_raws):
+        if self._loss_scale_cfg is None:
+            (loss, new_b), grads = jax.value_and_grad(
+                lambda p: self._loss_of(p, b_raws, key, in_raws, label_raws),
+                has_aux=True,
+            )(tuple(p_raws))
+        else:
+            scale = scaler_state[0]
+
+            def scaled(p):
+                loss, new_b = self._loss_of(
+                    p, b_raws, key, in_raws, label_raws
+                )
+                return loss * scale.astype(loss.dtype), (loss, new_b)
+
+            (_, (loss, new_b)), grads = jax.value_and_grad(
+                scaled, has_aux=True
+            )(tuple(p_raws))
+            grads = tuple(
+                None if g is None else g / scale.astype(g.dtype)
+                for g in grads
+            )
         grads = list(grads)
         if self._used_mask is not None:
             grads = [g if used else None
                      for g, used in zip(grads, self._used_mask)]
         grads = self._process_grads(list(p_raws), grads)
+        if self._loss_scale_cfg is not None:
+            # bias-correction time must count APPLIED updates, not
+            # attempted steps (the eager scaler skips optimizer.step()
+            # entirely on overflow) — it rides in the scaler state
+            t = (scaler_state[3] + 1).astype(t.dtype)
         new_p, new_state = self.opt._functional_update(
             self._p_objs, list(p_raws), grads, opt_state, lr, t
         )
-        return loss, new_p, new_state, new_b
+        if self._loss_scale_cfg is not None:
+            new_p, new_state, scaler_state = self._apply_loss_scaling(
+                grads, p_raws, opt_state, new_p, new_state, scaler_state
+            )
+        return loss, new_p, new_state, new_b, scaler_state
+
+    def _apply_loss_scaling(self, grads, p_raws, opt_state, new_p, new_state,
+                            scaler_state):
+        """Fused check_finite_and_unscale + update_loss_scaling
+        (operators/amp/check_finite_and_unscale_op.cc,
+        update_loss_scaling_op.cc): ONE all-grads finite reduction in the
+        compiled program — no per-param host sync (r3 weak #3). Non-finite
+        steps keep params/state and shrink the scale."""
+        cfg = self._loss_scale_cfg
+        finite = jnp.all(jnp.stack([
+            jnp.isfinite(g).all() for g in grads if g is not None
+        ]))
+        sel = lambda new, old: jax.tree_util.tree_map(
+            lambda n, o: jnp.where(finite, n, o), new, old
+        )
+        new_p = sel(tuple(new_p), tuple(p_raws))
+        new_state = sel(new_state, opt_state)
+        scale, good, bad, t_applied = scaler_state
+        t_applied = jnp.where(finite, t_applied + 1, t_applied)
+        good = jnp.where(finite, good + 1, 0)
+        bad = jnp.where(finite, 0, bad + 1)
+        do_incr = finite & (good >= cfg["incr_every_n_steps"])
+        do_decr = (~finite) & (bad >= cfg["decr_every_n_nan_or_inf"])
+        scale = jnp.where(do_incr, scale * cfg["incr_ratio"], scale)
+        scale = jnp.where(
+            do_decr, jnp.maximum(scale * cfg["decr_ratio"], 1.0), scale
+        )
+        good = jnp.where(do_incr, 0, good)
+        bad = jnp.where(do_decr, 0, bad)
+        return new_p, new_state, (scale, good, bad, t_applied)
 
     def _analyze_usage(self, p_raws, b_raws, key, in_raws, label_raws):
         """Which params does the loss actually read? (one abstract trace).
@@ -121,31 +281,14 @@ class TrainStep:
         return tuple(id(v) in used for v in closed.jaxpr.invars[:n_p])
 
     def _process_grads(self, p_raws, g_raws):
-        """Regularizer terms + grad clip + strategy hook, traced."""
-        opt = self.opt
-        reg = opt._regularization
-        if reg is not None or any(p.regularizer is not None
-                                  for p in self._p_objs):
-            out = []
-            for p, praw, g in zip(self._p_objs, p_raws, g_raws):
-                r = p.regularizer or reg
-                if g is None or r is None:
-                    out.append(g)
-                else:
-                    out.append(g + r.grad_term(praw))
-            g_raws = out
-        if opt._grad_clip is not None:
-            with AG.trace_mode(), _swapped(self._p_objs, p_raws):
-                pgs = [(p, Tensor._wrap(g) if g is not None else None)
-                       for p, g in zip(self._p_objs, g_raws)]
-                pgs = opt._grad_clip(pgs)
-                g_raws = [g._data if g is not None else None for _, g in pgs]
-        if self._grad_post_hook is not None:
-            g_raws = self._grad_post_hook(g_raws, self._p_objs)
-        return g_raws
+        return process_grads(
+            self.opt, self._p_objs, p_raws, g_raws, self._grad_post_hook
+        )
 
     # -- eager entry ---------------------------------------------------------
     def __call__(self, inputs, labels=None):
+        if self._delegate is not None:
+            return self._delegate(inputs, labels)
         opt = self.opt
         in_raws = tuple(
             x._data if isinstance(x, Tensor) else jnp.asarray(x)
@@ -166,8 +309,9 @@ class TrainStep:
         opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         t = jnp.asarray(opt._step_count, jnp.float32)
-        loss, new_p, new_state, new_b = self._jitted(
-            p_raws, opt_state, b_raws, key, lr, t, in_raws, label_raws
+        loss, new_p, new_state, new_b, self._scaler_state = self._jitted(
+            p_raws, opt_state, b_raws, key, lr, t, self._scaler_state,
+            in_raws, label_raws
         )
         for p, raw in zip(self._p_objs, new_p):
             p._data = raw

@@ -1,0 +1,278 @@
+"""Every DistributedStrategy flag is real or loud (VERDICT r3 item 3).
+
+The reference composes meta-optimizers per enabled flag
+(fleet_base.py:1150-1181 + strategy_compiler.py:171); here each flag must
+either change the compiled TrainStep / optimizer, or raise — never be
+silently dropped.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.jit import TrainStep
+
+
+def _fleet_opt(opt, **flags):
+    strategy = DistributedStrategy()
+    for k, v in flags.items():
+        setattr(strategy, k, v)
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.distributed_optimizer(opt)
+
+
+class _DtypeProbe(nn.Layer):
+    """Records the activation dtype flowing through it at trace time."""
+
+    def __init__(self):
+        super().__init__()
+        self.seen = None
+
+    def forward(self, x):
+        self.seen = x.dtype
+        return x
+
+
+def _mse(out, y):
+    return ((out - y) * (out - y)).mean()
+
+
+class TestAmp:
+    def test_bf16_autocast_inside_train_step(self):
+        probe = _DtypeProbe()
+        model = nn.Sequential(nn.Linear(4, 4), probe, nn.Linear(4, 1))
+        opt = _fleet_opt(
+            optimizer.Adam(learning_rate=1e-3,
+                           parameters=model.parameters()),
+            amp=True,
+        )
+        step = TrainStep(model, _mse, opt)
+        x = np.random.rand(8, 4).astype(np.float32)
+        y = np.random.rand(8, 1).astype(np.float32)
+        loss = step(x, y)
+        # white-listed matmul output flows in bf16 under the O1 policy
+        assert probe.seen == jnp.bfloat16
+        assert np.isfinite(float(loss.numpy()))
+        # same model without the flag stays f32
+        probe2 = _DtypeProbe()
+        model2 = nn.Sequential(nn.Linear(4, 4), probe2, nn.Linear(4, 1))
+        step2 = TrainStep(
+            model2, _mse,
+            optimizer.Adam(learning_rate=1e-3,
+                           parameters=model2.parameters()),
+        )
+        step2(x, y)
+        assert probe2.seen == jnp.float32
+
+    def test_fp16_dynamic_loss_scaling_skips_bad_step(self):
+        model = nn.Linear(4, 1)
+        opt = _fleet_opt(
+            optimizer.SGD(learning_rate=0.1,
+                          parameters=model.parameters()),
+            amp=True,
+            amp_configs={
+                "use_bf16": False,
+                "init_loss_scaling": 8.0,
+                "decr_every_n_nan_or_inf": 1,
+                "incr_every_n_steps": 2,
+            },
+        )
+        step = TrainStep(model, _mse, opt)
+        assert step._loss_scale_cfg is not None
+        x = np.random.rand(8, 4).astype(np.float32)
+        y = np.random.rand(8, 1).astype(np.float32)
+        w0 = np.asarray(model.weight._data)
+        step(x, y)
+        w1 = np.asarray(model.weight._data)
+        assert not np.allclose(w0, w1)  # good step updated params
+        scale_after_good = float(step._scaler_state[0])
+        assert scale_after_good == 8.0
+        # poison a batch -> non-finite grads -> params held, scale halved
+        x_bad = x.copy()
+        x_bad[0, 0] = np.inf
+        step(x_bad, y)
+        w2 = np.asarray(model.weight._data)
+        np.testing.assert_array_equal(w1, w2)
+        assert float(step._scaler_state[0]) == 4.0
+        # two consecutive good steps -> scale *= incr_ratio
+        step(x, y)
+        step(x, y)
+        assert float(step._scaler_state[0]) == 8.0
+
+
+class TestRecompute:
+    def test_recompute_changes_program_and_keeps_numerics(self):
+        def build(flagged):
+            paddle.seed(7)
+            model = nn.Sequential(
+                nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 1)
+            )
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=model.parameters())
+            if flagged:
+                opt = _fleet_opt(opt, recompute=True)
+            return TrainStep(model, _mse, opt), model
+
+        x = np.random.rand(8, 6).astype(np.float32)
+        y = np.random.rand(8, 1).astype(np.float32)
+        step_rc, model_rc = build(True)
+        step_plain, model_plain = build(False)
+        assert step_rc._recompute
+        l1 = float(step_rc(x, y).numpy())
+        l2 = float(step_plain(x, y).numpy())
+        np.testing.assert_allclose(l1, l2, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(model_rc.state_dict()["0.weight"]._data),
+            np.asarray(model_plain.state_dict()["0.weight"]._data),
+            rtol=1e-6,
+        )
+        # the grad program re-emits the forward under a remat call
+        p_raws = tuple(p._data for p in step_rc._p_objs)
+        jaxpr = jax.make_jaxpr(
+            lambda p: jax.grad(
+                lambda q: step_rc._loss_of(
+                    q, (), None, (jnp.asarray(x),), (jnp.asarray(y),)
+                )[0]
+            )(p)
+        )(p_raws)
+        assert "remat" in str(jaxpr)
+
+
+class TestOptimizerSwaps:
+    def test_lamb_swap(self):
+        model = nn.Linear(4, 4)
+        opt = _fleet_opt(
+            optimizer.Adam(learning_rate=1e-3,
+                           parameters=model.parameters()),
+            lamb=True,
+        )
+        assert isinstance(opt._inner, optimizer.Lamb)
+
+    def test_lamb_wrong_inner_raises(self):
+        model = nn.Linear(4, 4)
+        with pytest.raises(ValueError, match="lamb"):
+            _fleet_opt(
+                optimizer.SGD(learning_rate=1e-3,
+                              parameters=model.parameters()),
+                lamb=True,
+            )
+
+    def test_lars_swap(self):
+        model = nn.Linear(4, 4)
+        opt = _fleet_opt(
+            optimizer.Momentum(learning_rate=1e-3, momentum=0.9,
+                               parameters=model.parameters()),
+            lars=True,
+        )
+        assert isinstance(opt._inner, optimizer.Lars)
+
+    def test_dgc_raises(self):
+        model = nn.Linear(4, 4)
+        with pytest.raises(NotImplementedError, match="dgc"):
+            _fleet_opt(
+                optimizer.Momentum(learning_rate=1e-3,
+                                   parameters=model.parameters()),
+                dgc=True,
+            )
+
+    def test_a_sync_raises(self):
+        model = nn.Linear(4, 4)
+        with pytest.raises(NotImplementedError, match="a_sync"):
+            _fleet_opt(
+                optimizer.SGD(learning_rate=1e-3,
+                              parameters=model.parameters()),
+                a_sync=True,
+            )
+
+
+class TestLocalSGD:
+    def _data(self, steps, B=16, D=3):
+        rng = np.random.RandomState(3)
+        xs = [rng.rand(B, D).astype(np.float32) for _ in range(steps)]
+        ys = [rng.rand(B, 1).astype(np.float32) for _ in range(steps)]
+        return xs, ys
+
+    def test_k1_matches_data_parallel(self):
+        """k_steps=1: average-after-every-local-SGD-step == synchronous DP
+        (mean of per-worker SGD updates = SGD on the mean gradient)."""
+        xs, ys = self._data(3)
+
+        paddle.seed(11)
+        model_dp = paddle.DataParallel(nn.Linear(3, 1))
+        step_dp = TrainStep(
+            model_dp, _mse,
+            optimizer.SGD(learning_rate=0.1,
+                          parameters=model_dp.parameters()),
+        )
+        dp_losses = [
+            float(step_dp(model_dp.shard_input(x),
+                          model_dp.shard_input(y)).numpy())
+            for x, y in zip(xs, ys)
+        ]
+
+        paddle.seed(11)
+        model = nn.Linear(3, 1)
+        opt = _fleet_opt(
+            optimizer.SGD(learning_rate=0.1,
+                          parameters=model.parameters()),
+            localsgd=True,
+            localsgd_configs={"k_steps": 1},
+        )
+        step = TrainStep(model, _mse, opt)
+        assert step._delegate is not None
+        ls_losses = [
+            float(step(x, y).numpy()) for x, y in zip(xs, ys)
+        ]
+        np.testing.assert_allclose(ls_losses, dp_losses, rtol=1e-5)
+
+    def test_k2_matches_manual_worker_simulation(self):
+        """k_steps=2: workers diverge for 2 local steps, then average —
+        checked against an explicit 8-worker numpy simulation."""
+        steps = 4
+        xs, ys = self._data(steps)
+        dp = len(jax.devices())
+        lr = 0.1
+
+        paddle.seed(5)
+        model = nn.Linear(3, 1)
+        W0 = np.asarray(model.weight._data).copy()
+        b0 = np.asarray(model.bias._data).copy()
+        opt = _fleet_opt(
+            optimizer.SGD(learning_rate=lr,
+                          parameters=model.parameters()),
+            localsgd=True,
+            localsgd_configs={"k_steps": 2},
+        )
+        step = TrainStep(model, _mse, opt)
+        for x, y in zip(xs, ys):
+            step(x, y)
+        step._delegate.sync_to_model()
+        got_W = np.asarray(model.weight._data)
+
+        # manual simulation
+        Ws = [W0.copy() for _ in range(dp)]
+        bs = [b0.copy() for _ in range(dp)]
+        shard = 16 // dp
+        for t in range(steps):
+            for i in range(dp):
+                xi = xs[t][i * shard:(i + 1) * shard]
+                yi = ys[t][i * shard:(i + 1) * shard]
+                pred = xi @ Ws[i] + bs[i]
+                e = pred - yi
+                gW = 2.0 * xi.T @ e / e.size
+                gb = 2.0 * e.mean(axis=0)
+                Ws[i] = Ws[i] - lr * gW
+                bs[i] = bs[i] - lr * gb
+            if (t + 1) % 2 == 0:
+                W_avg = np.mean(Ws, axis=0)
+                b_avg = np.mean(bs, axis=0)
+                Ws = [W_avg.copy() for _ in range(dp)]
+                bs = [b_avg.copy() for _ in range(dp)]
+        # final state: after the step-4 sync all workers agree
+        np.testing.assert_allclose(got_W, np.mean(Ws, axis=0),
+                                   rtol=1e-4, atol=1e-6)
