@@ -49,6 +49,9 @@ from . import jit  # noqa: F401
 from . import distributed  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401
+from . import hapi  # noqa: F401
+from . import static  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
 from .ops import creation, linalg, logic, manipulation, math, search  # noqa: F401
 from .ops.creation import to_tensor  # noqa: F401
 from .ops.logic import is_tensor  # noqa: F401
@@ -57,8 +60,10 @@ __version__ = "0.1.0"
 
 
 def disable_static(place=None):
-    """2.0 default mode is dygraph; eager is always on here."""
-    return None
+    """2.0 default mode is dygraph."""
+    from . import static as static_mod
+
+    static_mod._disable()
 
 
 def enable_static():

@@ -126,6 +126,9 @@ def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
     """
     from .tensor import Tensor  # late import; Tensor depends on ops at patch time
 
+    rec = _maybe_static_record(raw_fn, tensors, name)
+    if rec is not None:
+        return rec
     raws = tuple(t._data for t in tensors)
     raws = _maybe_amp_cast(name, raws)
     need_grad = (
@@ -139,12 +142,32 @@ def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
             return tuple(Tensor._wrap(o, stop_gradient=True) for o in out)
         return Tensor._wrap(out, stop_gradient=True)
 
-    out, vjp_fn = jax.vjp(raw_fn, *raws)
+    # int/bool inputs (labels, indices) can be real op ARGUMENTS — jax.vjp
+    # runs over the inexact-dtype subset only, the rest bind as constants
+    # (matches the reference's no-grad-var slots in GradOpMaker)
+    diff_idx = [
+        i for i, r in enumerate(raws)
+        if jnp.issubdtype(jnp.asarray(r).dtype, jnp.inexact)
+    ]
+    if len(diff_idx) < len(raws):
+        full = list(raws)
+
+        def fn_diff(*diff_raws, _fn=raw_fn):
+            args = list(full)
+            for i, r in zip(diff_idx, diff_raws):
+                args[i] = r
+            return _fn(*args)
+
+        out, vjp_fn = jax.vjp(fn_diff, *[raws[i] for i in diff_idx])
+        grad_tensors = tuple(tensors[i] for i in diff_idx)
+    else:
+        out, vjp_fn = jax.vjp(raw_fn, *raws)
+        grad_tensors = tuple(tensors)
     multi = isinstance(out, (tuple, list))
     outs = tuple(out) if multi else (out,)
     node = TapeNode(
         vjp_fn,
-        tuple(tensors),
+        grad_tensors,
         len(outs),
         [(o.shape, o.dtype) for o in outs],
         name=name,
@@ -199,10 +222,30 @@ def apply_aux(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
     return (wrapped if multi else wrapped[0]), aux
 
 
+def _maybe_static_record(raw_fn, tensors, name, differentiable=True):
+    """Static-mode graph capture (LayerHelper.append_op analog): when an
+    op consumes a symbolic variable, record it into the default Program
+    instead of executing."""
+    from ..static import _static_mode_on
+
+    if not _static_mode_on():
+        return None
+    if not any(
+        getattr(t, "_static_var", None) is not None for t in tensors
+    ):
+        return None
+    from ..static.program import record_apply
+
+    return record_apply(raw_fn, tensors, name, differentiable)
+
+
 def apply_nondiff(raw_fn: Callable, tensors: Sequence):
     """Dispatch an op that is never differentiable (argmax, comparisons...)."""
     from .tensor import Tensor
 
+    rec = _maybe_static_record(raw_fn, tensors, None, differentiable=False)
+    if rec is not None:
+        return rec
     out = raw_fn(*(t._data for t in tensors))
     if isinstance(out, (tuple, list)):
         return tuple(Tensor._wrap(o, stop_gradient=True) for o in out)

@@ -42,6 +42,7 @@ class Tensor:
         "persistable",
         "_grad_hooks",
         "_inplace_version",
+        "_static_var",  # static-mode symbolic Variable (static/program.py)
         "__weakref__",
     )
 
@@ -89,6 +90,12 @@ class Tensor:
 
     @property
     def shape(self):
+        v = getattr(self, "_static_var", None)
+        if v is not None and v.is_data:
+            # feed placeholders report unknown dims as -1 (framework.py
+            # Variable.shape semantics) so `reshape([x.shape[0], ...])`
+            # style scripts stay batch-polymorphic
+            return [-1 if (d is None or d < 0) else d for d in v.shape]
         return list(self._data.shape)
 
     @property

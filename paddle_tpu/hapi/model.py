@@ -1,0 +1,359 @@
+"""paddle.Model — the high-level trainer.
+
+Reference: python/paddle/hapi/model.py — Model :810, prepare :1244,
+fit :1299, evaluate :1515, predict :1609, train_batch/eval_batch/
+predict_batch :880-1040, save/load :1041-1200; the dygraph backend
+(DynamicGraphAdapter :724) is the semantic model here.
+
+TPU-first: the training backend is the fused `jit.TrainStep` (one donated
+XLA program per step) instead of per-op dygraph dispatch; eval/predict run
+the jit-cached functional forward. When `paddle.distributed` is
+initialized, the network is wrapped in DataParallel and batches shard over
+the dp mesh axis (prepare_distributed_context analog, model.py:165).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework import io as fio
+from ..io.dataloader import DataLoader
+from ..io.dataset import Dataset
+from ..jit.train_step import TrainStep
+from ..metric import Metric
+from ..nn.layer import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+from ..jit.train_step import _as_list as _to_list  # shared normalization
+
+
+def _numpy(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Model:
+    """An h(igh-level)api over Layer + TrainStep + DataLoader (model.py:810).
+
+    Usage (reference parity)::
+
+        model = paddle.Model(network)
+        model.prepare(optimizer, paddle.nn.CrossEntropyLoss(),
+                      paddle.metric.Accuracy())
+        model.fit(train_dataset, eval_dataset, batch_size=64, epochs=2)
+        model.evaluate(eval_dataset)
+        model.predict(test_dataset)
+    """
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self._dp_model = None
+        self._save_dir = None
+        self._prepared = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """model.py:1244. `loss` is a Layer (e.g. CrossEntropyLoss()) or a
+        callable; `metrics` paddle.metric instances."""
+        self._optimizer = optimizer
+        if loss is not None and not isinstance(loss, Layer) \
+                and not callable(loss):
+            raise TypeError("loss should be a Layer or a callable")
+        self._loss = loss
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(
+                    f"metric should be paddle.metric.Metric, got {type(m)}"
+                )
+        self._metrics = _to_list(metrics)
+        if amp_configs is not None:
+            raise NotImplementedError(
+                "amp via Model.prepare: use fleet DistributedStrategy.amp "
+                "(the TrainStep consumes it)"
+            )
+        # prepare_distributed_context analog (model.py:165): under an
+        # initialized parallel env, lay params out over the mesh
+        from ..distributed import comm
+        from ..distributed.parallel import DataParallel
+
+        if comm.is_initialized() and comm._default_group().nranks > 1 \
+                and not isinstance(self.network, DataParallel):
+            self._dp_model = DataParallel(self.network)
+        self._prepared = True
+        return self
+
+    def _net(self):
+        return self._dp_model if self._dp_model is not None else self.network
+
+    def _loss_fn(self, outs, *labels):
+        if self._loss is None:
+            # network computes its own loss (model.py allows loss-less
+            # prepare when outputs ARE the loss)
+            return outs if not isinstance(outs, (list, tuple)) else outs[0]
+        outs = _to_list(outs)
+        return self._loss(*(outs + list(labels)))
+
+    def _shard(self, arrs):
+        """Shard batches over dp when active and divisible."""
+        if self._dp_model is None:
+            return arrs
+        n = self._dp_model.group.nranks
+        out = []
+        for a in arrs:
+            raw = a._data if isinstance(a, Tensor) else jnp.asarray(a)
+            out.append(
+                self._dp_model.shard_input(raw)
+                if raw.ndim > 0 and raw.shape[0] % n == 0 else a
+            )
+        return out
+
+    # -- the three batch engines (model.py:880-1040) -------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        if not self._prepared or self._optimizer is None:
+            raise RuntimeError(
+                "call model.prepare(optimizer, loss, ...) before training"
+            )
+        if not update:
+            raise NotImplementedError(
+                "update=False (gradient accumulation) rides through "
+                "DistributedStrategy.gradient_merge instead"
+            )
+        if self._train_step is None:
+            self._train_step = TrainStep(
+                self._net(), self._loss_fn, self._optimizer,
+                return_outputs=bool(self._metrics),
+            )
+        inputs = self._shard(_to_list(inputs))
+        labels = self._shard(_to_list(labels))
+        self.network.train()
+        if self._metrics:
+            # metrics come from the SAME forward the loss used (one fused
+            # program; DynamicGraphAdapter.train_batch behavior)
+            loss, outs = self._train_step(inputs, labels)
+            metrics = [float(_numpy(loss).reshape(-1)[0])]
+            outs = jax.tree_util.tree_map(
+                lambda r: Tensor._wrap(r, stop_gradient=True)
+                if not isinstance(r, Tensor) else r, outs,
+            )
+            metrics += self._update_metrics(outs, labels)
+        else:
+            loss = self._train_step(inputs, labels)
+            metrics = [float(_numpy(loss).reshape(-1)[0])]
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    def _update_metrics(self, outs, labels):
+        vals = []
+        outs = _to_list(outs)
+        labels = [
+            y if isinstance(y, Tensor) else Tensor(y) for y in labels
+        ]
+        for m in self._metrics:
+            state = m.compute(*(outs + labels))
+            m.update(*_to_list(state))
+            vals.append(m.accumulate())
+        return vals
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = self._shard(_to_list(inputs))
+        labels = self._shard(_to_list(labels))
+        from ..core import autograd as AG
+
+        with AG.no_grad():
+            outs = self._net()(*[
+                x if isinstance(x, Tensor) else Tensor(x) for x in inputs
+            ])
+            loss = self._loss_fn(
+                outs, *[y if isinstance(y, Tensor) else Tensor(y)
+                        for y in labels]
+            )
+        metrics = [float(_numpy(loss).reshape(-1)[0])]
+        metrics += self._update_metrics(outs, labels)
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core import autograd as AG
+
+        with AG.no_grad():
+            outs = self._net()(*[
+                x if isinstance(x, Tensor) else Tensor(x)
+                for x in _to_list(inputs)
+            ])
+        return [
+            _numpy(o) for o in _to_list(outs)
+        ]
+
+    # -- loops ---------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(
+                data, batch_size=batch_size, shuffle=shuffle,
+                num_workers=num_workers, drop_last=drop_last,
+            )
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, num_iters=None):
+        """model.py:1299."""
+        loader = self._loader(
+            train_data, batch_size, shuffle, num_workers, drop_last
+        )
+        eval_loader = self._loader(
+            eval_data, batch_size, False, num_workers, False
+        )
+        self._save_dir = save_dir
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=["loss"] + [m.name() for m in self._metrics],
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        done_iters = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                vals = _to_list(self.train_batch(ins, labs))
+                logs = self._logs(vals)
+                cbks.on_train_batch_end(step, logs)
+                done_iters += 1
+                if num_iters is not None and done_iters >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(
+                    eval_loader, batch_size=batch_size, log_freq=log_freq,
+                    verbose=verbose, callbacks=cbks,
+                )
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+
+    def _split_batch(self, batch):
+        batch = _to_list(batch)
+        n_in = max(len(self._inputs), 1)
+        if len(batch) == 1:
+            return batch, []
+        return batch[:n_in], batch[n_in:]
+
+    def _logs(self, vals):
+        names = ["loss"] + [m.name() for m in self._metrics]
+        return dict(zip(names, vals))
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        """model.py:1515. Returns {metric_name: value}."""
+        loader = self._loader(eval_data, batch_size, False, num_workers,
+                              False)
+        own_cbks = not hasattr(callbacks, "on_eval_begin")
+        cbks = callbacks if not own_cbks else config_callbacks(
+            callbacks, model=self, batch_size=batch_size, verbose=verbose,
+            log_freq=log_freq,
+            metrics=["loss"] + [m.name() for m in self._metrics],
+        )
+        for m in self._metrics:
+            m.reset()
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks.on_eval_begin({"steps": steps})
+        logs, losses = {}, []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            vals = _to_list(self.eval_batch(ins, labs))
+            losses.append(vals[0])
+            logs = self._logs([float(np.mean(losses))] + vals[1:])
+            cbks.on_eval_batch_end(step, logs)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        """model.py:1609. Returns per-output lists of batch arrays (or
+        concatenated when stack_outputs)."""
+        loader = self._loader(test_data, batch_size, False, num_workers,
+                              False)
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, verbose=verbose,
+            metrics=[],
+        )
+        cbks.on_predict_begin()
+        outputs = None
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            ins, _ = self._split_batch(batch)
+            outs = self.predict_batch(ins)
+            if outputs is None:
+                outputs = [[] for _ in outs]
+            for slot, o in zip(outputs, outs):
+                slot.append(o)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        if outputs is None:
+            return []
+        if stack_outputs:
+            outputs = [np.concatenate(slot, axis=0) for slot in outputs]
+        return outputs
+
+    # -- persistence (model.py:1041 save / :1135 load) -----------------------
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            opt = getattr(self._optimizer, "_inner", self._optimizer)
+            fio.save(opt.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            opt = getattr(self._optimizer, "_inner", self._optimizer)
+            opt.set_state_dict(fio.load(opt_path))
+
+    # -- misc ----------------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        if input_size is None and not self._inputs:
+            raise ValueError("summary needs input_size or Model inputs spec")
+        if input_size is None:
+            input_size = [tuple(s.shape) for s in self._inputs]
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,91 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py —
+summary() builds a per-layer table via forward hooks and reports parameter
+totals)."""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["summary"]
+
+
+def _shapes(out):
+    if isinstance(out, Tensor):
+        return list(out.shape)
+    if isinstance(out, (list, tuple)):
+        return [_shapes(o) for o in out]
+    return []
+
+
+def summary(net: Layer, input_size, dtypes=None):
+    """Print a layer table; returns {'total_params', 'trainable_params'}.
+
+    `input_size`: a shape tuple, or list of shape tuples for multi-input
+    forwards. A -1 leading dim means batch (replaced by 1)."""
+    if isinstance(input_size, tuple):
+        input_sizes = [input_size]
+    elif isinstance(input_size, list) and input_size \
+            and isinstance(input_size[0], int):
+        input_sizes = [tuple(input_size)]
+    else:
+        input_sizes = [tuple(s) for s in input_size]
+    dtypes = dtypes or ["float32"] * len(input_sizes)
+    if isinstance(dtypes, str):
+        dtypes = [dtypes] * len(input_sizes)
+
+    rows: List[tuple] = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, output=None):
+            n_params = sum(
+                int(np.prod(p.shape)) for p in lyr.parameters(
+                    include_sublayers=False
+                )
+            )
+            rows.append(
+                (f"{type(lyr).__name__}-{len(rows) + 1}",
+                 _shapes(output), n_params)
+            )
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not sub.sublayers():  # leaf layers only
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        ins = [
+            Tensor(np.zeros(
+                tuple(1 if d == -1 else d for d in shape), dtype=dt
+            ))
+            for shape, dt in zip(input_sizes, dtypes)
+        ]
+        net(*ins)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(
+        int(np.prod(p.shape)) for p in net.parameters() if p.trainable
+    )
+    name_w = max([len(r[0]) for r in rows] + [12]) + 2
+    print("-" * (name_w + 40))
+    print(f"{'Layer (type)':<{name_w}}{'Output Shape':<24}{'Param #':>10}")
+    print("=" * (name_w + 40))
+    for name, shape, n in rows:
+        print(f"{name:<{name_w}}{str(shape):<24}{n:>10,}")
+    print("=" * (name_w + 40))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * (name_w + 40))
+    return {"total_params": total, "trainable_params": trainable}

@@ -74,10 +74,14 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer, *,
-                 donate: bool = True, grad_post_hook: Optional[Callable] = None):
+                 donate: bool = True, grad_post_hook: Optional[Callable] = None,
+                 return_outputs: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.opt = optimizer
+        # return_outputs: step() also returns the forward outputs (metric
+        # consumers avoid a second forward; DynamicGraphAdapter analog)
+        self._ret_out = return_outputs
         # grad_post_hook(list[raw_grad], list[Parameter]) -> list[raw_grad]:
         # the seam where DataParallel/fleet strategies splice in comm or
         # accumulation (Reducer-hook analog, imperative/reducer.cc:563).
@@ -186,12 +190,12 @@ class TrainStep:
             labels = [Tensor._wrap(r) for r in label_raws]
             loss = self.loss_fn(outs, *labels)
             loss_raw = loss._data if isinstance(loss, Tensor) else loss
-        return loss_raw, new_b
+        return loss_raw, (new_b, out_raw if self._ret_out else None)
 
     def _step_fn(self, p_raws, opt_state, b_raws, key, lr, t, scaler_state,
                  in_raws, label_raws):
         if self._loss_scale_cfg is None:
-            (loss, new_b), grads = jax.value_and_grad(
+            (loss, (new_b, outs)), grads = jax.value_and_grad(
                 lambda p: self._loss_of(p, b_raws, key, in_raws, label_raws),
                 has_aux=True,
             )(tuple(p_raws))
@@ -199,12 +203,12 @@ class TrainStep:
             scale = scaler_state[0]
 
             def scaled(p):
-                loss, new_b = self._loss_of(
+                loss, aux = self._loss_of(
                     p, b_raws, key, in_raws, label_raws
                 )
-                return loss * scale.astype(loss.dtype), (loss, new_b)
+                return loss * scale.astype(loss.dtype), (loss, aux)
 
-            (_, (loss, new_b)), grads = jax.value_and_grad(
+            (_, (loss, (new_b, outs))), grads = jax.value_and_grad(
                 scaled, has_aux=True
             )(tuple(p_raws))
             grads = tuple(
@@ -228,7 +232,7 @@ class TrainStep:
             new_p, new_state, scaler_state = self._apply_loss_scaling(
                 grads, p_raws, opt_state, new_p, new_state, scaler_state
             )
-        return loss, new_p, new_state, new_b, scaler_state
+        return loss, new_p, new_state, new_b, outs, scaler_state
 
     def _apply_loss_scaling(self, grads, p_raws, opt_state, new_p, new_state,
                             scaler_state):
@@ -309,10 +313,11 @@ class TrainStep:
         opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         t = jnp.asarray(opt._step_count, jnp.float32)
-        loss, new_p, new_state, new_b, self._scaler_state = self._jitted(
-            p_raws, opt_state, b_raws, key, lr, t, self._scaler_state,
-            in_raws, label_raws
-        )
+        loss, new_p, new_state, new_b, outs, self._scaler_state = \
+            self._jitted(
+                p_raws, opt_state, b_raws, key, lr, t, self._scaler_state,
+                in_raws, label_raws
+            )
         for p, raw in zip(self._p_objs, new_p):
             p._data = raw
             p._node = None
@@ -321,4 +326,10 @@ class TrainStep:
         for b, raw in zip(self._b_objs, new_b):
             b._data = raw
             b._node = None
-        return Tensor._wrap(loss, stop_gradient=True)
+        loss_t = Tensor._wrap(loss, stop_gradient=True)
+        if self._ret_out:
+            outs_t = jax.tree_util.tree_map(
+                lambda r: Tensor._wrap(r, stop_gradient=True), outs
+            )
+            return loss_t, outs_t
+        return loss_t

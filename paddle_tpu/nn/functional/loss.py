@@ -38,10 +38,11 @@ def cross_entropy(
     name=None,
 ):
     """paddle.nn.functional.cross_entropy: softmax+NLL fused (the reference's
-    softmax_with_cross_entropy kernel); XLA fuses the same way."""
-    lbl = label._data
+    softmax_with_cross_entropy kernel); XLA fuses the same way. The label
+    rides as a real op argument (not a closure capture) so the op records
+    cleanly into static programs."""
 
-    def f(logits, *w):
+    def f(logits, lbl, *w):
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
             jnp.maximum(logits, 1e-30)
         )
@@ -70,7 +71,7 @@ def cross_entropy(
                 return jnp.sum(loss) / denom
         return _reduce(loss, reduction)
 
-    args = (input,) + ((weight,) if weight is not None else ())
+    args = (input, label) + ((weight,) if weight is not None else ())
     return AG.apply(f, args, name="cross_entropy")
 
 

@@ -159,9 +159,24 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        """loss.backward() + step() convenience (static-graph-era API)."""
+        """loss.backward() + step() convenience. In static mode this
+        RECORDS the backward+update directive into the program (the
+        append_backward analog, fluid/backward.py:1337); Executor.run
+        compiles and applies it."""
         if parameters is not None:
             self._parameter_list = list(parameters)
+        loss_var = getattr(loss, "_static_var", None)
+        if loss_var is not None:
+            from ..static.program import default_main_program
+
+            prog = default_main_program()
+            if self._parameter_list is None:
+                self._parameter_list = [
+                    p for p in prog.all_parameters() if p.trainable
+                ]
+            prog.optimize_directives.append((self, loss_var))
+            prog._version += 1
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,9 +1,15 @@
-"""paddle_tpu.static — static-graph facade (stage 3; stub switches for now).
+"""paddle_tpu.static — the static-graph facade (L8, SURVEY.md §2.7).
 
-reference: python/paddle/static/ over fluid Program/Executor. In the TPU
-build "static mode" is trace-and-compile: programs are captured by tracing
-(paddle_tpu.jit) rather than built op-desc-by-op-desc; this module will hold
-the Program/Executor-compatible API shells.
+Reference: python/paddle/static/ over fluid Program/Executor/
+append_backward (framework.py, executor.py:916, backward.py:1337).
+
+TPU-native "static mode" is deferred trace-and-compile: `paddle.static.data`
+creates symbolic placeholders; ops touching them record into the default
+Program (program.py); `opt.minimize(loss)` records the backward+update
+directive; `Executor.run(prog, feed, fetch_list)` compiles the whole thing
+— forward, backward, optimizer — into one jitted XLA program per feed
+signature and executes it. An unmodified Paddle static training script
+maps 1:1 onto this surface.
 """
 from __future__ import annotations
 
@@ -22,3 +28,19 @@ def _disable():
 
 def _static_mode_on() -> bool:
     return _STATIC_MODE
+
+
+from .program import (  # noqa: E402,F401
+    Program,
+    Variable,
+    data,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from .executor import Executor, global_scope  # noqa: E402,F401
+
+__all__ = [
+    "Program", "Variable", "data", "default_main_program",
+    "default_startup_program", "program_guard", "Executor", "global_scope",
+]

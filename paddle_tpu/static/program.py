@@ -1,0 +1,198 @@
+"""Static-graph Program: the deferred-execution graph builder.
+
+Reference: python/paddle/fluid/framework.py (`Program`/`Block`/`Variable`
+over C++ OpDescs, framework.proto:43-202) + `paddle.static.data`
+(static/input.py). There, graph building appends protobuf OpDescs which an
+interpreter later runs op-by-op (executor.cc:414).
+
+TPU-native: a Program records (pure_fn, inputs, outputs) triples as ops —
+the SAME jnp closures eager dispatch runs — with symbolic placeholder
+outputs shaped by `jax.eval_shape` (no device work at build time). The
+Executor replays the op list inside ONE `jax.jit` per (program, feed
+signature, fetch set): XLA is the interpreter, so "static mode" compiles
+to exactly the same machine program the jit path produces. Concrete
+tensors touched during building (parameters, captured constants) become
+program leaves resolved at run time from the live objects, so optimizer
+updates are visible across runs.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Program", "Variable", "data", "program_guard",
+    "default_main_program", "default_startup_program",
+]
+
+
+class Variable:
+    """A symbolic graph edge (framework.py Variable analog)."""
+
+    _counter = 0
+
+    def __init__(self, name: Optional[str], shape, dtype, is_data=False):
+        Variable._counter += 1
+        self.id = Variable._counter
+        self.name = name or f"tmp_var_{self.id}"
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.is_data = is_data  # a feed placeholder
+
+    def aval(self):
+        return jax.ShapeDtypeStruct(
+            tuple(1 if (d is None or d < 0) else d for d in self.shape),
+            self.dtype,
+        )
+
+
+class StaticOp:
+    """One recorded op: raw_fn over resolved inputs -> output vars.
+
+    Inputs are either Variables (edges) or live Tensor objects (parameters
+    and captured constants — resolved to their CURRENT ._data at run time,
+    the scope-lookup analog of executor.cc feed/fetch variable resolution).
+    """
+
+    def __init__(self, fn: Callable, inputs: Sequence, out_vars: List[Variable],
+                 multi: bool, name: str):
+        self.fn = fn
+        self.inputs = list(inputs)
+        self.out_vars = out_vars
+        self.multi = multi
+        self.name = name
+
+
+class Program:
+    """framework.py Program. One block (control flow lowers to lax ops in
+    this build, so nested BlockDescs are unnecessary)."""
+
+    def __init__(self):
+        self.ops: List[StaticOp] = []
+        self.vars = {}
+        # recorded `opt.minimize(loss)` directives: (optimizer, loss_var)
+        self.optimize_directives = []
+        self._version = 0
+
+    def _add_var(self, var: Variable):
+        self.vars[var.name] = var
+        return var
+
+    def record(self, fn, inputs, out_avals, multi, name):
+        out_vars = [
+            self._add_var(Variable(None, a.shape, a.dtype))
+            for a in out_avals
+        ]
+        self.ops.append(StaticOp(fn, inputs, out_vars, multi, name))
+        self._version += 1
+        return out_vars
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        from ..core.tensor import Parameter
+
+        seen, out = set(), []
+        for op in self.ops:
+            for i in op.inputs:
+                if isinstance(i, Tensor) and isinstance(i, Parameter) \
+                        and id(i) not in seen:
+                    seen.add(id(i))
+                    out.append(i)
+        return out
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.ops = list(self.ops)
+        p.vars = dict(self.vars)
+        if not for_test:
+            p.optimize_directives = list(self.optimize_directives)
+        return p
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, vars={len(self.vars)}, "
+                f"optimized={bool(self.optimize_directives)})")
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    """framework.py program_guard."""
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_m, prev_s
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """paddle.static.data: declare a feed placeholder. Returns a symbolic
+    Tensor; ops consuming it record into the default main program."""
+    from . import _static_mode_on
+
+    if not _static_mode_on():
+        raise RuntimeError(
+            "paddle.static.data requires static mode: call "
+            "paddle.enable_static() first"
+        )
+    var = Variable(name, shape, convert_dtype(dtype), is_data=True)
+    _main_program._add_var(var)
+    t = Tensor._wrap(var.aval(), stop_gradient=True)
+    t._static_var = var
+    return t
+
+
+def is_symbolic(t) -> bool:
+    return getattr(t, "_static_var", None) is not None
+
+
+def record_apply(raw_fn, tensors, name, differentiable=True):
+    """The AG.apply hook in static mode: symbolic inputs mean 'record into
+    the program' instead of executing (LayerHelper.append_op analog).
+
+    Differentiability is decided at Executor compile time by jax.grad over
+    the replayed program, so `differentiable` is advisory only."""
+    avals = []
+    for t in tensors:
+        if is_symbolic(t):
+            avals.append(t._static_var.aval())
+        else:
+            avals.append(t._data)
+    out_aval = jax.eval_shape(raw_fn, *avals)
+    multi = isinstance(out_aval, (tuple, list))
+    outs = tuple(out_aval) if multi else (out_aval,)
+    inputs = [
+        t._static_var if is_symbolic(t) else t for t in tensors
+    ]
+    out_vars = _main_program.record(raw_fn, inputs, outs, multi, name or "op")
+    wrapped = []
+    for v in out_vars:
+        w = Tensor._wrap(v.aval(), stop_gradient=not differentiable)
+        w._static_var = v
+        wrapped.append(w)
+    return tuple(wrapped) if multi else wrapped[0]
