@@ -75,6 +75,8 @@ def config_callbacks(callbacks=None, model=None, batch_size=None,
                      save_freq=1, save_dir=None, metrics=None,
                      mode="train"):
     """callbacks.py:23 config_callbacks: user callbacks + defaults."""
+    if isinstance(callbacks, Callback):
+        callbacks = [callbacks]
     cbks = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbks):
         cbks.append(ProgBarLogger(log_freq, verbose=verbose))

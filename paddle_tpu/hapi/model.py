@@ -275,7 +275,9 @@ class Model:
         """model.py:1515. Returns {metric_name: value}."""
         loader = self._loader(eval_data, batch_size, False, num_workers,
                               False)
-        own_cbks = not hasattr(callbacks, "on_eval_begin")
+        from .callbacks import CallbackList
+
+        own_cbks = not isinstance(callbacks, CallbackList)
         cbks = callbacks if not own_cbks else config_callbacks(
             callbacks, model=self, batch_size=batch_size, verbose=verbose,
             log_freq=log_freq,

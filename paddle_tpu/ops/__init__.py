@@ -5,10 +5,12 @@ Aggregates all op namespaces and applies the Tensor method patch
 python/paddle/fluid/dygraph/math_op_patch.py).
 """
 from . import creation, linalg, logic, manipulation, math, search  # noqa: F401
+from . import sequence  # noqa: F401
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from . import patch as _patch  # noqa: F401  (side effect: Tensor methods)

@@ -269,3 +269,53 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
             ]
         )
     return Tensor._wrap(out.astype(jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32))
+
+
+# -- round-4 op-gap closure (VERDICT r3 #6) ---------------------------------
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    from ..core.dtype import convert_dtype
+
+    col = row if col is None else col
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    from ..core.dtype import convert_dtype
+
+    col = row if col is None else col
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+def poisson(x, name=None):
+    """Per-element Poisson draw with rate x (poisson_op parity)."""
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor._wrap(
+        jax.random.poisson(rnd.next_key(), x._data).astype(x._data.dtype)
+    )
+
+
+def polar(abs, angle, name=None):
+    from ..core import autograd as AG
+
+    a = abs if isinstance(abs, Tensor) else Tensor(abs)
+    g = angle if isinstance(angle, Tensor) else Tensor(angle)
+    return AG.apply(
+        lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+        (a, g), name="polar",
+    )
+
+
+def complex(real, imag, name=None):
+    from ..core import autograd as AG
+
+    r = real if isinstance(real, Tensor) else Tensor(real)
+    i = imag if isinstance(imag, Tensor) else Tensor(imag)
+    return AG.apply(lambda a, b: jax.lax.complex(a, b), (r, i),
+                    name="complex")
+
+
+__all__ += [
+    "tril_indices", "triu_indices", "poisson", "polar", "complex",
+]

@@ -231,3 +231,83 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
         (as_tensor(input), as_tensor(x), as_tensor(y)),
         name="addmm",
     )
+
+
+# -- round-4 op-gap closure (VERDICT r3 #6) ---------------------------------
+def eig(x, name=None):
+    """General (non-symmetric) eigendecomposition. XLA supports this on
+    CPU only (the reference's eig kernel is likewise CPU/LAPACK,
+    operators/eig_op.h); run outside jit on TPU jobs."""
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    w, v = jnp.linalg.eig(x._data)
+    return Tensor._wrap(w), Tensor._wrap(v)
+
+
+def eigvals(x, name=None):
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor._wrap(jnp.linalg.eigvals(x._data))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """paddle.linalg.lu: packed LU + 1-indexed pivots (lu_op parity)."""
+    if not pivot:
+        raise NotImplementedError("lu(pivot=False) is not supported")
+    x = x if isinstance(x, Tensor) else Tensor(x)
+
+    def f(a):
+        lu_, piv, _ = jax.lax.linalg.lu(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    lu_t, piv_t = AG.apply(f, (x,), name="lu")
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+        return lu_t, piv_t, info
+    return lu_t, piv_t
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A X = B given the Cholesky factor `y` of A (cholesky_solve_op
+    parity: x=B, y=factor)."""
+    from jax.scipy.linalg import cho_solve
+
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    y = y if isinstance(y, Tensor) else Tensor(y)
+    return AG.apply(
+        lambda b, f: cho_solve((f, not upper), b), (x, y),
+        name="cholesky_solve",
+    )
+
+
+def matrix_exp(x, name=None):
+    from jax.scipy.linalg import expm
+
+    return AG.apply(expm, (x if isinstance(x, Tensor) else Tensor(x),),
+                    name="matrix_exp")
+
+
+def cond(x, p=None, name=None):
+    return AG.apply(
+        lambda a: jnp.linalg.cond(a, p=p),
+        (x if isinstance(x, Tensor) else Tensor(x),), name="cond",
+    )
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distances between row vectors of x [.., M, D] and
+    y [.., N, D]."""
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    y = y if isinstance(y, Tensor) else Tensor(y)
+
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, axis=-1), 1e-24))
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return AG.apply(f, (x, y), name="cdist")
+
+
+__all__ += [
+    "eig", "eigvals", "lu", "cholesky_solve", "matrix_exp", "cond", "cdist",
+]

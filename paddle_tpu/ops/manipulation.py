@@ -440,3 +440,202 @@ def repeat_interleave(x, repeats, axis=None, name=None):
     return AG.apply(
         lambda a: jnp.repeat(a, r, axis=axis), (x,), name="repeat_interleave"
     )
+
+
+# -- round-4 op-gap closure (VERDICT r3 #6) ---------------------------------
+def tensordot(x, y, axes=2, name=None):
+    from ._dispatch import as_tensor as _at
+
+    return AG.apply(
+        lambda a, b: jnp.tensordot(a, b, axes=axes), (_at(x), _at(y)),
+        name="tensordot",
+    )
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    from ._dispatch import as_tensor as _at
+
+    return AG.apply(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        (_at(x),), name="diagonal",
+    )
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (diag_embed_op parity): the last dim of
+    `input` becomes the (offset) diagonal of a new square matrix placed on
+    (dim1, dim2)."""
+    from ._dispatch import as_tensor as _at
+
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = []
+        src = {d1: nd - 2, d2: nd - 1}
+        it = iter(perm)
+        for d in range(nd):
+            order.append(src[d] if d in src else next(it))
+        return jnp.transpose(out, order)
+
+    return AG.apply(f, (_at(input),), name="diag_embed")
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (Tensor.unfold parity): returns a view
+    with a trailing window dim."""
+    from ._dispatch import as_tensor as _at
+
+    x = _at(x)
+    axis = axis % len(x.shape)
+    dim = x.shape[axis]
+    n_win = (dim - size) // step + 1
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)            # [dim, ...rest]
+        starts = jnp.arange(n_win) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]
+        win = moved[idx]                            # [n_win, size, ...rest]
+        win = jnp.moveaxis(win, 1, -1)              # [n_win, ...rest, size]
+        return jnp.moveaxis(win, 0, axis)           # axis->n_win, +[size]
+
+    return AG.apply(f, (x,), name="unfold")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    from ._dispatch import as_tensor as _at, canon_shape
+
+    x = _at(x)
+    shp = canon_shape(shape)
+    offs = canon_shape(offsets) if offsets is not None else (0,) * len(shp)
+    shp = tuple(
+        x.shape[i] - offs[i] if d in (-1, None) else d
+        for i, d in enumerate(shp)
+    )
+
+    def f(a):
+        return jax.lax.dynamic_slice(a, offs, shp)
+
+    return AG.apply(f, (x,), name="crop")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Recode global ids into per-shard local ids (shard_index_op parity;
+    the TP embedding-split helper)."""
+    from ._dispatch import as_tensor as _at
+
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}"
+        )
+    size = (index_num + nshards - 1) // nshards
+
+    def f(ids):
+        shard = ids // size
+        local = ids % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return AG.apply_nondiff(f, (_at(input),))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """Deduplicate consecutive runs. Output size is data-dependent -> host
+    computed (outside jit), like reference unique ops on dynamic LoD."""
+    import numpy as np
+
+    from ._dispatch import as_tensor as _at
+    from ..core.dtype import convert_dtype
+
+    x = _at(x)
+    a = np.asarray(jax.device_get(x._data))
+    if axis is None:
+        flat = a.reshape(-1)
+        keep = np.ones(flat.shape[0], bool)
+        keep[1:] = flat[1:] != flat[:-1]
+        out = flat[keep]
+    else:
+        moved = np.moveaxis(a, axis, 0)
+        keep = np.ones(moved.shape[0], bool)
+        keep[1:] = np.any(
+            moved[1:].reshape(moved.shape[0] - 1, -1)
+            != moved[:-1].reshape(moved.shape[0] - 1, -1), axis=1
+        )
+        out = np.moveaxis(moved[keep], 0, axis)
+    results = [Tensor(out)]
+    d = convert_dtype(dtype)
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(inv.astype(d)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, keep.shape[0]))
+        results.append(Tensor(cnt.astype(d)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def masked_fill(x, mask, value, name=None):
+    from ._dispatch import as_tensor as _at
+
+    v = value._data if isinstance(value, Tensor) else value
+    return AG.apply(
+        lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+        (_at(x), _at(mask)), name="masked_fill",
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    from ._dispatch import as_tensor as _at
+
+    def f(a, idx, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return AG.apply(f, (_at(x), _at(index), _at(value)), name="index_add")
+
+
+def index_fill(x, index, axis, value, name=None):
+    from ._dispatch import as_tensor as _at
+
+    v = value._data if isinstance(value, Tensor) else value
+
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[idx].set(jnp.asarray(v, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return AG.apply(f, (_at(x), _at(index)), name="index_fill")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    from ._dispatch import as_tensor as _at
+
+    idx_t = tuple(_at(i) for i in indices)
+
+    def f(a, v, *idxs):
+        if accumulate:
+            return a.at[idxs].add(v.astype(a.dtype))
+        return a.at[idxs].set(v.astype(a.dtype))
+
+    return AG.apply(f, (_at(x), _at(value)) + idx_t, name="index_put")
+
+
+view = reshape  # paddle.view is reshape without copy; XLA decides layout
+
+
+__all__ += [
+    "tensordot", "diagonal", "diag_embed", "unfold", "crop", "shard_index",
+    "unique_consecutive", "masked_fill", "index_add", "index_fill",
+    "index_put", "view",
+]

@@ -117,8 +117,10 @@ def clip(x, min=None, max=None, name=None):
 
 
 def lerp(x, y, weight, name=None):
-    w = weight._data if isinstance(weight, Tensor) else weight
-    return AG.apply(lambda a, b: a + w * (b - a), (x, y), name="lerp")
+    if isinstance(weight, Tensor):
+        return AG.apply(lambda a, b, w: a + w * (b - a), (x, y, weight),
+                        name="lerp")
+    return AG.apply(lambda a, b: a + weight * (b - a), (x, y), name="lerp")
 
 
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
@@ -334,3 +336,187 @@ def inner(x, y, name=None):
 
 def outer(x, y, name=None):
     return AG.apply(jnp.outer, (x, y), name="outer")
+
+
+# -- round-4 op-gap closure (reference op-library parity, VERDICT r3 #6) ----
+logcumsumexp = unary(
+    lambda x, axis=None: (
+        jax.lax.cumlogsumexp(x.reshape(-1) if axis is None else x,
+                             axis=0 if axis is None else axis)
+    ),
+    "logcumsumexp",
+)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return AG.apply(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        (x if isinstance(x, Tensor) else Tensor(x),),
+        name="nan_to_num",
+    )
+
+
+sgn = unary(jnp.sign, "sgn")
+signbit = nondiff(jnp.signbit, "signbit")
+isposinf = nondiff(jnp.isposinf, "isposinf")
+isneginf = nondiff(jnp.isneginf, "isneginf")
+isreal = nondiff(jnp.isreal, "isreal")
+i0 = unary(jax.scipy.special.i0, "i0")
+i0e = unary(jax.scipy.special.i0e, "i0e")
+i1 = unary(jax.scipy.special.i1, "i1")
+i1e = unary(jax.scipy.special.i1e, "i1e")
+
+
+def polygamma(x, n, name=None):
+    return AG.apply(
+        lambda a: jax.scipy.special.polygamma(n, a),
+        (x if isinstance(x, Tensor) else Tensor(x),),
+        name="polygamma",
+    )
+
+
+def _trapz_fn():
+    fn = getattr(jnp, "trapezoid", None)
+    return fn if fn is not None else jnp.trapz
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    f = _trapz_fn()
+    y = y if isinstance(y, Tensor) else Tensor(y)
+    if x is not None:
+        return AG.apply(
+            lambda yy, xx: f(yy, x=xx, axis=axis), (y, x), name="trapezoid"
+        )
+    return AG.apply(
+        lambda yy: f(yy, dx=1.0 if dx is None else dx, axis=axis),
+        (y,), name="trapezoid",
+    )
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = y if isinstance(y, Tensor) else Tensor(y)
+
+    def ct(yy, spacing):
+        y1 = jax.lax.slice_in_dim(yy, 1, None, axis=axis)
+        y0 = jax.lax.slice_in_dim(yy, 0, yy.shape[axis] - 1, axis=axis)
+        return jnp.cumsum((y0 + y1) / 2 * spacing, axis=axis)
+
+    if x is not None:
+        def f(yy, xx):
+            d = jnp.diff(xx, axis=axis)
+            return ct(yy, d)
+
+        return AG.apply(f, (y, x), name="cumulative_trapezoid")
+    return AG.apply(
+        lambda yy: ct(yy, 1.0 if dx is None else dx), (y,),
+        name="cumulative_trapezoid",
+    )
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return AG.apply(
+        lambda a: jnp.vander(a, N=n, increasing=increasing),
+        (x if isinstance(x, Tensor) else Tensor(x),),
+        name="vander",
+    )
+
+
+ldexp = binary(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), "ldexp")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    from ._dispatch import as_tensor as _at
+
+    d = jnp.int32 if (out_int32 or not jax.config.read("jax_enable_x64")) \
+        else jnp.int64
+    return AG.apply_nondiff(
+        lambda a, s: jnp.searchsorted(
+            s, a, side="right" if right else "left"
+        ).astype(d),
+        (_at(x), _at(sorted_sequence)),
+    )
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    from ._dispatch import as_tensor as _at
+
+    return AG.apply_nondiff(
+        lambda a, t: jnp.isin(a, t, assume_unique=assume_unique,
+                              invert=invert),
+        (_at(x), _at(test_x)),
+    )
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened gather (paddle.take): index into x.flatten(). mode=
+    "raise" bounds-checks eagerly on concrete indices (under jit, where a
+    data-dependent raise cannot exist, it degrades to clip)."""
+    import numpy as _np
+
+    from ._dispatch import as_tensor as _at
+
+    if mode == "raise":
+        it = index if isinstance(index, Tensor) else Tensor(index)
+        try:
+            idx_np = _np.asarray(jax.device_get(it._data))
+        except Exception:
+            idx_np = None  # traced index: data-dependent raise impossible
+        if idx_np is not None and idx_np.size:
+            xt = x if isinstance(x, Tensor) else Tensor(x)
+            n = 1
+            for s in xt.shape:
+                n *= s
+            if idx_np.max() >= n or idx_np.min() < -n:
+                raise IndexError(
+                    f"take: index out of range for tensor with {n} elements"
+                )
+    jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return AG.apply(
+        lambda a, i: jnp.take(a.reshape(-1), i, mode=jmode),
+        (_at(x), _at(index)), name="take",
+    )
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clipping along `axis` (renorm_op parity)."""
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return AG.apply(f, (x if isinstance(x, Tensor) else Tensor(x),),
+                    name="renorm")
+
+
+def numel(x, name=None):
+    import numpy as _np
+
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor(_np.int64(int(_np.prod(x.shape)) if x.shape else 1))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return AG.apply(
+        lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+        (x if isinstance(x, Tensor) else Tensor(x),), name="nanmedian",
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return AG.apply(
+        lambda a: jnp.nanquantile(a, q, axis=axis, keepdims=keepdim),
+        (x if isinstance(x, Tensor) else Tensor(x),), name="nanquantile",
+    )
+
+
+__all__ += [
+    "logcumsumexp", "nan_to_num", "sgn", "signbit", "isposinf", "isneginf",
+    "isreal", "i0", "i0e", "i1", "i1e", "polygamma", "trapezoid",
+    "cumulative_trapezoid", "vander", "ldexp", "bucketize", "isin", "take",
+    "renorm", "numel", "nanmedian", "nanquantile",
+]

@@ -1,0 +1,129 @@
+"""Sequence / ragged ops — the LoD policy (SURVEY.md §7 hard parts).
+
+Reference: the LoD ragged-batch representation (lod_tensor.h:114) feeding
+operators/sequence_ops/ (sequence_pad_op, sequence_unpad_op,
+sequence_mask_op, sequence_pool_op, ...). LoD offsets do not exist on TPU
+— dynamic row partitions defeat XLA's static shapes — so the policy is
+**dense + lengths/segment-ids**: every ragged value travels as a padded
+dense tensor plus an int lengths (or segment-ids) tensor, and sequence
+ops take the lengths explicitly. segment_* mirror the reference's
+sequence_pool kernels (sum/mean/max/min over rows of one sequence) in
+segment-ids form, implemented on jax.ops.segment_* so XLA lowers them to
+one-hot matmuls/scatters that tile onto the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ._dispatch import as_tensor, nondiff
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[N] lengths -> [N, maxlen] 0/1 mask (sequence_mask_op.cc parity).
+    `maxlen` must be static (None -> needs concrete lengths; prefer
+    passing maxlen under jit)."""
+    x = as_tensor(x)
+    if maxlen is None:
+        import numpy as np
+
+        maxlen = int(np.asarray(jax.device_get(x._data)).max())
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+
+    def f(lens):
+        r = jnp.arange(maxlen)
+        return (r[None, :] < lens[..., None]).astype(d)
+
+    return AG.apply_nondiff(f, (x,))
+
+
+def sequence_pad(x, pad_value, maxlen, lengths, name=None):
+    """Ragged rows (concatenated [total, ...] + lengths) -> padded
+    [batch, maxlen, ...] (sequence_pad_op parity; LoD -> lengths).
+    Returns (padded, lengths)."""
+    x, lengths = as_tensor(x), as_tensor(lengths)
+    pv = float(pad_value) if not isinstance(pad_value, Tensor) else pad_value
+    n = int(lengths.shape[0])
+
+    def f(vals, lens, *pvt):
+        pad = pvt[0] if pvt else jnp.asarray(pv, vals.dtype)
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), lens.dtype), jnp.cumsum(lens)[:-1]]
+        )
+        pos = jnp.arange(maxlen)
+        idx = starts[:, None] + pos[None, :]           # [n, maxlen]
+        valid = pos[None, :] < lens[:, None]
+        safe = jnp.clip(idx, 0, vals.shape[0] - 1)
+        out = vals[safe]                                # [n, maxlen, ...]
+        mask = valid.reshape(valid.shape + (1,) * (out.ndim - 2))
+        return jnp.where(mask, out, pad.astype(vals.dtype))
+
+    args = (x, lengths) + (
+        (pad_value,) if isinstance(pad_value, Tensor) else ()
+    )
+    padded = AG.apply(f, args, name="sequence_pad")
+    return padded, lengths
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [batch, maxlen, ...] + lengths -> concatenated [total, ...]
+    (sequence_unpad_op parity). `length` must be host-concrete (the output
+    row count is data-dependent — outside jit only, like every dynamic-
+    shape op under XLA)."""
+    import numpy as np
+
+    x, length = as_tensor(x), as_tensor(length)
+    lens = np.asarray(jax.device_get(length._data))
+
+    def f(vals):
+        rows = [vals[i, : int(l)] for i, l in enumerate(lens)]
+        return jnp.concatenate(rows, axis=0)
+
+    return AG.apply(f, (x,), name="sequence_unpad")
+
+
+def _segment(pool):
+    def op(data, segment_ids, name=None, *, num_segments=None):
+        data, segment_ids = as_tensor(data), as_tensor(segment_ids)
+        import numpy as np
+
+        n = num_segments
+        if n is None:
+            n = int(np.asarray(jax.device_get(segment_ids._data)).max()) + 1
+
+        def f(vals, ids):
+            if pool == "sum":
+                return jax.ops.segment_sum(vals, ids, num_segments=n)
+            if pool == "mean":
+                s = jax.ops.segment_sum(vals, ids, num_segments=n)
+                cnt = jax.ops.segment_sum(
+                    jnp.ones((vals.shape[0],), vals.dtype), ids,
+                    num_segments=n,
+                )
+                cnt = jnp.maximum(cnt, 1).reshape(
+                    (n,) + (1,) * (vals.ndim - 1)
+                )
+                return s / cnt
+            if pool == "max":
+                return jax.ops.segment_max(vals, ids, num_segments=n)
+            return jax.ops.segment_min(vals, ids, num_segments=n)
+
+        return AG.apply(f, (data, segment_ids), name=f"segment_{pool}")
+
+    op.__name__ = f"segment_{pool}"
+    return op
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")

@@ -102,10 +102,7 @@ class Executor:
                 outs = tuple(outs) if op.multi else (outs,)
                 for var, o in zip(op.out_vars, outs):
                     env[var.id] = o
-            fetches = tuple(
-                env[v.id] if isinstance(v, Variable) else resolve(v)
-                for v in fetch_vars
-            )
+            fetches = tuple(resolve(v) for v in fetch_vars)
             return fetches, env
 
         directives = program.optimize_directives
