@@ -61,11 +61,22 @@ def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label):
     compile_s = time.perf_counter() - t0
 
     # steady state: async dispatch, one block at the end -> steps pipeline
+    # optional device-trace artifact (DeviceTracer/GenProfile analog):
+    # PADDLE_TPU_TRACE=<dir> captures an XPlane trace of the timed loop
+    import os
+
+    trace_dir = os.environ.get("PADDLE_TPU_TRACE")
+    if trace_dir:
+        from paddle_tpu import profiler as prof
+
+        prof.start_profiler(trace_dir=os.path.join(trace_dir, label))
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(x, y)
     jax.block_until_ready(loss._data)
     dt = time.perf_counter() - t0
+    if trace_dir:
+        prof.stop_profiler()
 
     # one blocked step isolates device time from host dispatch overhead
     t0 = time.perf_counter()

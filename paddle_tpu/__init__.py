@@ -50,6 +50,7 @@ from . import distributed  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from . import hapi  # noqa: F401
+from . import profiler  # noqa: F401
 from . import static  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .ops import creation, linalg, logic, manipulation, math, search  # noqa: F401

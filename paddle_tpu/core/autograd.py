@@ -35,6 +35,7 @@ class _State(threading.local):
 
 
 _state = _State()
+_profiler_mod = None
 
 
 def is_grad_enabled() -> bool:
@@ -122,8 +123,24 @@ def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
 
     Returns Tensor or tuple[Tensor] mirroring raw_fn's output structure.
     The Tracer::TraceOp analog: forward dispatch + tape append
-    (reference: tracer.cc:132,205 CreateGradOpNode).
+    (reference: tracer.cc:132,205 CreateGradOpNode). When the profiler is
+    on, each dispatch shows up as an `op::<name>` event (the RecordEvent
+    in Tracer::TraceOp, tracer.cc:137).
     """
+    global _profiler_mod
+    if _profiler_mod is None:
+        from .. import profiler as _p
+
+        _profiler_mod = _p
+    if _profiler_mod._enabled:
+        with _profiler_mod.RecordEvent(
+            f"op::{name or getattr(raw_fn, '__name__', 'op')}"
+        ):
+            return _apply_impl(raw_fn, tensors, name)
+    return _apply_impl(raw_fn, tensors, name)
+
+
+def _apply_impl(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
     from .tensor import Tensor  # late import; Tensor depends on ops at patch time
 
     rec = _maybe_static_record(raw_fn, tensors, name)

@@ -33,6 +33,10 @@ from .collective import (  # noqa: F401
 )
 from .parallel import DataParallel  # noqa: F401
 from .pipeline import PipelineLayer, PipelineParallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
+# NOTE: .launch is deliberately not imported here — it is the
+# `python -m paddle_tpu.distributed.launch` entry point, and importing it
+# eagerly would trip runpy's re-execution warning.
 from . import fleet  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear,

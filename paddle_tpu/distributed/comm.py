@@ -92,6 +92,7 @@ class _CommState(threading.local):
 
 
 _state = _CommState()
+_jax_dist_initialized = False
 
 
 def _ensure_init() -> Group:
@@ -110,21 +111,39 @@ def init_parallel_env(backend: Optional[str] = None) -> "ParallelEnv":
     """
     import os
 
+    global _jax_dist_initialized
+
+    def _dist_client_active():
+        # must not touch jax.process_count() here: that initializes the
+        # XLA backend, after which jax.distributed.initialize refuses to
+        # run. The distributed client state is the pre-backend signal.
+        try:
+            from jax._src import distributed as _jd
+
+            return _jd.global_state.client is not None
+        except Exception:
+            return False
+
     if (int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1
             and os.environ.get("PADDLE_TRAINER_ENDPOINTS")
-            and jax.process_count() == 1):
+            and not _jax_dist_initialized
+            and not _dist_client_active()):
         # Multi-host launch: endpoints list ≙ coordinator bootstrap
-        # (gen_comm_id_helper.cc:284 SendBroadCastCommID analog).
-        try:
-            coordinator = os.environ[
-                "PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),
-                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        # (gen_comm_id_helper.cc:284 SendBroadCastCommID analog). Failures
+        # propagate: a typo'd coordinator address must NOT degrade to
+        # silent single-host training.
+        coordinator = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+        if ":" not in coordinator:
+            raise ValueError(
+                "PADDLE_TRAINER_ENDPOINTS entries must be host:port, got "
+                f"{coordinator!r}"
             )
-        except Exception:  # already initialized or single-host fallback
-            pass
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+        _jax_dist_initialized = True
     if _state.default_group is None:
         devs = jax.devices()
         _state.default_group = Group(devs, axis_name="dp", gid=0)

@@ -1,0 +1,144 @@
+"""Distributed launch runner.
+
+Reference: python/paddle/distributed/fleet/launch.py — launch() :334,
+launch_collective :208 (build Cluster from env/args, spawn one subprocess
+per device with PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS /
+FLAGS_selected_gpus, then a watch loop that tears the job down when any
+proc dies — launch_utils.py:996-1118 TrainerProc management).
+
+TPU-native: one process PER HOST (not per chip — a jax process owns all
+its local chips), `PADDLE_TRAINER_ENDPOINTS`'s first entry doubling as
+the jax.distributed coordinator address (the gen_comm_id TCP-bootstrap
+analog). `--nproc_per_node > 1` exists for CPU-backend testing where each
+proc simulates a host.
+
+Usage::
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
+        [--ips=h1,h2] [--start_port=6170] train.py [args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List
+
+__all__ = ["launch", "build_cluster_env", "main"]
+
+
+def build_cluster_env(nproc: int, ips: str = "127.0.0.1",
+                      start_port: int = 6170,
+                      base_env: Dict[str, str] = None) -> List[Dict[str, str]]:
+    """Per-rank environment blocks (launch_utils.py get_cluster analog).
+
+    Endpoints are host:port pairs, rank-major across hosts; rank 0's
+    endpoint is the coordinator address.
+    """
+    if nproc < 1:
+        raise ValueError(f"nproc must be >= 1, got {nproc}")
+    hosts = [h.strip() for h in ips.split(",") if h.strip()]
+    if not hosts:
+        raise ValueError(f"no hosts parsed from ips={ips!r}")
+    endpoints = []
+    for host in hosts:
+        for p in range(nproc):
+            endpoints.append(f"{host}:{start_port + p}")
+    envs = []
+    for rank, ep in enumerate(endpoints):
+        env = dict(base_env if base_env is not None else os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_CURRENT_ENDPOINT": ep,
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        })
+        envs.append(env)
+    return envs
+
+
+def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
+           ips: str = "127.0.0.1", start_port: int = 6170,
+           backend: str = None, node_rank: int = None) -> int:
+    """Spawn THIS node's ranks and babysit them (launch_collective :208).
+
+    `node_rank` selects which host of `ips` this invocation is (default
+    env PADDLE_NODE_RANK, else 0); only that host's ranks spawn here —
+    remote hosts run the same command with their own node_rank. Returns
+    the first non-zero exit code (0 on full success); on any failure the
+    remaining ranks are terminated (the watch-loop teardown).
+    """
+    if node_rank is None:
+        node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
+    hosts = [h.strip() for h in ips.split(",") if h.strip()]
+    if not 0 <= node_rank < len(hosts):
+        raise ValueError(
+            f"node_rank {node_rank} out of range for {len(hosts)} hosts"
+        )
+    envs = build_cluster_env(nproc_per_node, ips=ips, start_port=start_port)
+    lo = node_rank * nproc_per_node
+    envs = envs[lo:lo + nproc_per_node]
+    procs = []
+    for env in envs:
+        if backend:
+            env["JAX_PLATFORM_NAME"] = backend
+        p = subprocess.Popen(
+            [sys.executable, script] + list(script_args), env=env
+        )
+        procs.append(p)
+    rc = 0
+    try:
+        while procs:
+            alive = []
+            for p in procs:
+                code = p.poll()
+                if code is None:
+                    alive.append(p)
+                elif code != 0 and rc == 0:
+                    rc = code  # first failure wins; tear the job down
+            if rc != 0:
+                break
+            procs = alive
+            if procs:
+                time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="spawn per-host training processes (fleet launch analog)",
+    )
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=None,
+                        help="index of this host in --ips "
+                             "(default: $PADDLE_NODE_RANK or 0)")
+    parser.add_argument("--ips", type=str, default="127.0.0.1")
+    parser.add_argument("--start_port", type=int,
+                        default=int(os.environ.get("PADDLE_PORT", 6170)))
+    parser.add_argument("--backend", type=str, default=None,
+                        help="force a jax backend in children (e.g. cpu)")
+    parser.add_argument("script", type=str)
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    rc = launch(
+        args.script, args.script_args, nproc_per_node=args.nproc_per_node,
+        ips=args.ips, start_port=args.start_port, backend=args.backend,
+    )
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

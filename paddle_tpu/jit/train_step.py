@@ -291,6 +291,12 @@ class TrainStep:
 
     # -- eager entry ---------------------------------------------------------
     def __call__(self, inputs, labels=None):
+        from .. import profiler as _profiler
+
+        with _profiler.RecordEvent("TrainStep"):
+            return self._call_impl(inputs, labels)
+
+    def _call_impl(self, inputs, labels=None):
         if self._delegate is not None:
             return self._delegate(inputs, labels)
         opt = self.opt

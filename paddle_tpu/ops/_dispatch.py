@@ -42,6 +42,7 @@ def as_tensor(x, like=None):
 def unary(fn, name=None):
     def op(x, *, _fn=fn, **kw):
         x = as_tensor(x)
+        kw.pop("name", None)  # paddle-API name= is documentation only
         if kw:
             return AG.apply(lambda a: _fn(a, **kw), (x,), name=name)
         return AG.apply(_fn, (x,), name=name)
@@ -77,6 +78,7 @@ def nondiff(fn, name=None):
 
     def op(*args, _fn=fn, **kw):
         ts = tuple(as_tensor(a) for a in args)
+        kw.pop("name", None)
         if kw:
             return AG.apply_nondiff(lambda *r: _fn(*r, **kw), ts)
         return AG.apply_nondiff(_fn, ts)

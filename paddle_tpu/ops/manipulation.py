@@ -514,7 +514,7 @@ def crop(x, shape=None, offsets=None, name=None):
     from ._dispatch import as_tensor as _at, canon_shape
 
     x = _at(x)
-    shp = canon_shape(shape)
+    shp = canon_shape(shape) if shape is not None else tuple(x.shape)
     offs = canon_shape(offsets) if offsets is not None else (0,) * len(shp)
     shp = tuple(
         x.shape[i] - offs[i] if d in (-1, None) else d

@@ -52,7 +52,6 @@ def sequence_pad(x, pad_value, maxlen, lengths, name=None):
     Returns (padded, lengths)."""
     x, lengths = as_tensor(x), as_tensor(lengths)
     pv = float(pad_value) if not isinstance(pad_value, Tensor) else pad_value
-    n = int(lengths.shape[0])
 
     def f(vals, lens, *pvt):
         pad = pvt[0] if pvt else jnp.asarray(pv, vals.dtype)

@@ -1,0 +1,150 @@
+"""Profiling / tracing (SURVEY.md §5; VERDICT r3 item 7).
+
+Reference: paddle/fluid/platform/profiler.h — RAII `RecordEvent` (:127)
+host annotations sprinkled through hot paths (tracer.cc:137,
+basic_engine.cc:284), `EnableProfiler`/`DisableProfiler` (:210,:213) with
+per-event aggregation tables; device timeline via CUPTI DeviceTracer
+(device_tracer.cc:278) dumping a chrome-trace proto; Python facade
+fluid/profiler.py.
+
+TPU-native: `RecordEvent` pairs a host-side timing registry with
+`jax.profiler.TraceAnnotation`, so events appear both in the host summary
+table and on the device timeline; `start_profiler`/`stop_profiler` wrap
+`jax.profiler.start_trace` (XPlane/TensorBoard artifact — the
+DeviceTracer analog, produced by libtpu rather than CUPTI). Op dispatch
+and TrainStep carry RecordEvent hooks that cost one module-flag check
+when profiling is off.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "RecordEvent", "record_event", "start_profiler", "stop_profiler",
+    "profiler", "is_profiling", "event_summary", "reset_profiler",
+]
+
+_enabled = False          # host event recording on?
+_trace_dir: Optional[str] = None
+
+
+class _Registry(threading.local):
+    def __init__(self):
+        self.events: Dict[str, List[float]] = {}
+        self.stack: List = []
+
+
+_reg = _Registry()
+
+
+def is_profiling() -> bool:
+    return _enabled
+
+
+class RecordEvent:
+    """RAII event annotation (profiler.h:127). Usable as a context manager
+    or decorator; nests; no-op (one flag check) when profiling is off."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def __enter__(self):
+        if _enabled:
+            import jax
+
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            _reg.events.setdefault(self.name, []).append(dt)
+            self._ann.__exit__(*exc)
+            self._t0 = None
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **kw):
+            with RecordEvent(self.name):
+                return fn(*a, **kw)
+
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped
+
+
+record_event = RecordEvent
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   trace_dir: Optional[str] = None):
+    """EnableProfiler analog (profiler.h:210). `trace_dir` additionally
+    captures a device XPlane trace (TensorBoard-loadable)."""
+    global _enabled, _trace_dir
+    _enabled = True
+    _reg.events = {}
+    if trace_dir is not None:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        _trace_dir = trace_dir
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
+    """DisableProfiler analog: stops recording, dumps the event table
+    (and ends the device trace if one is running). Returns the summary."""
+    global _enabled, _trace_dir
+    _enabled = False
+    if _trace_dir is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    summary = event_summary(sorted_key)
+    if profile_path:
+        import json
+
+        with open(profile_path, "w") as f:
+            json.dump(summary, f, indent=2)
+    return summary
+
+
+def event_summary(sorted_key: str = "total") -> Dict[str, Dict[str, float]]:
+    """Aggregated event table (profiler's PrintProfiler analog):
+    name -> {calls, total_ms, avg_ms, max_ms, min_ms}."""
+    out = {}
+    for name, times in _reg.events.items():
+        total = sum(times)
+        out[name] = {
+            "calls": len(times),
+            "total_ms": total * 1e3,
+            "avg_ms": total / len(times) * 1e3,
+            "max_ms": max(times) * 1e3,
+            "min_ms": min(times) * 1e3,
+        }
+    key = {"total": "total_ms", "calls": "calls", "max": "max_ms",
+           "min": "min_ms", "ave": "avg_ms"}.get(sorted_key, "total_ms")
+    return dict(
+        sorted(out.items(), key=lambda kv: -kv[1][key])
+    )
+
+
+def reset_profiler():
+    _reg.events = {}
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", tracer_option: str = "Default",
+             trace_dir: Optional[str] = None, profile_path: Optional[str] = None):
+    """fluid/profiler.py context-manager facade."""
+    start_profiler(state, tracer_option, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(profile_path=profile_path)
