@@ -136,6 +136,7 @@ def main(argv=None):
     rc = launch(
         args.script, args.script_args, nproc_per_node=args.nproc_per_node,
         ips=args.ips, start_port=args.start_port, backend=args.backend,
+        node_rank=args.node_rank,
     )
     sys.exit(rc)
 

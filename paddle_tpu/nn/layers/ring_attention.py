@@ -1,0 +1,182 @@
+"""Blockwise (online-softmax) and ring attention — long-context core.
+
+Reference gap (SURVEY.md §5 long-context): the reference has NO sequence/
+context parallelism — attention is the materialized matmul-softmax of
+nn/layer/transformer.py MultiHeadAttention. This module is the TPU-native
+green-field design:
+
+  - `blockwise_attention`: flash-style online-softmax accumulation over KV
+    blocks — O(block) memory instead of O(S^2), exact softmax attention.
+  - `ring_attention`: the same accumulation with the KV blocks living on
+    the `sp` mesh axis; each step overlaps a `lax.ppermute` KV rotation
+    around the ICI ring with the local block's compute, so S scales with
+    the number of devices at constant per-device memory.
+
+Layouts: [B, H, S, D] (post head-split, as MultiHeadAttention produces).
+Accumulation runs in f32 regardless of input dtype (bf16-safe), matching
+the flash-attention recipe. Causal masking uses global positions (the
+sp-shard offset of each KV block).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...distributed import comm
+
+__all__ = ["blockwise_attention", "ring_attention", "ring_attention_raw"]
+
+_NEG = -1e30
+
+
+def _block_step(q, k, v, scale, o, m, l, mask=None):
+    """One online-softmax accumulation step.
+
+    q [B,H,Sq,D]; k,v [B,H,Sk,D]; o [B,H,Sq,D] f32; m,l [B,H,Sq] f32.
+    Returns updated (o, m, l). `mask` [Sq,Sk] additive (0 / -inf-ish).
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = s + mask
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return o_new, m_new, l_new
+
+
+def _blockwise_raw(q, k, v, *, causal=False, block_size=512, scale=None):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    scale = scale if scale is not None else D ** -0.5
+    block = min(block_size, Sk)
+    n_blocks = (Sk + block - 1) // block
+    qf = q.astype(jnp.float32)
+
+    o = jnp.zeros((B, H, S, D), jnp.float32)
+    m = jnp.full((B, H, S), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+    qpos = jnp.arange(S)
+    for j in range(n_blocks):
+        lo = j * block
+        hi = min(lo + block, Sk)
+        kj = k[:, :, lo:hi].astype(jnp.float32)
+        vj = v[:, :, lo:hi]
+        mask = None
+        if causal:
+            kpos = jnp.arange(lo, hi)
+            mask = jnp.where(kpos[None, :] > qpos[:, None], _NEG, 0.0)
+        o, m, l = _block_step(qf, kj, vj, scale, o, m, l, mask)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def blockwise_attention(q, k, v, causal=False, block_size=512, scale=None):
+    """Exact softmax attention with O(block) score memory (flash-style).
+    q,k,v: [B, H, S, D] Tensors or arrays."""
+    from ...core import autograd as AG
+
+    ts = tuple(
+        x if isinstance(x, Tensor) else Tensor(x) for x in (q, k, v)
+    )
+    return AG.apply(
+        partial(_blockwise_raw, causal=causal, block_size=block_size,
+                scale=scale),
+        ts, name="blockwise_attention",
+    )
+
+
+def _ring_raw(q, k, v, *, axis_name, sp_size, causal, scale):
+    """Per-device body under shard_map: local q stays put, kv rotates
+    around the ring; global causal positions come from the shard index."""
+    idx = jax.lax.axis_index(axis_name)
+    B, H, Sl, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    qf = q.astype(jnp.float32)
+    qpos = idx * Sl + jnp.arange(Sl)
+
+    perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+
+    def body(step, carry):
+        o, m, l, kc, vc = carry
+        src = (idx - step) % sp_size  # whose KV block we hold this step
+        mask = None
+        if causal:
+            kpos = src * Sl + jnp.arange(Sl)
+            mask = jnp.where(kpos[None, :] > qpos[:, None], _NEG, 0.0)
+        o, m, l = _block_step(
+            qf, kc.astype(jnp.float32), vc, scale, o, m, l, mask
+        )
+        # rotate AFTER compute; XLA overlaps the ppermute with the next
+        # step's einsums (async collectives over ICI)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return o, m, l, kc, vc
+
+    o = jnp.zeros((B, H, Sl, D), jnp.float32)
+    m = jnp.full((B, H, Sl), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, Sl), jnp.float32)
+    carry = (o, m, l, k, v)
+    # python loop: sp_size is static and small; each iteration's mask
+    # offset differs (static unrolled ring like the pipeline's 1F1B loop)
+    for step in range(sp_size):
+        carry = body(step, carry)
+    o, m, l = carry[0], carry[1], carry[2]
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_raw(q, k, v, *, axis_name="sp", sp_size=None,
+                       causal=False, scale=None):
+    """shard_map-region form: call INSIDE an spmd region where q/k/v are
+    the local [B,H,S/sp,D] shards (the building block TrainStep-traced
+    models hit via MultiHeadAttention(seq_parallel=True))."""
+    if sp_size is None:
+        sp_size = jax.lax.axis_size(axis_name)
+    return _ring_raw(q, k, v, axis_name=axis_name, sp_size=sp_size,
+                     causal=causal, scale=scale)
+
+
+def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
+                   causal=False, scale=None):
+    """Single-controller form: q,k,v are GLOBAL [B,H,S,D] Tensors; S is
+    sharded over the mesh's sp axis, the ring program runs one compiled
+    shard_map, and the global output returns with the same sharding."""
+    from ...core import autograd as AG
+
+    mesh = mesh if mesh is not None else comm.hybrid_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "ring_attention needs a mesh with an 'sp' axis: fleet.init "
+            "with hybrid_configs sp_degree, or pass mesh="
+        )
+    sp = mesh.shape[sp_axis]
+    spec = P(None, None, sp_axis, None)
+
+    def f(qr, kr, vr):
+        qr, kr, vr = (
+            jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+            for x in (qr, kr, vr)
+        )
+        body = comm.shard_map(
+            partial(_ring_raw, axis_name=sp_axis, sp_size=sp,
+                    causal=causal, scale=scale),
+            mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return body(qr, kr, vr)
+
+    ts = tuple(
+        x if isinstance(x, Tensor) else Tensor(x) for x in (q, k, v)
+    )
+    return AG.apply(f, ts, name="ring_attention")

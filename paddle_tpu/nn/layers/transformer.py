@@ -46,8 +46,18 @@ class MultiHeadAttention(Layer):
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
-                 need_weights=False, weight_attr=None, bias_attr=None):
+                 need_weights=False, weight_attr=None, bias_attr=None,
+                 attn_impl="dense", causal=False, block_size=512):
+        # attn_impl: "dense" (materialized scores, reference semantics),
+        # "blockwise" (online-softmax, O(block) memory), or "ring"
+        # (sequence-parallel over the hybrid mesh's sp axis — the
+        # long-context path the reference lacks, SURVEY.md §5)
         super().__init__()
+        if attn_impl not in ("dense", "blockwise", "ring"):
+            raise ValueError(f"unknown attn_impl {attn_impl!r}")
+        self.attn_impl = attn_impl
+        self.causal = causal
+        self.block_size = block_size
         self.embed_dim = embed_dim
         self.kdim = kdim or embed_dim
         self.vdim = vdim or embed_dim
@@ -97,26 +107,64 @@ class MultiHeadAttention(Layer):
                 v = concat([cache.v, v], axis=2)
                 cache = MultiHeadAttention.Cache(k, v)
 
+        if self.attn_impl != "dense":
+            # flash-style paths never materialize the weights and use
+            # LOCAL query positions for causal masking — features that
+            # need either are rejected loudly, not silently wrong
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "blockwise/ring attention support causal=True masking "
+                    "only; arbitrary attn_mask needs the dense impl"
+                )
+            if self.dropout and self.training:
+                raise NotImplementedError(
+                    "attention-weight dropout requires the dense impl "
+                    "(flash-style paths never materialize the weights)"
+                )
+            if self.need_weights:
+                raise NotImplementedError(
+                    "need_weights requires the dense impl"
+                )
+            if cache is not None:
+                raise NotImplementedError(
+                    "incremental-decode Cache needs query-position offsets "
+                    "the blockwise/ring paths do not implement yet; use "
+                    "the dense impl for decoding"
+                )
+            from .ring_attention import blockwise_attention, ring_attention
+
+            if self.attn_impl == "blockwise":
+                out = blockwise_attention(
+                    q, k, v, causal=self.causal,
+                    block_size=self.block_size,
+                )
+            else:
+                out = ring_attention(q, k, v, causal=self.causal)
+            weights = None
+        else:
+            out = None
+
         mask = _convert_attention_mask(attn_mask, q._data.dtype)
         scale = self.head_dim ** -0.5
 
-        def score_fn(qr, kr, *m):
-            scores = jnp.einsum("bhqd,bhkd->bhqk", qr, kr) * scale
-            if m:
-                scores = scores + m[0]
-            return jax.nn.softmax(scores, axis=-1)
+        if out is None:
+            def score_fn(qr, kr, *m):
+                scores = jnp.einsum("bhqd,bhkd->bhqk", qr, kr) * scale
+                if m:
+                    scores = scores + m[0]
+                return jax.nn.softmax(scores, axis=-1)
 
-        args = (q, k) + ((mask,) if mask is not None else ())
-        weights = AG.apply(score_fn, args, name="attention_scores")
-        # dropout on the softmax weights, paddle semantics
-        # (nn/layer/transformer.py applies F.dropout to `weights`)
-        if self.dropout and self.training:
-            weights = F.dropout(weights, self.dropout, training=True)
-        out = AG.apply(
-            lambda w, vr: jnp.einsum("bhqk,bhkd->bhqd", w, vr),
-            (weights, v),
-            name="attention_context",
-        )
+            args = (q, k) + ((mask,) if mask is not None else ())
+            weights = AG.apply(score_fn, args, name="attention_scores")
+            # dropout on the softmax weights, paddle semantics
+            # (nn/layer/transformer.py applies F.dropout to `weights`)
+            if self.dropout and self.training:
+                weights = F.dropout(weights, self.dropout, training=True)
+            out = AG.apply(
+                lambda w, vr: jnp.einsum("bhqk,bhkd->bhqd", w, vr),
+                (weights, v),
+                name="attention_context",
+            )
 
         from ...ops.manipulation import reshape, transpose
 
@@ -135,14 +183,17 @@ class MultiHeadAttention(Layer):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
-                 normalize_before=False, weight_attr=None, bias_attr=None):
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 attn_impl="dense", causal=False):
         super().__init__()
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
                                             weight_attr=weight_attr,
-                                            bias_attr=bias_attr)
+                                            bias_attr=bias_attr,
+                                            attn_impl=attn_impl,
+                                            causal=causal)
         self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
         self.dropout = Dropout(act_dropout)
         self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
