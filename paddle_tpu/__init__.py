@@ -82,3 +82,6 @@ def in_dynamic_mode() -> bool:
 
 # paddle.abs etc. come from ops import *; math.max/min shadow builtins only
 # inside this namespace, matching paddle's own API.
+from . import text  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import inference  # noqa: F401,E402

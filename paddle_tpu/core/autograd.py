@@ -140,6 +140,25 @@ def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
     return _apply_impl(raw_fn, tensors, name)
 
 
+def _check_nan_inf(name, outs):
+    """FLAGS_check_nan_inf (platform/flags.cc:44 ->
+    CheckVarHasNanOrInf, details/nan_inf_utils_detail.cc): eager-mode
+    per-op output sentinel. Host-syncs per op — a debug flag, exactly as
+    in the reference; inside jit traces it is a no-op (use the fused
+    finite check of the amp path there)."""
+    from .flags import flag
+
+    if not flag("check_nan_inf") or _state.trace_depth > 0:
+        return
+    for o in outs:
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact):
+            if not bool(jnp.all(jnp.isfinite(o))):
+                raise RuntimeError(
+                    f"FLAGS_check_nan_inf: op '{name or 'op'}' produced "
+                    "NaN/Inf"
+                )
+
+
 def _apply_impl(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
     from .tensor import Tensor  # late import; Tensor depends on ops at patch time
 
@@ -155,6 +174,8 @@ def _apply_impl(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None)
     )
     if not need_grad:
         out = raw_fn(*raws)
+        outs_chk = out if isinstance(out, (tuple, list)) else (out,)
+        _check_nan_inf(name, outs_chk)
         if isinstance(out, (tuple, list)):
             return tuple(Tensor._wrap(o, stop_gradient=True) for o in out)
         return Tensor._wrap(out, stop_gradient=True)
@@ -182,6 +203,7 @@ def _apply_impl(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None)
         grad_tensors = tuple(tensors)
     multi = isinstance(out, (tuple, list))
     outs = tuple(out) if multi else (out,)
+    _check_nan_inf(name, outs)
     node = TapeNode(
         vjp_fn,
         grad_tensors,

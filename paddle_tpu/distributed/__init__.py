@@ -6,10 +6,10 @@ TPU-native core: one device mesh + named-axis XLA collectives (comm.py)
 instead of ring-id'd NCCL communicators; see comm.py / collective.py /
 parallel.py docstrings for the mapping.
 """
-from . import env  # noqa: F401
-from .env import get_rank, get_world_size  # noqa: F401
 from .comm import (  # noqa: F401
     Group,
+    get_rank,
+    get_world_size,
     ParallelEnv,
     get_group,
     init_parallel_env,

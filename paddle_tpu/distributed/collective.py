@@ -231,8 +231,10 @@ def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True,
         from ..core import autograd as AG
 
         def f(x):
-            full = jax.lax.all_gather(x, g.axis_name, axis=0, tiled=False)
-            return full[src]
+            # O(size) select+psum, not an O(nranks*size) all_gather
+            i = jax.lax.axis_index(g.axis_name)
+            contrib = jnp.where(i == src, x, jnp.zeros_like(x))
+            return jax.lax.psum(contrib, g.axis_name)
 
         return _write_back(tensor, AG.apply(f, (_as_t(tensor),),
                                             name="c_broadcast"))
@@ -278,9 +280,40 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op: int = ReduceOp.SUM,
 def scatter(tensor, tensor_list=None, src: int = 0, group=None,
             sync_op: bool = True, use_calc_stream: bool = True):
     """collective.py scatter: rank r receives the r-th chunk held at src.
-    Single-controller eager: the stacked [nranks, ...] layout already places
-    chunk r on device r, so this is a (sharded) identity + provenance note."""
+
+    spmd region: only src's stacked value is read (broadcast-select +
+    per-rank chunk pick), so `src` carries its full meaning. Eager
+    single-controller: one process owns the single copy of tensor_list,
+    so every logical src holds identical data and the stacked layout
+    already places chunk r on device r — `src` is semantically inert
+    THERE (not dropped: there is nothing rank-distinct to choose)."""
     g = _group(group)
+    if comm.in_spmd_region(g.axis_name):
+        from ..core import autograd as AG
+
+        stacked_in = tensor_list if tensor_list is not None else tensor
+        if isinstance(stacked_in, (list, tuple)):
+            raws = tuple(_as_t(t) for t in stacked_in)
+
+            def f(*xs):
+                x = jnp.stack(xs, axis=0)
+                i = jax.lax.axis_index(g.axis_name)
+                xb = jax.lax.psum(
+                    jnp.where(i == src, x, jnp.zeros_like(x)), g.axis_name
+                )
+                return xb[i]
+
+            return _write_back(tensor, AG.apply(f, raws, name="c_scatter"))
+
+        def f(x):
+            i = jax.lax.axis_index(g.axis_name)
+            xb = jax.lax.psum(
+                jnp.where(i == src, x, jnp.zeros_like(x)), g.axis_name
+            )
+            return xb[i]
+
+        return _write_back(tensor, AG.apply(f, (_as_t(stacked_in),),
+                                            name="c_scatter"))
     if tensor_list is not None:
         stacked = jnp.stack([_raw(t) for t in tensor_list], axis=0)
     else:

@@ -208,6 +208,24 @@ class ParallelEnv:
         return self.rank
 
 
+def get_rank() -> int:
+    """Trainer rank (reference parallel.py get_rank: PADDLE_TRAINER_ID or
+    the process index)."""
+    return ParallelEnv().rank
+
+
+def get_world_size() -> int:
+    """Number of TRAINER PROCESSES (reference get_world_size semantics —
+    PADDLE_TRAINERS_NUM / process count), distinct from
+    ParallelEnv().world_size which counts mesh devices in the
+    single-controller model."""
+    import os
+
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    return jax.process_count()
+
+
 # ---------------------------------------------------------------------------
 # spmd region tracking: inside a shard_map'd program, collectives lower to
 # bare lax ops on the axis name instead of launching their own shard_map.

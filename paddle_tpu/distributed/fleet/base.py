@@ -110,15 +110,29 @@ class _DistributedOptimizer:
         return bool(self.user_defined_strategy.gradient_merge_configs["avg"])
 
     def _zero_constrain(self, x, force=False):
-        """Shard a state leaf's leading axis over dp when divisible."""
+        """Shard a state leaf over dp on the FIRST dp-divisible axis.
+
+        Ownership policy vs the reference (sharding/shard.py assigns every
+        param an owner rank): XLA sharding constraints cannot reshape
+        storage, so leaves with no dp-divisible axis (e.g. a [10] bias on
+        dp=8) stay REPLICATED — documented deviation; their bytes are
+        O(small) by construction since weight matrices always carry a
+        divisible axis in practice. A flatten+pad global shard would
+        change the functional-state layout every optimizer rule consumes
+        and is deliberately not done."""
         mesh = comm.hybrid_mesh()
         if mesh is None:
             return x
         dp = mesh.shape["dp"]
-        if x.ndim == 0 or x.shape[0] % dp != 0:
-            return x
-        spec = P(*(["dp"] + [None] * (x.ndim - 1)))
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        for axis in range(x.ndim):
+            if x.shape[axis] % dp == 0 and x.shape[axis] > 0:
+                spec = P(*(
+                    [None] * axis + ["dp"] + [None] * (x.ndim - axis - 1)
+                ))
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, spec)
+                )
+        return x
 
     @property
     def _sharding_stage(self) -> int:

@@ -53,6 +53,11 @@ class DataParallel(Layer):
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size_MB=25,
                  last_comm_buffer_size_MB=1, find_unused_parameters=False,
                  group: Optional[comm.Group] = None):
+        # comm_buffer_size_MB / last_comm_buffer_size_MB: accepted for
+        # script parity, deliberately unused — grad-comm bucketing is
+        # XLA's all-reduce combiner (the Reducer group-size knobs have no
+        # seam here). find_unused_parameters likewise: TrainStep's jaxpr
+        # usage analysis subsumes it (unused params get no update).
         super().__init__()
         self._layers = layers
         self.group = group or comm._default_group()

@@ -1,0 +1,2 @@
+"""paddle_tpu.incubate (reference: python/paddle/fluid/incubate/)."""
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,151 @@
+"""Auto-checkpoint: epoch-range training that survives preemption.
+
+Reference: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py —
+`AutoCheckpointChecker` (:71) reads the job id from env;
+`TrainEpochRange` (:265) is a context manager whose `get()` yields epoch
+indices, snapshotting executor/program state to a job-keyed directory
+each epoch range and RESUMING from the last snapshot when the (restarted)
+job enters the range again (`train_epoch_range` :598).
+
+TPU-native: state = the registered Layers' state_dicts + optimizers'
+state_dicts saved through framework.io (orbax-style numpy-tree pickles);
+the snapshot key is PADDLE_JOB_ID (the preemptible-cluster job identity).
+Multi-host: only trainer 0 writes; every trainer restores.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import tempfile
+from typing import List, Optional
+
+__all__ = ["TrainEpochRange", "train_epoch_range"]
+
+_CHECKPOINT_ENV = "PADDLE_CHECKPOINT_DIR"
+_JOB_ENV = "PADDLE_JOB_ID"
+
+
+class TrainEpochRange:
+    """Resumable epoch range.
+
+    Usage::
+
+        r = TrainEpochRange(10, name="run1")
+        r.register(model=model, optimizer=opt)
+        for epoch in r.get():       # resumes mid-range after a restart
+            train_one_epoch(...)
+    """
+
+    def __init__(self, max_epoch_num: int, name: str = "acp",
+                 checkpoint_path: Optional[str] = None,
+                 save_checkpoint_inter: int = 1):
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name
+        root = checkpoint_path or os.environ.get(
+            _CHECKPOINT_ENV, os.path.join(tempfile.gettempdir(),
+                                          "paddle_tpu_auto_checkpoint")
+        )
+        job = os.environ.get(_JOB_ENV, "default_job")
+        self._dir = os.path.join(root, job, name)
+        self._inter = max(int(save_checkpoint_inter), 1)
+        self._models: List = []
+        self._opts: List = []
+        self._restored_epoch = -1
+
+    # -- state registry (the exe/program auto-registration analog) ---------
+    def register(self, model=None, optimizer=None):
+        if model is not None:
+            self._models.append(model)
+        if optimizer is not None:
+            self._opts.append(optimizer)
+        return self
+
+    # -- persistence ---------------------------------------------------------
+    def _meta_path(self):
+        return os.path.join(self._dir, "meta.json")
+
+    def _save(self, epoch: int):
+        from ...distributed import comm
+        from ...framework import io as fio
+
+        if comm.ParallelEnv().rank != 0:
+            return  # one writer per job
+        os.makedirs(self._dir, exist_ok=True)
+        tmp = self._dir + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        for i, m in enumerate(self._models):
+            fio.save(m.state_dict(), os.path.join(tmp, f"model_{i}.pdparams"))
+        for i, o in enumerate(self._opts):
+            inner = getattr(o, "_inner", o)
+            fio.save(inner.state_dict(), os.path.join(tmp, f"opt_{i}.pdopt"))
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"epoch": epoch, "name": self.name,
+                       "max_epoch_num": self.max_epoch_num}, f)
+        # atomic swap so a preemption mid-save never corrupts the snapshot
+        old = self._dir + ".old"
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.exists(os.path.join(self._dir, "meta.json")):
+            os.rename(self._dir, old)
+        else:
+            shutil.rmtree(self._dir, ignore_errors=True)
+        os.rename(tmp, self._dir)
+        shutil.rmtree(old, ignore_errors=True)
+
+    def _snapshot_dir(self):
+        """Newest COMPLETE snapshot, surviving a preemption between the
+        two renames of _save: the live dir, then the fully-written .tmp,
+        then the displaced .old."""
+        for d in (self._dir, self._dir + ".tmp", self._dir + ".old"):
+            if os.path.exists(os.path.join(d, "meta.json")):
+                return d
+        return None
+
+    def restore(self) -> int:
+        """Load the last snapshot; returns the NEXT epoch to run (0 when
+        no snapshot exists)."""
+        from ...framework import io as fio
+
+        snap = self._snapshot_dir()
+        if snap is None:
+            return 0
+        if snap != self._dir:
+            # finish the interrupted swap before reading
+            shutil.rmtree(self._dir, ignore_errors=True)
+            os.rename(snap, self._dir)
+            for leftover in (self._dir + ".tmp", self._dir + ".old"):
+                shutil.rmtree(leftover, ignore_errors=True)
+        with open(self._meta_path()) as f:
+            meta = json.load(f)
+        for i, m in enumerate(self._models):
+            m.set_state_dict(
+                fio.load(os.path.join(self._dir, f"model_{i}.pdparams"))
+            )
+        for i, o in enumerate(self._opts):
+            inner = getattr(o, "_inner", o)
+            inner.set_state_dict(
+                fio.load(os.path.join(self._dir, f"opt_{i}.pdopt"))
+            )
+        self._restored_epoch = int(meta["epoch"])
+        return self._restored_epoch + 1
+
+    # -- the epoch range -------------------------------------------------
+    def get(self):
+        start = self.restore()
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            if (epoch + 1) % self._inter == 0 \
+                    or epoch + 1 == self.max_epoch_num:
+                self._save(epoch)
+
+
+@contextlib.contextmanager
+def train_epoch_range(max_epoch_num, name="acp", checkpoint_path=None,
+                      save_checkpoint_inter=1):
+    """auto_checkpoint.py:598 context-manager facade."""
+    yield TrainEpochRange(
+        max_epoch_num, name=name, checkpoint_path=checkpoint_path,
+        save_checkpoint_inter=save_checkpoint_inter,
+    )

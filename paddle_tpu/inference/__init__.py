@@ -1,0 +1,141 @@
+"""paddle.inference — the deployment predictor (SURVEY.md §2.10, L11).
+
+Reference: paddle/fluid/inference/api/analysis_predictor.cc — load a
+saved program + params, run an analysis/optimization pass pipeline, serve
+through zero-copy input/output handles (paddle_infer::Config /
+create_predictor / Predictor.run).
+
+TPU-native: the artifact is `paddle_tpu.jit.save`'s serialized StableHLO
++ params (the __model__ analog); the "analysis pass pipeline" is XLA —
+the program was optimized at export and compiles natively on load. The
+handle API shape (names, reshape, copy_from_cpu/copy_to_cpu) is kept so
+reference serving code ports directly.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor_"]
+
+
+class Config:
+    """paddle_infer.Config parity: artifact paths + accepted-but-inert
+    device knobs (XLA owns placement/optimization)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._path = prog_file
+        self._enable_memory_optim = True
+        self._switch_ir_optim = True
+
+    def set_prog_file(self, path):
+        self._path = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def prog_file(self):
+        return self._path
+
+    # accepted device/optimization toggles (ir passes ≙ XLA; no-ops here)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def switch_ir_optim(self, flag=True):
+        self._switch_ir_optim = flag
+
+    def disable_glog_info(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Tensor_:
+    """Input/output handle (paddle_infer.Tensor parity): stages a host
+    array in, reads results out."""
+
+    def __init__(self, name: str, shape=None):
+        self.name = name
+        self._shape = list(shape) if shape is not None else None
+        self._value: Optional[np.ndarray] = None
+
+    def reshape(self, shape):
+        self._shape = list(shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.ascontiguousarray(arr)
+        self._shape = list(arr.shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"handle '{self.name}' holds no data yet")
+        return self._value
+
+    def shape(self):
+        return self._shape
+
+
+class Predictor:
+    """AnalysisPredictor analog over a jit.save artifact."""
+
+    def __init__(self, config: Config):
+        from ..jit.save_load import load
+
+        if config.prog_file() is None:
+            raise ValueError("Config needs the artifact path (prog_file)")
+        self._layer = load(config.prog_file())
+        with open(config.prog_file() + ".pdmeta") as f:
+            meta = json.load(f)
+        self._input_specs = meta["input_specs"]
+        self._inputs: Dict[str, Tensor_] = {}
+        for i, (shape, dtype) in enumerate(self._input_specs):
+            name = f"input_{i}"
+            self._inputs[name] = Tensor_(name, shape)
+        # handles are persistent: fetch-before-run works, run() fills them
+        self._outputs: Dict[str, Tensor_] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> Tensor_:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return sorted(self._outputs) if self._outputs else ["output_0"]
+
+    def get_output_handle(self, name: str) -> Tensor_:
+        if name not in self._outputs:
+            self._outputs[name] = Tensor_(name)
+        return self._outputs[name]
+
+    def run(self) -> bool:
+        args = []
+        for name, handle in self._inputs.items():
+            if handle._value is None:
+                raise RuntimeError(f"input '{name}' was not fed")
+            args.append(handle._value)
+        out = self._layer(*args)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            h = self.get_output_handle(f"output_{i}")
+            h.copy_from_cpu(
+                o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+            )
+        return True
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

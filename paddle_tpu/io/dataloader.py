@@ -2,20 +2,21 @@
 fluid/dataloader/dataloader_iter.py:265 single-process iter, :469
 multi-process iter with shared-memory workers + watchdog).
 
-TPU-first design: collation happens on a thread pool (numpy releases the
-GIL for the copies that matter) with a bounded prefetch queue, and the
-device transfer is one `jax.device_put` per batch — the double-buffer H2D
-prefetch of the reference's buffered_reader. A process pool is used when
-num_workers > 0 AND the dataset is picklable; otherwise threads (on TPU
-hosts the transform work is rarely the bottleneck the GPU world needs
-worker processes for).
+TPU-first design: workers fetch+collate ahead of the consumer through a
+bounded prefetch queue, and the device transfer is one `jax.device_put`
+per batch — the double-buffer H2D prefetch of the reference's
+buffered_reader. With num_workers > 0, a spawned PROCESS pool is used
+when use_shared_memory=True and the dataset/collate pickle cleanly
+(dataset ships once via the worker initializer); otherwise a thread pool
+(numpy releases the GIL for the copies that matter).
 """
 from __future__ import annotations
 
 import itertools
+import pickle
 import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, Optional
 
 import numpy as np
@@ -23,6 +24,27 @@ import numpy as np
 from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
+
+
+_PROC_STATE = {}
+
+
+def _proc_worker_init(dataset, collate_fn):
+    """Runs once per spawned worker: bind the dataset/collate globally
+    (the mmap-shared-dataset analog — spawn ships them exactly once).
+    Workers pin jax to CPU FIRST — a child touching jnp (e.g. a dataset
+    returning Tensors) must never grab the parent's TPU."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    _PROC_STATE["dataset"] = dataset
+    _PROC_STATE["collate"] = collate_fn
+
+
+def _proc_worker_fetch(indices):
+    ds = _PROC_STATE["dataset"]
+    return _PROC_STATE["collate"]([ds[i] for i in indices])
 
 
 def default_collate_fn(batch):
@@ -86,6 +108,10 @@ class DataLoader:
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        self._pool = None
+        self._pool_is_proc = False
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -134,24 +160,89 @@ class DataLoader:
                 return
             yield _to_tensor_tree(self.collate_fn(batch))
 
+    def _make_pool(self):
+        """Worker pool choice (dataloader_iter.py:469 multiprocess path):
+        process workers when shared memory is requested and the dataset/
+        collate pickle cleanly (children are spawned, so the dataset
+        travels once via the initializer); thread pool otherwise. The
+        pool persists across epochs when persistent_workers=True."""
+        if self._pool is not None:
+            return self._pool
+        pool = None
+        if self.use_shared_memory:
+            try:
+                # probe picklability WITHOUT materializing the bytes (a
+                # large in-RAM dataset must not be copied just to probe)
+                class _Null:
+                    def write(self, b):
+                        return len(b)
+
+                pickle.Pickler(_Null(), protocol=4).dump(self.dataset)
+                pickle.Pickler(_Null(), protocol=4).dump(self.collate_fn)
+            except Exception:
+                pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            else:
+                import multiprocessing as mp
+
+                pool = ProcessPoolExecutor(
+                    max_workers=self.num_workers,
+                    mp_context=mp.get_context("spawn"),
+                    initializer=_proc_worker_init,
+                    initargs=(self.dataset, self.collate_fn),
+                )
+                self._pool_is_proc = True
+        else:
+            pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        if self.persistent_workers:
+            self._pool = pool
+        return pool
+
     def _iter_prefetch(self):
-        """Thread-pool fetch + bounded queue — the buffered_reader analog."""
+        """Worker-pool fetch + bounded queue — the buffered_reader analog
+        (one device transfer per batch on the consumer side)."""
         depth = self.num_workers * self.prefetch_factor
-        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        pool = self._make_pool()
+        is_proc = self._pool_is_proc
         q: "queue.Queue" = queue.Queue(maxsize=depth)
         sentinel = object()
+
+        def submit(indices):
+            if is_proc:
+                return pool.submit(_proc_worker_fetch, list(indices))
+            return pool.submit(self._fetch, indices)
+
+        stop = threading.Event()
+
+        def put_or_cancel(item):
+            """Blocking put that aborts when the consumer is gone — the
+            producer must never deadlock on a full queue nobody drains."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            if item is not sentinel and hasattr(item, "cancel"):
+                item.cancel()
+            return False
 
         def producer():
             try:
                 futures = []
                 for indices in self.batch_sampler:
-                    futures.append(pool.submit(self._fetch, indices))
+                    if stop.is_set():
+                        break
+                    futures.append(submit(indices))
                     while len(futures) >= depth:
-                        q.put(futures.pop(0))
+                        if not put_or_cancel(futures.pop(0)):
+                            break
                 for f in futures:
-                    q.put(f)
+                    if stop.is_set():
+                        f.cancel()
+                    else:
+                        put_or_cancel(f)
             finally:
-                q.put(sentinel)
+                put_or_cancel(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -162,7 +253,19 @@ class DataLoader:
                     break
                 yield _to_tensor_tree(item.result())
         finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+            # early break: stop the producer and cancel queued fetches so
+            # a persistent pool is clean for the next epoch; q is drained
+            # so the producer can never deadlock on q.put
+            stop.set()
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not sentinel:
+                    item.cancel()
+            if pool is not self._pool:
+                pool.shutdown(wait=False, cancel_futures=True)
 
     # -- legacy constructors (fluid reader API shims) ------------------------
     @staticmethod

@@ -118,14 +118,14 @@ class DistributedBatchSampler(BatchSampler):
 
     def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
                  shuffle=False, drop_last=False):
-        from ..distributed import env as dist_env
+        from ..distributed import comm as dist_env
 
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.nranks = (
-            num_replicas if num_replicas is not None else dist_env.world_size()
+            num_replicas if num_replicas is not None else dist_env.get_world_size()
         )
-        self.local_rank = rank if rank is not None else dist_env.rank()
+        self.local_rank = rank if rank is not None else dist_env.get_rank()
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0

@@ -56,6 +56,9 @@ def _block_step(q, k, v, scale, o, m, l, mask=None):
     return o_new, m_new, l_new
 
 
+_UNROLL_BLOCKS = 16
+
+
 def _blockwise_raw(q, k, v, *, causal=False, block_size=512, scale=None):
     B, H, S, D = q.shape
     Sk = k.shape[2]
@@ -63,21 +66,53 @@ def _blockwise_raw(q, k, v, *, causal=False, block_size=512, scale=None):
     block = min(block_size, Sk)
     n_blocks = (Sk + block - 1) // block
     qf = q.astype(jnp.float32)
+    qpos = jnp.arange(S)
 
     o = jnp.zeros((B, H, S, D), jnp.float32)
     m = jnp.full((B, H, S), _NEG, jnp.float32)
     l = jnp.zeros((B, H, S), jnp.float32)
-    qpos = jnp.arange(S)
-    for j in range(n_blocks):
+
+    if n_blocks <= _UNROLL_BLOCKS:
+        # small programs: unrolled python loop keeps the exact flash
+        # backward (recompute per block, no scan residual stacking)
+        for j in range(n_blocks):
+            lo = j * block
+            hi = min(lo + block, Sk)
+            kj = k[:, :, lo:hi].astype(jnp.float32)
+            vj = v[:, :, lo:hi]
+            mask = None
+            if causal:
+                kpos = jnp.arange(lo, hi)
+                mask = jnp.where(kpos[None, :] > qpos[:, None], _NEG, 0.0)
+            o, m, l = _block_step(qf, kj, vj, scale, o, m, l, mask)
+        return (o / l[..., None]).astype(q.dtype)
+
+    # long sequences: lax.scan over blocks so jaxpr/compile size stays
+    # O(1) in n_blocks (padded tail masked out). NOTE on backward: scan's
+    # vjp stacks per-block residuals — peak memory O(n_blocks * carry);
+    # a custom flash VJP (recompute per block) is the planned upgrade.
+    pad = n_blocks * block - Sk
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+    def body(carry, j):
+        o, m, l = carry
         lo = j * block
-        hi = min(lo + block, Sk)
-        kj = k[:, :, lo:hi].astype(jnp.float32)
-        vj = v[:, :, lo:hi]
-        mask = None
+        kj = jax.lax.dynamic_slice_in_dim(kp, lo, block, 2)
+        vj = jax.lax.dynamic_slice_in_dim(vp, lo, block, 2)
+        kpos = lo + jnp.arange(block)
+        invalid = kpos[None, :] >= Sk
         if causal:
-            kpos = jnp.arange(lo, hi)
-            mask = jnp.where(kpos[None, :] > qpos[:, None], _NEG, 0.0)
-        o, m, l = _block_step(qf, kj, vj, scale, o, m, l, mask)
+            invalid = invalid | (kpos[None, :] > qpos[:, None])
+        mask = jnp.where(invalid, _NEG, 0.0)
+        o, m, l = _block_step(
+            qf, kj.astype(jnp.float32), vj, scale, o, m, l, mask
+        )
+        return (o, m, l), None
+
+    (o, m, l), _ = jax.lax.scan(
+        body, (o, m, l), jnp.arange(n_blocks)
+    )
     return (o / l[..., None]).astype(q.dtype)
 
 
@@ -160,6 +195,12 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
             "with hybrid_configs sp_degree, or pass mesh="
         )
     sp = mesh.shape[sp_axis]
+    S = q.shape[2]
+    if S % sp != 0:
+        raise ValueError(
+            f"ring_attention: sequence length {S} must be divisible by "
+            f"the '{sp_axis}' axis size {sp}"
+        )
     spec = P(None, None, sp_axis, None)
 
     def f(qr, kr, vr):

@@ -1,0 +1,6 @@
+"""paddle_tpu.text — NLP datasets (reference: python/paddle/text/:
+__init__.py re-exports datasets/; SURVEY.md §2.8 paddle.text row)."""
+from .datasets import *  # noqa: F401,F403
+from . import datasets  # noqa: F401
+
+__all__ = datasets.__all__

@@ -1,0 +1,72 @@
+"""IMDB sentiment dataset (reference: text/datasets/imdb.py — aclImdb
+tarball; vocabulary from train docs over a frequency cutoff, punctuation
+stripped, label 0=pos 1=neg per the reference's ordering)."""
+from __future__ import annotations
+
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ._common import resolve_data_file
+
+__all__ = ["Imdb"]
+
+URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        self.data_file = resolve_data_file(data_file, download, "imdb", URL)
+        self.word_idx = self._build_dict(
+            re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$"), cutoff
+        )
+        self._load()
+
+    def _tokenize(self, pattern):
+        docs = []
+        punct = str.maketrans("", "", string.punctuation)
+        with tarfile.open(self.data_file) as tf:
+            for member in tf:
+                if member.isfile() and pattern.match(member.name):
+                    text = tf.extractfile(member).read().decode(
+                        "utf-8", "ignore"
+                    )
+                    docs.append(
+                        text.rstrip("\n\r").translate(punct).lower().split()
+                    )
+        return docs
+
+    def _build_dict(self, pattern, cutoff):
+        freq = {}
+        for doc in self._tokenize(pattern):
+            for w in doc:
+                freq[w] = freq.get(w, 0) + 1
+        kept = [(w, c) for w, c in freq.items() if c > cutoff]
+        kept.sort(key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, kind in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(
+                rf"aclImdb/{self.mode}/{kind}/.*\.txt$"
+            )
+            for doc in self._tokenize(pattern):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)

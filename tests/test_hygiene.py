@@ -1,0 +1,177 @@
+"""Round-4 hygiene coverage (VERDICT r3 item 10 + weak #5/#7/#8)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import comm
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed import fleet
+from paddle_tpu.jit import TrainStep
+
+
+class TestCheckNanInf:
+    def test_flag_catches_nan(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+            with pytest.raises(RuntimeError, match="log"):
+                paddle.log(x)  # log(-1) = nan
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_flag_off_is_silent(self):
+        x = paddle.to_tensor(np.array([-1.0], np.float32))
+        out = paddle.log(x)
+        assert np.isnan(out.numpy()).all()
+
+
+class TestEnvMerged:
+    def test_single_source_of_truth(self, monkeypatch):
+        assert not hasattr(dist, "env")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "5")
+        assert dist.get_rank() == 3
+        assert dist.get_world_size() == 5
+        assert comm.ParallelEnv().rank == 3
+
+    def test_defaults_without_env(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+        monkeypatch.delenv("PADDLE_TRAINERS_NUM", raising=False)
+        assert dist.get_rank() == 0
+        assert dist.get_world_size() == 1
+
+
+class TestZeroShardings:
+    """weak #5: actually inspect the state shardings ZeRO produces."""
+
+    def _strategy(self, stage):
+        s = DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {"stage": stage}
+        return s
+
+    def test_stage1_shards_optimizer_state_over_dp(self):
+        fleet.init(is_collective=True, strategy=self._strategy(1))
+        model = nn.Linear(16, 24)
+        opt = fleet.distributed_optimizer(
+            optimizer.Adam(learning_rate=1e-3,
+                           parameters=model.parameters())
+        )
+        step = TrainStep(model, lambda o, y: ((o - y) ** 2).mean(), opt)
+        x = np.random.rand(8, 16).astype(np.float32)
+        y = np.random.rand(8, 24).astype(np.float32)
+        step(x, y)
+        inner = opt._inner
+        m_w = inner._accumulators["moment1"][id(model.weight)]
+        # weight moment [16, 24]: axis 0 divisible by dp=8 -> sharded
+        assert len(m_w.sharding.device_set) == 8
+        assert not m_w.sharding.is_fully_replicated
+        # bias moment [24]: divisible too -> sharded
+        m_b = inner._accumulators["moment1"][id(model.bias)]
+        assert not m_b.sharding.is_fully_replicated
+
+    def test_non_divisible_leaf_stays_replicated_documented(self):
+        fleet.init(is_collective=True, strategy=self._strategy(1))
+        model = nn.Linear(16, 10)  # bias [10]: 10 % 8 != 0
+        opt = fleet.distributed_optimizer(
+            optimizer.Adam(learning_rate=1e-3,
+                           parameters=model.parameters())
+        )
+        step = TrainStep(model, lambda o, y: ((o - y) ** 2).mean(), opt)
+        x = np.random.rand(8, 16).astype(np.float32)
+        y = np.random.rand(8, 10).astype(np.float32)
+        step(x, y)
+        inner = opt._inner
+        m_b = inner._accumulators["moment1"][id(model.bias)]
+        assert m_b.sharding.is_fully_replicated  # the documented deviation
+        # the [16, 10] weight moment shards on axis 0
+        m_w = inner._accumulators["moment1"][id(model.weight)]
+        assert not m_w.sharding.is_fully_replicated
+
+
+class TestCollectivesSpmd:
+    def test_broadcast_selects_src_without_allgather(self):
+        g = comm._default_group()
+
+        from paddle_tpu.core.tensor import Tensor
+
+        def prog(x):
+            with comm.spmd_region(g.axis_name):
+                return dist.broadcast(
+                    Tensor._wrap(x), src=2, group=g
+                )._data
+
+        f = comm.shard_map(
+            prog, g.mesh,
+            in_specs=jax.sharding.PartitionSpec(g.axis_name),
+            out_specs=jax.sharding.PartitionSpec(g.axis_name),
+        )
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = np.asarray(jax.jit(f)(x))
+        np.testing.assert_array_equal(out.reshape(-1), [2.0] * 8)
+
+    def test_scatter_spmd_uses_src(self):
+        g = comm._default_group()
+
+        from paddle_tpu.core.tensor import Tensor
+
+        def prog(x):
+            with comm.spmd_region(g.axis_name):
+                return dist.scatter(
+                    Tensor._wrap(x), src=3, group=g
+                )._data
+
+        # each rank holds a DIFFERENT stacked [8, 1]; only src's must win
+        f = comm.shard_map(
+            prog, g.mesh,
+            in_specs=jax.sharding.PartitionSpec(g.axis_name),
+            out_specs=jax.sharding.PartitionSpec(g.axis_name),
+        )
+        # global [64, 1]: rank r holds rows 8r..8r+7 = r*100 + arange(8)
+        x = np.concatenate([
+            (r * 100 + np.arange(8, dtype=np.float32)).reshape(8, 1)
+            for r in range(8)
+        ])
+        out = np.asarray(jax.jit(f)(x)).reshape(-1)
+        # src=3's stack is 300+arange(8); rank r receives chunk r
+        np.testing.assert_array_equal(out, 300 + np.arange(8))
+
+
+class TestDataLoaderProcessPool:
+    def test_process_pool_matches_sync(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import FakeData
+
+        ds = FakeData(sample_shape=(1, 6, 6), num_samples=32, num_classes=4)
+        proc = DataLoader(ds, batch_size=8, num_workers=2,
+                          use_shared_memory=True)
+        assert len(list(proc)) == 4
+        sync = DataLoader(ds, batch_size=8)
+        for (a, la), (b, lb) in zip(proc, sync):
+            np.testing.assert_allclose(a.numpy(), b.numpy())
+            np.testing.assert_array_equal(la.numpy(), lb.numpy())
+
+    def test_unpicklable_falls_back_to_threads(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+
+        lock = __import__("threading").Lock()  # unpicklable payload
+
+        class Ds(Dataset):
+            def __getitem__(self, i):
+                _ = lock
+                return np.full((2,), i, np.float32), np.int64(i)
+
+            def __len__(self):
+                return 16
+
+        loader = DataLoader(Ds(), batch_size=4, num_workers=2,
+                            use_shared_memory=True)
+        batches = list(loader)
+        assert len(batches) == 4
+        assert not loader._pool_is_proc

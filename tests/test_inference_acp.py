@@ -1,0 +1,99 @@
+"""Inference predictor + auto-checkpoint tests (components #22, #40)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+    TrainEpochRange, train_epoch_range,
+)
+from paddle_tpu.jit import InputSpec
+
+
+class TestPredictor:
+    def _save_artifact(self, tmp_path):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        path = str(tmp_path / "model" / "net")
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([3, 4], "float32")])
+        return net, path
+
+    def test_predict_round_trip(self, tmp_path):
+        net, path = self._save_artifact(tmp_path)
+        from paddle_tpu import inference
+
+        config = inference.Config(path + ".pdmodel")
+        predictor = inference.create_predictor(config)
+        names = predictor.get_input_names()
+        assert names == ["input_0"]
+        x = np.random.rand(3, 4).astype(np.float32)
+        h = predictor.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        assert predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]
+        ).copy_to_cpu()
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_missing_feed_raises(self, tmp_path):
+        _, path = self._save_artifact(tmp_path)
+        from paddle_tpu import inference
+
+        predictor = inference.create_predictor(inference.Config(path))
+        with pytest.raises(RuntimeError, match="not fed"):
+            predictor.run()
+
+
+class TestAutoCheckpoint:
+    def _train_with_crash(self, ckpt_dir, crash_after=None):
+        """Train 4 epochs on fixed data; optionally 'preempt' mid-range."""
+        paddle.seed(7)
+        model = nn.Linear(3, 1)
+        opt = optimizer.Adam(learning_rate=0.05,
+                             parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        xs = rng.rand(4, 8, 3).astype(np.float32)
+        ys = rng.rand(4, 8, 1).astype(np.float32)
+        r = TrainEpochRange(4, name="t", checkpoint_path=ckpt_dir)
+        r.register(model=model, optimizer=opt)
+        ran = []
+        for epoch in r.get():
+            if crash_after is not None and epoch == crash_after:
+                raise KeyboardInterrupt  # the preemption
+            loss = ((model(paddle.to_tensor(xs[epoch]))
+                     - paddle.to_tensor(ys[epoch])) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ran.append(epoch)
+        return model, ran
+
+    def test_resume_after_preemption_matches_uninterrupted(self, tmp_path):
+        a = str(tmp_path / "a")
+        b = str(tmp_path / "b")
+        # uninterrupted run
+        model_full, ran = self._train_with_crash(a)
+        assert ran == [0, 1, 2, 3]
+        # preempted at epoch 2, then restarted
+        with pytest.raises(KeyboardInterrupt):
+            self._train_with_crash(b, crash_after=2)
+        model_resumed, ran2 = self._train_with_crash(b)
+        assert ran2 == [2, 3]  # resumed mid-range, epochs 0-1 not re-run
+        np.testing.assert_allclose(
+            model_resumed.weight._data, model_full.weight._data,
+            rtol=1e-6,
+        )
+
+    def test_fresh_range_runs_all_epochs(self, tmp_path):
+        with train_epoch_range(3, checkpoint_path=str(tmp_path)) as r:
+            r.register(model=nn.Linear(2, 2))
+            assert list(r.get()) == [0, 1, 2]
+        # completed range restarts from the final snapshot -> empty
+        with train_epoch_range(3, checkpoint_path=str(tmp_path)) as r:
+            r.register(model=nn.Linear(2, 2))
+            assert list(r.get()) == []
