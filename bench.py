@@ -91,6 +91,83 @@ def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label):
     }
 
 
+def _bert_base():
+    """BERT-base-shaped encoder (BASELINE config 3): 12 layers, hidden
+    768, 12 heads, seq 128 — the encoder dominates FLOPs; the head is a
+    2-way classifier. bf16 autocast via the fleet amp strategy (TPU-first
+    policy; MXU-bound matmuls cast down, softmax/norms stay f32)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class Bert(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(30522, 768)
+            self.pos = nn.Embedding(512, 768)
+            self.encoder = nn.LayerList([
+                nn.TransformerEncoderLayer(768, 12, 3072, dropout=0.0)
+                for _ in range(12)
+            ])
+            self.head = nn.Linear(768, 2)
+
+        def forward(self, ids):
+            import jax.numpy as jnp
+
+            T = ids.shape[1]
+            pos_ids = paddle.arange(T, dtype="int64")
+            h = self.embed(ids) + self.pos(pos_ids)
+            for lyr in self.encoder:
+                h = lyr(h)
+            return self.head(h.mean(axis=1))
+
+    return Bert()
+
+
+def _bench_bert(steps=10, batch=32, seq=128):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    strategy = DistributedStrategy()
+    strategy.amp = True  # bf16 autocast inside the fused step
+    fleet.init(is_collective=True, strategy=strategy)
+    model = _bert_base()
+    opt = fleet.distributed_optimizer(
+        optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                        parameters=model.parameters())
+    )
+    step = TrainStep(
+        model, lambda out, y: nn.functional.cross_entropy(out, y), opt
+    )
+    import jax.numpy as jnp
+
+    ids = jax.device_put(jnp.asarray(
+        (np.arange(batch * seq) % 30000).reshape(batch, seq)
+        .astype(np.int32)
+    ))
+    y = jax.device_put(jnp.asarray((np.arange(batch) % 2).astype(np.int32)))
+    jax.block_until_ready(ids)
+
+    t0 = time.perf_counter()
+    loss = step(ids, y)
+    jax.block_until_ready(loss._data)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, y)
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+    return steps * batch / dt, {
+        "bert_base_bf16_step_ms": round(dt / steps * 1e3, 2),
+        "bert_base_bf16_compile_s": round(compile_s, 1),
+    }
+
+
 def main():
     from paddle_tpu import optimizer
     from paddle_tpu.vision.models import LeNet, resnet50
@@ -115,6 +192,10 @@ def main():
     )
     extra.update(bd)
     extra["resnet50_synthetic_imgs_per_sec"] = round(r50_ips, 1)
+
+    bert_ips, bd = _bench_bert()
+    extra.update(bd)
+    extra["bert_base_bf16_samples_per_sec"] = round(bert_ips, 1)
     extra["vs_r02"] = round(lenet_ips / 663.6, 1)
     extra["note"] = (
         "TrainStep hot path (fused fwd+bwd+opt, donated, device-staged "

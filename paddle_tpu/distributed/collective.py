@@ -231,10 +231,11 @@ def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True,
         from ..core import autograd as AG
 
         def f(x):
-            # O(size) select+psum, not an O(nranks*size) all_gather
+            # O(size) select+psum, not an O(nranks*size) all_gather;
+            # psum promotes bool, so restore the caller's dtype
             i = jax.lax.axis_index(g.axis_name)
             contrib = jnp.where(i == src, x, jnp.zeros_like(x))
-            return jax.lax.psum(contrib, g.axis_name)
+            return jax.lax.psum(contrib, g.axis_name).astype(x.dtype)
 
         return _write_back(tensor, AG.apply(f, (_as_t(tensor),),
                                             name="c_broadcast"))
@@ -300,7 +301,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None,
                 i = jax.lax.axis_index(g.axis_name)
                 xb = jax.lax.psum(
                     jnp.where(i == src, x, jnp.zeros_like(x)), g.axis_name
-                )
+                ).astype(x.dtype)
                 return xb[i]
 
             return _write_back(tensor, AG.apply(f, raws, name="c_scatter"))
@@ -309,7 +310,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None,
             i = jax.lax.axis_index(g.axis_name)
             xb = jax.lax.psum(
                 jnp.where(i == src, x, jnp.zeros_like(x)), g.axis_name
-            )
+            ).astype(x.dtype)
             return xb[i]
 
         return _write_back(tensor, AG.apply(f, (_as_t(stacked_in),),

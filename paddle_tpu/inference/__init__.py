@@ -100,6 +100,10 @@ class Predictor:
         with open(config.prog_file() + ".pdmeta") as f:
             meta = json.load(f)
         self._input_specs = meta["input_specs"]
+        import pickle
+
+        treedef = pickle.loads(bytes.fromhex(meta["out_treedef"]))
+        self._n_outputs = max(getattr(treedef, "num_leaves", 1), 1)
         self._inputs: Dict[str, Tensor_] = {}
         for i, (shape, dtype) in enumerate(self._input_specs):
             name = f"input_{i}"
@@ -114,7 +118,8 @@ class Predictor:
         return self._inputs[name]
 
     def get_output_names(self) -> List[str]:
-        return sorted(self._outputs) if self._outputs else ["output_0"]
+        # known from the artifact metadata BEFORE the first run
+        return [f"output_{i}" for i in range(self._n_outputs)]
 
     def get_output_handle(self, name: str) -> Tensor_:
         if name not in self._outputs:

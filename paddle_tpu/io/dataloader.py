@@ -56,6 +56,16 @@ def default_collate_fn(batch):
 
         return Tensor._wrap(jnp.stack([s._data for s in batch]))
     if isinstance(sample, np.ndarray):
+        if (len(batch) > 1 and sample.ndim > 0
+                and not sample.dtype.hasobject
+                and all(s.shape == sample.shape
+                        and s.dtype == sample.dtype
+                        and s.flags.c_contiguous for s in batch)):
+            # native GIL-free collation (staging.cpp pt_stack; numpy
+            # fallback inside when no toolchain built the library)
+            from .. import native
+
+            return native.stack_samples(batch)
         return np.stack(batch)
     if isinstance(sample, (int, np.integer)):
         return np.asarray(batch, np.int64)
@@ -181,6 +191,7 @@ class DataLoader:
                 pickle.Pickler(_Null(), protocol=4).dump(self.collate_fn)
             except Exception:
                 pool = ThreadPoolExecutor(max_workers=self.num_workers)
+                self._pool_is_proc = False
             else:
                 import multiprocessing as mp
 
@@ -193,6 +204,7 @@ class DataLoader:
                 self._pool_is_proc = True
         else:
             pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            self._pool_is_proc = False
         if self.persistent_workers:
             self._pool = pool
         return pool

@@ -24,28 +24,43 @@ class Imdb(Dataset):
             raise ValueError(f"mode should be 'train' or 'test', got {mode}")
         self.mode = mode.lower()
         self.data_file = resolve_data_file(data_file, download, "imdb", URL)
+        # ONE archive walk collects the dict corpus (train pos+neg only —
+        # the reference vocabulary) and this mode's documents together
+        groups = self._tokenize_groups({
+            "dict_pos": re.compile(r"aclImdb/train/pos/.*\.txt$"),
+            "dict_neg": re.compile(r"aclImdb/train/neg/.*\.txt$"),
+            "pos": re.compile(rf"aclImdb/{self.mode}/pos/.*\.txt$"),
+            "neg": re.compile(rf"aclImdb/{self.mode}/neg/.*\.txt$"),
+        })
         self.word_idx = self._build_dict(
-            re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$"), cutoff
+            groups["dict_pos"] + groups["dict_neg"], cutoff
         )
-        self._load()
+        self._load(groups["pos"], groups["neg"])
 
-    def _tokenize(self, pattern):
-        docs = []
+    def _tokenize_groups(self, patterns):
+        groups = {k: [] for k in patterns}
         punct = str.maketrans("", "", string.punctuation)
         with tarfile.open(self.data_file) as tf:
             for member in tf:
-                if member.isfile() and pattern.match(member.name):
-                    text = tf.extractfile(member).read().decode(
-                        "utf-8", "ignore"
-                    )
-                    docs.append(
-                        text.rstrip("\n\r").translate(punct).lower().split()
-                    )
-        return docs
+                if not member.isfile():
+                    continue
+                doc = None
+                for key, pattern in patterns.items():
+                    if pattern.match(member.name):
+                        if doc is None:
+                            text = tf.extractfile(member).read().decode(
+                                "utf-8", "ignore"
+                            )
+                            doc = text.rstrip("\n\r").translate(
+                                punct
+                            ).lower().split()
+                        groups[key].append(doc)
+        return groups
 
-    def _build_dict(self, pattern, cutoff):
+    @staticmethod
+    def _build_dict(docs, cutoff):
         freq = {}
-        for doc in self._tokenize(pattern):
+        for doc in docs:
             for w in doc:
                 freq[w] = freq.get(w, 0) + 1
         kept = [(w, c) for w, c in freq.items() if c > cutoff]
@@ -54,14 +69,11 @@ class Imdb(Dataset):
         word_idx["<unk>"] = len(word_idx)
         return word_idx
 
-    def _load(self):
+    def _load(self, pos_docs, neg_docs):
         unk = self.word_idx["<unk>"]
         self.docs, self.labels = [], []
-        for label, kind in ((0, "pos"), (1, "neg")):
-            pattern = re.compile(
-                rf"aclImdb/{self.mode}/{kind}/.*\.txt$"
-            )
-            for doc in self._tokenize(pattern):
+        for label, docs in ((0, pos_docs), (1, neg_docs)):
+            for doc in docs:
                 self.docs.append([self.word_idx.get(w, unk) for w in doc])
                 self.labels.append(label)
 

@@ -1,0 +1,57 @@
+"""Native C++ staging library tests (SURVEY.md §2 native mandate)."""
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason="no C++ toolchain: numpy fallback is exercised elsewhere",
+)
+
+
+def test_library_builds_and_loads():
+    assert native.lib() is not None
+    assert native.lib().pt_version() == 1
+
+
+def test_stack_samples_matches_numpy():
+    for dtype in (np.uint8, np.float32, np.int64):
+        xs = [
+            (np.random.rand(3, 5, 7) * 100).astype(dtype)
+            for _ in range(13)
+        ]
+        np.testing.assert_array_equal(
+            native.stack_samples(xs), np.stack(xs)
+        )
+
+
+def test_stack_u8_to_f32_fused_normalize():
+    xs = [
+        np.random.randint(0, 256, (3, 32, 32), np.uint8)
+        for _ in range(9)
+    ]
+    got = native.stack_u8_to_f32(xs, scale=1.0 / 255.0, shift=-0.5)
+    ref = np.stack(xs).astype(np.float32) / 255.0 - 0.5
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+    assert got.dtype == np.float32
+
+
+def test_collate_routes_through_native():
+    from paddle_tpu.io.dataloader import default_collate_fn
+
+    xs = [np.random.rand(2, 3).astype(np.float32) for _ in range(4)]
+    out = default_collate_fn(xs)
+    np.testing.assert_array_equal(out, np.stack(xs))
+    # ragged shapes keep the numpy path (and still work)
+    ragged = [np.zeros((2,), np.float32), np.zeros((2,), np.float64)]
+    assert default_collate_fn(ragged).shape == (2, 2)
+
+
+def test_numpy_fallback_paths():
+    """The fallback branches must mirror native results exactly."""
+    xs = [np.random.randint(0, 256, (4, 4), np.uint8) for _ in range(3)]
+    native_out = native.stack_u8_to_f32(xs)
+    fallback = np.stack(xs).astype(np.float32) * (1.0 / 255.0)
+    np.testing.assert_allclose(native_out, fallback, rtol=1e-6)
