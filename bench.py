@@ -28,9 +28,11 @@ import time
 import numpy as np
 
 
-def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label):
+def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label,
+                 amp=False):
     """Time `steps` TrainStep calls (one donated XLA program each), async-
-    dispatched, single block at the end. Returns (imgs/sec, breakdown)."""
+    dispatched, single block at the end. Returns (imgs/sec, breakdown).
+    amp=True routes the optimizer through the fleet bf16 strategy."""
     import jax
 
     import paddle_tpu as paddle
@@ -40,6 +42,14 @@ def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label):
     paddle.seed(0)
     model = model_fn()
     opt = opt_fn(model)
+    if amp:
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        fleet.init(is_collective=True, strategy=strategy)
+        opt = fleet.distributed_optimizer(opt)
     step = TrainStep(
         model, lambda out, y: nn.functional.cross_entropy(out, y), opt
     )
@@ -111,8 +121,6 @@ def _bert_base():
             self.head = nn.Linear(768, 2)
 
         def forward(self, ids):
-            import jax.numpy as jnp
-
             T = ids.shape[1]
             pos_ids = paddle.arange(T, dtype="int64")
             h = self.embed(ids) + self.pos(pos_ids)
@@ -192,6 +200,17 @@ def main():
     )
     extra.update(bd)
     extra["resnet50_synthetic_imgs_per_sec"] = round(r50_ips, 1)
+
+    r50_bf16_ips, bd = _bench_train(
+        lambda: resnet50(num_classes=1000),
+        lambda m: optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9, parameters=m.parameters()
+        ),
+        (3, 224, 224), 1000, batch=64, steps=20, label="resnet50_bf16",
+        amp=True,
+    )
+    extra.update(bd)
+    extra["resnet50_bf16_imgs_per_sec"] = round(r50_bf16_ips, 1)
 
     bert_ips, bd = _bench_bert()
     extra.update(bd)

@@ -52,7 +52,7 @@ from .framework.io import load, save  # noqa: F401
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
-from .hapi import Model, summary  # noqa: F401
+from .hapi import Model, flops, summary  # noqa: F401
 from .ops import creation, linalg, logic, manipulation, math, search  # noqa: F401
 from .ops.creation import to_tensor  # noqa: F401
 from .ops.logic import is_tensor  # noqa: F401

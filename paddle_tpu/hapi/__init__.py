@@ -1,5 +1,5 @@
 """paddle_tpu.hapi — the high-level API (reference: python/paddle/hapi/:
 model.py Model trainer, callbacks.py, model_summary.py)."""
 from .model import Model  # noqa: F401
-from .summary import summary  # noqa: F401
+from .summary import flops, summary  # noqa: F401
 from . import callbacks  # noqa: F401

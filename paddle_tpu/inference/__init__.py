@@ -133,7 +133,13 @@ class Predictor:
                 raise RuntimeError(f"input '{name}' was not fed")
             args.append(handle._value)
         out = self._layer(*args)
-        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        import jax
+
+        # full pytree flatten: nested outputs line up with the leaf count
+        # get_output_names advertised from the artifact treedef
+        outs = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda v: isinstance(v, Tensor)
+        )
         for i, o in enumerate(outs):
             h = self.get_output_handle(f"output_{i}")
             h.copy_from_cpu(

@@ -28,4 +28,8 @@ from .sampler import (  # noqa: F401
     SubsetRandomSampler,
     WeightedRandomSampler,
 )
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader,
+    default_collate_fn,
+    vision_collate_fn,
+)

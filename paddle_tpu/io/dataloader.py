@@ -78,6 +78,26 @@ def default_collate_fn(batch):
     return np.asarray(batch)
 
 
+def vision_collate_fn(batch):
+    """Collate for (uint8 image, label) vision samples with the native
+    FUSED stack + uint8->float32 /255 normalize (staging.cpp
+    pt_stack_u8_to_f32) — use as DataLoader(collate_fn=vision_collate_fn)
+    with datasets that keep images uint8 and skip transforms.ToTensor's
+    per-sample division. Non-(img, label) batches defer to the default."""
+    sample = batch[0]
+    if (isinstance(sample, (tuple, list)) and len(sample) == 2
+            and isinstance(sample[0], np.ndarray)
+            and sample[0].dtype == np.uint8
+            and all(s[0].shape == sample[0].shape
+                    and s[0].flags.c_contiguous for s in batch)):
+        from .. import native
+
+        imgs = native.stack_u8_to_f32([s[0] for s in batch])
+        labels = default_collate_fn([s[1] for s in batch])
+        return imgs, labels
+    return default_collate_fn(batch)
+
+
 def _to_tensor_tree(obj):
     if isinstance(obj, np.ndarray):
         return Tensor(obj)

@@ -89,17 +89,24 @@ def _src_ptrs(samples):
     return arr
 
 
+# below this, thread spawn/join overhead beats the memcpy win
+_MIN_NATIVE_BYTES = 1 << 20
+
+
 def stack_samples(samples) -> np.ndarray:
     """np.stack for a list of same-shape/dtype contiguous arrays, done by
-    the native library (GIL released during the copies)."""
+    the native library (GIL released during the copies). Small batches
+    (< ~1MB) go straight to np.stack — thread startup would dominate."""
     L = lib()
     first = samples[0]
-    if L is None:
+    total = first.nbytes * len(samples)
+    if L is None or total < _MIN_NATIVE_BYTES:
         return np.stack(samples)
     out = np.empty((len(samples),) + first.shape, first.dtype)
+    threads = _DEFAULT_THREADS if total >= 8 * _MIN_NATIVE_BYTES else 2
     L.pt_stack(
         out.ctypes.data, _src_ptrs(samples), len(samples),
-        first.nbytes, _DEFAULT_THREADS,
+        first.nbytes, threads,
     )
     return out
 

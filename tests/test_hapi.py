@@ -120,6 +120,26 @@ def test_custom_callback_and_visualdl():
     assert len(vdl.scalars["train/loss"]) == 2
 
 
+def test_flops(capsys):
+    net = nn.Sequential(
+        nn.Conv2D(1, 6, 5, padding=2),  # out 6x28x28: 28*28*6*(5*5*1)
+        nn.ReLU(),                       # 6*28*28
+        nn.MaxPool2D(2, 2),              # 6*14*14
+        nn.Flatten(),
+        nn.Linear(6 * 14 * 14, 10),      # 10 * 1176
+    )
+    total = paddle.flops(net, (1, 1, 28, 28))
+    conv = 28 * 28 * 6 * 5 * 5
+    relu = 6 * 28 * 28
+    pool = 6 * 14 * 14
+    linear = 10 * 6 * 14 * 14
+    assert total == conv + relu + pool + linear, total
+    out = capsys.readouterr().out
+    assert "Total Flops" in out
+    detail_total = paddle.flops(net, (1, 1, 28, 28), print_detail=True)
+    assert detail_total == total
+
+
 def test_summary(capsys):
     net = LeNet()
     info = paddle.summary(net, (1, 1, 28, 28))

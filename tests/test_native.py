@@ -55,3 +55,19 @@ def test_numpy_fallback_paths():
     native_out = native.stack_u8_to_f32(xs)
     fallback = np.stack(xs).astype(np.float32) * (1.0 / 255.0)
     np.testing.assert_allclose(native_out, fallback, rtol=1e-6)
+
+
+def test_vision_collate_fn_fused_normalize():
+    from paddle_tpu.io import vision_collate_fn
+
+    batch = [
+        (np.random.randint(0, 256, (3, 8, 8), np.uint8), np.int64(i))
+        for i in range(4)
+    ]
+    imgs, labels = vision_collate_fn(batch)
+    ref = np.stack([b[0] for b in batch]).astype(np.float32) / 255.0
+    np.testing.assert_allclose(imgs, ref, rtol=1e-6)
+    np.testing.assert_array_equal(labels, [0, 1, 2, 3])
+    # non-vision batches defer to the default collate
+    plain = [np.ones((2,), np.float32) for _ in range(3)]
+    assert vision_collate_fn(plain).shape == (3, 2)
