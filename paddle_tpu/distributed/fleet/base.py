@@ -432,6 +432,20 @@ class Fleet:
             raise NotImplementedError(
                 "a_sync is parameter-server mode — out of the TPU scope"
             )
+        if s.fp16_allreduce:
+            raise NotImplementedError(
+                "fp16_allreduce casts grads around an explicit NCCL "
+                "all-reduce (fp16_allreduce_optimizer.py:18); here the "
+                "grad reduction is emitted by XLA inside the compiled "
+                "step and its precision follows the tensor dtype — use "
+                "strategy.amp (bf16/fp16 compute) to reduce comm bytes"
+            )
+        if s.sharding and s.sharding_configs["hybrid_dp"]:
+            raise NotImplementedError(
+                "sharding hybrid_dp (sharding groups x dp groups) is not "
+                "built; state shards over the FULL dp axis here "
+                "(equivalent to sharding_degree == dp_degree)"
+            )
         from ...optimizer import Adam, AdamW, Lamb, Lars, Momentum
 
         if s.lamb:

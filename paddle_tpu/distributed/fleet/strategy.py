@@ -47,6 +47,7 @@ _DEFAULTS = {
     "tensor_parallel_configs": {"tensor_parallel_degree": 1},
     "gradient_merge": False,
     "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "fp16_allreduce": False,
     "localsgd": False,
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},
     "lamb": False,

@@ -165,7 +165,12 @@ class _Stage:
         self.p_objs = [p for p in module.parameters() if p.trainable]
         self.b_objs = list(dict(module.named_buffers()).values())
         # place state on this stage's submesh (TP specs keep their 'mp'
-        # placement inside the submesh)
+        # placement inside the submesh), and rebind tensor-parallel
+        # sublayers' mesh so their forward sharding constraints target
+        # THIS submesh rather than the job-wide hybrid mesh
+        for lyr in module.sublayers(include_self=True):
+            if isinstance(getattr(lyr, "mesh", None), Mesh):
+                lyr.mesh = mesh
         for p in module.parameters():
             spec = getattr(p, "_tp_spec", None) or P()
             p._data = jax.device_put(p._data, NamedSharding(mesh, spec))

@@ -163,3 +163,79 @@ def test_non_pipeline_model_rejected_when_pp():
             fleet.distributed_model(nn.Linear(4, 4))
     finally:
         comm._state.hybrid_mesh = None
+
+
+def test_hybrid_3d_dp_pp_mp_matches_single_device():
+    """GPT-3-config shape (SURVEY.md §7 stage 6): dp x pp x mp hybrid —
+    TP (Megatron MLP) layers inside pipeline stages, batches sharded over
+    dp, verified against the identical dense single-device model."""
+    from paddle_tpu.distributed import (
+        ColumnParallelLinear, RowParallelLinear,
+    )
+
+    steps, batch, D = 2, 8, 16
+    rng = np.random.RandomState(4)
+    xs = [rng.rand(batch, D).astype(np.float32) for _ in range(steps)]
+    ys = [rng.randint(0, 10, (batch,)).astype(np.int64)
+          for _ in range(steps)]
+    lr = 5e-2
+
+    def _loss(out, y):
+        return nn.functional.cross_entropy(out, y)
+
+    strategy = DistributedStrategy()
+    strategy.pipeline = True
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    strategy.hybrid_configs = {
+        "dp_degree": 2, "pp_degree": 2, "mp_degree": 2,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(21)
+        col = ColumnParallelLinear(D, 32, gather_output=False)
+        row = RowParallelLinear(32, D, input_is_parallel=True)
+        head = nn.Linear(D, 10)
+        # logical weights BEFORE training, for the dense reference
+        w_col = np.asarray(col.weight._data).copy()
+        b_col = np.asarray(col.bias._data).copy()
+        w_row = np.asarray(row.weight._data).copy()
+        b_row = np.asarray(row.bias._data).copy()
+        w_head = np.asarray(head.weight._data).copy()
+        b_head = np.asarray(head.bias._data).copy()
+
+        model = fleet.distributed_model(PipelineLayer(
+            [col, nn.ReLU(), row, head], loss_fn=_loss
+        ))
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(learning_rate=lr,
+                          parameters=model.parameters())
+        )
+        pp_losses = [
+            float(model.train_batch([x, y], opt).numpy())
+            for x, y in zip(xs, ys)
+        ]
+    finally:
+        comm._state.hybrid_mesh = None
+
+    # dense single-device reference with the same initial weights
+    dense1 = nn.Linear(D, 32)
+    dense1.weight.set_value(w_col)
+    dense1.bias.set_value(b_col)
+    dense2 = nn.Linear(32, D)
+    dense2.weight.set_value(w_row)
+    dense2.bias.set_value(b_row)
+    dense3 = nn.Linear(D, 10)
+    dense3.weight.set_value(w_head)
+    dense3.bias.set_value(b_head)
+    ref = nn.Sequential(dense1, nn.ReLU(), dense2, dense3)
+    ropt = optimizer.SGD(learning_rate=lr, parameters=ref.parameters())
+    ref_losses = []
+    for x, y in zip(xs, ys):
+        loss = _loss(ref(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        ropt.step()
+        ropt.clear_grad()
+        ref_losses.append(float(loss.numpy()))
+
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=5e-4,
+                               atol=5e-5)

@@ -180,6 +180,27 @@ class TestOptimizerSwaps:
                 dgc=True,
             )
 
+    def test_fp16_allreduce_raises(self):
+        model = nn.Linear(4, 4)
+        with pytest.raises(NotImplementedError, match="fp16_allreduce"):
+            _fleet_opt(
+                optimizer.SGD(learning_rate=1e-3,
+                              parameters=model.parameters()),
+                fp16_allreduce=True,
+            )
+
+    def test_sharding_hybrid_dp_raises(self):
+        model = nn.Linear(4, 4)
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"hybrid_dp": True}
+        fleet.init(is_collective=True, strategy=strategy)
+        with pytest.raises(NotImplementedError, match="hybrid_dp"):
+            fleet.distributed_optimizer(
+                optimizer.SGD(learning_rate=1e-3,
+                              parameters=model.parameters())
+            )
+
     def test_a_sync_raises(self):
         model = nn.Linear(4, 4)
         with pytest.raises(NotImplementedError, match="a_sync"):
