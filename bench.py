@@ -176,6 +176,49 @@ def _bench_bert(steps=10, batch=32, seq=128):
     }
 
 
+def _bench_flash_attention(steps=30):
+    """Long-context attention: the Pallas flash kernel vs XLA dense at
+    S=2048 causal (ops/pallas/flash_attention.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import flash_attention
+
+    B, H, S, D = 4, 12, 2048, 64
+    r = np.random.RandomState(0)
+    q, k, v = [
+        jax.device_put(jnp.asarray(
+            r.rand(B, H, S, D).astype(np.float32) - 0.5
+        ))
+        for _ in range(3)
+    ]
+
+    def dense(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+        pos = jnp.arange(S)
+        s = jnp.where(pos[None, :] > pos[:, None], -1e30, s)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    flash = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, True, 256, 256, None,
+                                        False)
+    )
+    dense_j = jax.jit(dense)
+
+    def ms(f):
+        jax.block_until_ready(f(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            o = f(q, k, v)
+        jax.block_until_ready(o)
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    return {
+        "flash_attn_s2048_pallas_ms": round(ms(flash), 2),
+        "flash_attn_s2048_dense_ms": round(ms(dense_j), 2),
+    }
+
+
 def main():
     from paddle_tpu import optimizer
     from paddle_tpu.vision.models import LeNet, resnet50
@@ -215,6 +258,7 @@ def main():
     bert_ips, bd = _bench_bert()
     extra.update(bd)
     extra["bert_base_bf16_samples_per_sec"] = round(bert_ips, 1)
+    extra.update(_bench_flash_attention())
     extra["vs_r02"] = round(lenet_ips / 663.6, 1)
     extra["note"] = (
         "TrainStep hot path (fused fwd+bwd+opt, donated, device-staged "

@@ -118,12 +118,35 @@ def _blockwise_raw(q, k, v, *, causal=False, block_size=512, scale=None):
 
 def blockwise_attention(q, k, v, causal=False, block_size=512, scale=None):
     """Exact softmax attention with O(block) score memory (flash-style).
-    q,k,v: [B, H, S, D] Tensors or arrays."""
+    q,k,v: [B, H, S, D] Tensors or arrays. On TPU with block-divisible
+    shapes this routes to the hand-tiled Pallas kernel
+    (ops/pallas/flash_attention.py — measured faster than both the dense
+    and the XLA-scheduled blockwise program); elsewhere the XLA blockwise
+    path runs."""
     from ...core import autograd as AG
 
     ts = tuple(
         x if isinstance(x, Tensor) else Tensor(x) for x in (q, k, v)
     )
+    S, Sk = ts[0].shape[2], ts[1].shape[2]
+    bq, bk = min(block_size, S), min(block_size, Sk)
+    D = ts[0].shape[-1]
+    # Pallas routing guards: single chip only (a pallas_call inside a
+    # multi-device jit is not GSPMD-partitionable like the XLA program it
+    # replaces — sharded meshes keep the blockwise path), and the
+    # kernel's per-head K/V VMEM residency must fit (~8MB of the ~16MB
+    # budget); beyond that the O(block) lax.scan path is the right tool.
+    fits_vmem = Sk * D * ts[1]._data.dtype.itemsize * 2 <= (8 << 20)
+    if (jax.default_backend() == "tpu" and len(jax.devices()) == 1
+            and ts[0]._data.ndim == 4 and fits_vmem
+            and S % bq == 0 and Sk % bk == 0):
+        from ...ops.pallas import flash_attention
+
+        return AG.apply(
+            lambda a, b, c: flash_attention(a, b, c, causal, bq, bk,
+                                            scale, False),
+            ts, name="flash_attention",
+        )
     return AG.apply(
         partial(_blockwise_raw, causal=causal, block_size=block_size,
                 scale=scale),

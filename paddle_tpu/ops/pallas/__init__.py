@@ -1,0 +1,5 @@
+"""Pallas TPU kernels — hand-tiled hot ops (SURVEY.md §2.4 TPU mapping:
+'dense op layer collapses into XLA ops + Pallas kernels')."""
+from .flash_attention import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,143 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The MXU-tiled counterpart of `nn/layers/ring_attention.py`'s XLA blockwise
+path (reference gap: the CUDA side fuses attention via
+operators/fused/fused_attention pieces and math/bert_encoder_functor.cu —
+here the fusion is an explicit VMEM-resident online-softmax kernel).
+
+Design: grid over (batch*heads, query blocks); each program holds its
+[block_q, D] query tile plus this head's full K/V in VMEM and runs the
+online-softmax accumulation over K blocks with `lax.fori_loop` (f32
+accumulators, causal masking by global positions, fully-masked key blocks
+skipped arithmetically via the -1e30 max). VMEM budget bounds the per-head
+K/V residency: S*D*4 bytes*2 must fit in ~16MB — S<=16k at D=128 — which
+covers single-chip use; beyond that, shard S over the `sp` axis
+(ring attention) so each device's resident block stays small.
+
+Backward: `jax.custom_vjp` whose bwd recomputes through the XLA blockwise
+path (identical math) — forward gets the hand kernel, backward the
+compiler-scheduled recompute.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale, seq_k):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)              # [block_q, D]
+    block_q, d = q.shape
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    n_k = seq_k // block_k
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                  # [block_q, block_k]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos > q_pos, _NEG, s)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + p.sum(axis=1)
+        o_new = o * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o_new, m_new, l_new
+
+    o = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q,), _NEG, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_k, body, (o, m, l))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def _forward(q, k, v, *, causal, block_q, block_k, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    scale = scale if scale is not None else D ** -0.5
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    if S % block_q or Sk % block_k:
+        raise ValueError(
+            f"flash_attention: S={S}/Sk={Sk} must be divisible by "
+            f"block_q={block_q}/block_k={block_k}"
+        )
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+    grid = (B * H, S // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, block_k=block_k, causal=causal, scale=scale,
+            seq_k=Sk,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, S, D)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(q, k, v, causal=False, block_q=256, block_k=256,
+                    scale=None, interpret=False):
+    """Exact softmax attention, Pallas-tiled on TPU. [B, H, S, D] in/out.
+    `interpret=True` runs the kernel in the Pallas interpreter (CPU
+    testing)."""
+    return _forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, interpret=interpret,
+    )
+
+
+def _fwd(q, k, v, causal, block_q, block_k, scale, interpret):
+    out = _forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        scale=scale, interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, scale, interpret, res, g):
+    from ...nn.layers.ring_attention import _blockwise_raw
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: _blockwise_raw(
+            a, b, c, causal=causal, block_size=block_k, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,82 @@
+"""Pallas flash-attention kernel, interpreter mode (CPU CI; the compiled
+kernel runs on real TPU — bench.py carries its timing)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import flash_attention
+
+B, H, S, D = 2, 2, 128, 32
+
+
+def _qkv(seed=0):
+    r = np.random.RandomState(seed)
+    return [
+        jnp.asarray(r.rand(B, H, S, D).astype(np.float32) - 0.5)
+        for _ in range(3)
+    ]
+
+
+def _dense(q, k, v, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    if causal:
+        pos = jnp.arange(S)
+        s = jnp.where(pos[None, :] > pos[:, None], -1e30, s)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block", [32, 64, 128])
+def test_kernel_matches_dense(causal, block):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal, block, block, None, True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_dense(q, k, v, causal)),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_kernel_gradients_match_dense():
+    q, k, v = _qkv(1)
+    cot = jnp.asarray(
+        np.random.RandomState(2).rand(B, H, S, D).astype(np.float32)
+    )
+
+    def loss_flash(a, b, c):
+        return (flash_attention(a, b, c, True, 64, 64, None, True)
+                * cot).sum()
+
+    def loss_dense(a, b, c):
+        return (_dense(a, b, c, True) * cot).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
+        )
+
+
+def test_indivisible_block_raises():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, False, 96, 96, None, True)
+
+
+def test_mha_blockwise_stays_on_xla_path_on_cpu():
+    """On the CPU backend blockwise_attention must NOT pick the pallas
+    kernel (compiled pallas is TPU-only; interpret is for tests)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layers.ring_attention import blockwise_attention
+
+    q, k, v = _qkv(3)
+    out = blockwise_attention(
+        paddle.to_tensor(np.asarray(q)), paddle.to_tensor(np.asarray(k)),
+        paddle.to_tensor(np.asarray(v)), causal=True, block_size=64,
+    )
+    np.testing.assert_allclose(
+        out.numpy(), np.asarray(_dense(q, k, v, True)), rtol=2e-4,
+        atol=2e-5,
+    )
