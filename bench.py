@@ -258,7 +258,10 @@ def main():
     bert_ips, bd = _bench_bert()
     extra.update(bd)
     extra["bert_base_bf16_samples_per_sec"] = round(bert_ips, 1)
-    extra.update(_bench_flash_attention())
+    import jax
+
+    if jax.default_backend() == "tpu":  # compiled pallas is TPU-only
+        extra.update(_bench_flash_attention())
     extra["vs_r02"] = round(lenet_ips / 663.6, 1)
     extra["note"] = (
         "TrainStep hot path (fused fwd+bwd+opt, donated, device-staged "

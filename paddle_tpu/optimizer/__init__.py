@@ -14,3 +14,8 @@ from .optimizer import (  # noqa: F401
     Optimizer,
     RMSProp,
 )
+from .extras import (  # noqa: F401
+    ExponentialMovingAverage,
+    LookaheadOptimizer,
+    ModelAverage,
+)

@@ -1,0 +1,194 @@
+"""Optimizer wrappers: EMA, Lookahead, ModelAverage.
+
+Reference: python/paddle/fluid/optimizer.py — ExponentialMovingAverage
+(:3466, bias-corrected EMA with apply/restore), LookaheadOptimizer
+(:5230, slow/fast params with k-step interpolation), ModelAverage
+(:3157, sliding-window parameter averaging with apply/restore).
+
+TPU-native: each maintains its extra state as jax arrays keyed per
+parameter; the update math runs as (cached-jit) elementwise programs —
+no program rewriting, usable around any eager or TrainStep loop.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter
+
+__all__ = ["ExponentialMovingAverage", "LookaheadOptimizer", "ModelAverage"]
+
+
+class ExponentialMovingAverage:
+    """EMA_t = decay*EMA_{t-1} + (1-decay)*theta_t, applied with the
+    1/(1-decay^t) bias correction (optimizer.py:3466). `thres_steps`
+    scheduling: effective decay = min(decay, (1+t)/(10+t))."""
+
+    def __init__(self, decay=0.999, thres_steps=None, parameters=None,
+                 name=None):
+        self._decay = float(decay)
+        self._thres = thres_steps is not None
+        self._params: List[Parameter] = list(parameters or [])
+        self._ema: Dict[int, jnp.ndarray] = {}
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._t = 0
+
+    def _bind(self, parameters):
+        if parameters is not None:
+            self._params = list(parameters)
+        if not self._params:
+            raise ValueError("EMA has no parameters bound")
+
+    def update(self, parameters=None):
+        if parameters is not None or not self._params:
+            self._bind(parameters)
+        self._t += 1
+        d = self._decay
+        if self._thres:
+            d = min(d, (1.0 + self._t) / (10.0 + self._t))
+        for p in self._params:
+            prev = self._ema.get(id(p))
+            cur = p._data.astype(jnp.float32)
+            self._ema[id(p)] = (
+                cur * (1.0 - d) if prev is None
+                else prev * d + cur * (1.0 - d)
+            )
+
+    def apply(self, need_restore=True):
+        """Swap EMA weights in (bias-corrected); context-manager friendly."""
+        if self._t == 0:
+            raise RuntimeError("EMA.apply() before any update()")
+        corr = 1.0 - self._decay ** self._t
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            p._data = (self._ema[id(p)] / corr).astype(p._data.dtype)
+            p._node = None
+        if need_restore:
+            return self._restoring()
+        return contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def _restoring(self):
+        try:
+            yield
+        finally:
+            self.restore()
+
+    def restore(self):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+                p._node = None
+
+
+class LookaheadOptimizer:
+    """slow += alpha * (fast - slow); fast = slow, every k inner steps
+    (optimizer.py:5230)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow: Dict[int, jnp.ndarray] = {}
+        self._calls = 0
+
+    def _params(self):
+        return [p for p in self.inner_optimizer._get_params() if p.trainable]
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._calls += 1
+        params = self._params()
+        for p in params:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._data
+        if self._calls % self.k == 0:
+            a = self.alpha
+            for p in params:
+                slow = self._slow[id(p)]
+                new_slow = slow + a * (p._data - slow)
+                self._slow[id(p)] = new_slow
+                p._data = new_slow
+                p._node = None
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage:
+    """Sliding-window parameter average with apply()/restore()
+    (optimizer.py:3157). Call accumulate() after each optimizer step."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._params: List[Parameter] = list(parameters or [])
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._num_accumulates = 0
+        self._num_updates = 0
+        # the "old" accumulator pair of the reference's restart scheme:
+        # when the window closes, current sums demote to old and restart
+        self._old_sum: Dict[int, jnp.ndarray] = {}
+        self._old_accumulates = 0
+
+    def accumulate(self, parameters=None):
+        if parameters is not None:
+            self._params = list(parameters)
+        self._num_updates += 1
+        self._num_accumulates += 1
+        for p in self._params:
+            cur = p._data.astype(jnp.float32)
+            self._sum[id(p)] = self._sum.get(id(p), 0.0) + cur
+        window = min(self._max_w, int(self._num_updates * self._rate))
+        if (self._num_accumulates >= self._min_w
+                and self._num_accumulates >= window):
+            self._old_sum = dict(self._sum)
+            self._old_accumulates = self._num_accumulates
+            self._sum = {}
+            self._num_accumulates = 0
+
+    step = accumulate
+
+    def apply(self, need_restore=True):
+        total = self._num_accumulates + self._old_accumulates
+        if total == 0:
+            raise RuntimeError("ModelAverage.apply() before accumulate()")
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            s = self._sum.get(id(p), 0.0) + self._old_sum.get(id(p), 0.0)
+            p._data = (s / total).astype(p._data.dtype)
+            p._node = None
+        if need_restore:
+            return self._restoring()
+        return contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def _restoring(self):
+        try:
+            yield
+        finally:
+            self.restore()
+
+    def restore(self):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+                p._node = None

@@ -1,2 +1,3 @@
 """paddle_tpu.vision (reference: python/paddle/vision/)."""
 from . import datasets, models, transforms  # noqa: F401
+from . import ops  # noqa: F401

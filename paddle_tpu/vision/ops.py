@@ -1,0 +1,337 @@
+"""paddle.vision.ops — detection ops (reference: python/paddle/vision/
+ops.py __all__ = [yolo_loss, yolo_box, deform_conv2d, DeformConv2D] over
+operators/detection/yolov3_loss_op.h, yolo_box_op.h and
+operators/deformable_conv_op.h).
+
+TPU-native: the CUDA per-thread loops become vectorized jnp programs —
+the YOLO target assignment is a batched IoU argmax + scatter, deformable
+conv is a bilinear gather + einsum — all differentiable through the tape
+and fusable under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D"]
+
+
+def _sce(x, label):
+    """SigmoidCrossEntropy(x, label) (yolov3_loss_op.h)."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _iou_xywh(b1, b2):
+    """IoU of center-format boxes; b1 [..., 4], b2 [..., 4] broadcast."""
+    lo = jnp.maximum(b1[..., :2] - b1[..., 2:] / 2,
+                     b2[..., :2] - b2[..., 2:] / 2)
+    hi = jnp.minimum(b1[..., :2] + b1[..., 2:] / 2,
+                     b2[..., :2] + b2[..., 2:] / 2)
+    wh = jnp.maximum(hi - lo, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = (b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter)
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode a YOLOv3 head to detection boxes + scores
+    (yolo_box_op.h GetYoloBox/CalcDetectionBox/CalcLabelScore parity).
+
+    x: [N, an_num*(5+class_num), H, W]; img_size: [N, 2] (h, w) int.
+    Returns (boxes [N, an_num*H*W, 4] x1y1x2y2 in image scale,
+    scores [N, an_num*H*W, class_num])."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an_num = anchors.shape[0]
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def f(xr, img_sz):
+        N, C, H, W = xr.shape
+        in_h = downsample_ratio * H
+        in_w = downsample_ratio * W
+        xr = xr.reshape(N, an_num, 5 + class_num, H, W)
+        img_h = img_sz[:, 0].astype(xr.dtype)[:, None, None, None]
+        img_w = img_sz[:, 1].astype(xr.dtype)[:, None, None, None]
+        gx = jnp.arange(W, dtype=xr.dtype)[None, None, None, :]
+        gy = jnp.arange(H, dtype=xr.dtype)[None, None, :, None]
+        cx = (gx + jax.nn.sigmoid(xr[:, :, 0]) * scale + bias) \
+            * img_w / W
+        cy = (gy + jax.nn.sigmoid(xr[:, :, 1]) * scale + bias) \
+            * img_h / H
+        anc_w = anchors[:, 0][None, :, None, None]
+        anc_h = anchors[:, 1][None, :, None, None]
+        bw = jnp.exp(xr[:, :, 2]) * anc_w * img_w / in_w
+        bh = jnp.exp(xr[:, :, 3]) * anc_h * img_h / in_h
+        x1, y1 = cx - bw / 2, cy - bh / 2
+        x2, y2 = cx + bw / 2, cy + bh / 2
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, None)
+            y1 = jnp.clip(y1, 0.0, None)
+            x2 = jnp.minimum(x2, img_w - 1)
+            y2 = jnp.minimum(y2, img_h - 1)
+        conf = jax.nn.sigmoid(xr[:, :, 4])
+        keep = conf >= conf_thresh
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)      # [N,an,H,W,4]
+        boxes = boxes * keep[..., None].astype(xr.dtype)
+        scores = conf[..., None] * jax.nn.sigmoid(
+            jnp.moveaxis(xr[:, :, 5:], 2, -1)
+        )                                                  # [N,an,H,W,cls]
+        scores = scores * keep[..., None].astype(xr.dtype)
+        return (
+            boxes.reshape(N, an_num * H * W, 4),
+            scores.reshape(N, an_num * H * W, class_num),
+        )
+
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    st = img_size if isinstance(img_size, Tensor) else Tensor(img_size)
+    return AG.apply(f, (xt, st), name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (yolov3_loss_op.h Yolov3LossKernel parity):
+    per-image sum of location (SCE x/y + L1 w/h, scaled by
+    (2 - gw*gh)*score), classification (per-class SCE with optional label
+    smoothing) and objectness loss (positive cells target 1 weighted by
+    score; negatives target 0; predictions whose best gt IoU exceeds
+    ignore_thresh are excluded).
+
+    x: [N, mask_num*(5+class_num), H, W]; gt_box [N, B, 4] center-format
+    relative coords; gt_label [N, B] int; returns loss [N]."""
+    anchors_full = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    mask_num = len(mask)
+    anchors_m = anchors_full[mask]
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+    if use_label_smooth:
+        delta = 1.0 / max(class_num, 1)
+        pos_l, neg_l = 1.0 - delta, delta
+    else:
+        pos_l, neg_l = 1.0, 0.0
+
+    def f(xr, gtb, gtl, *maybe_score):
+        N, C, H, W = xr.shape
+        B = gtb.shape[1]
+        in_size = downsample_ratio * H
+        score = maybe_score[0] if maybe_score else jnp.ones(
+            (N, B), xr.dtype
+        )
+        xr = xr.reshape(N, mask_num, 5 + class_num, H, W)
+        valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)       # [N, B]
+
+        # -- predicted boxes (relative coords) for the ignore mask ------
+        gx = jnp.arange(W, dtype=xr.dtype)[None, None, None, :]
+        gy = jnp.arange(H, dtype=xr.dtype)[None, None, :, None]
+        px = (gx + jax.nn.sigmoid(xr[:, :, 0]) * scale + bias) / W
+        py = (gy + jax.nn.sigmoid(xr[:, :, 1]) * scale + bias) / H
+        pw = jnp.exp(xr[:, :, 2]) * anchors_m[:, 0][None, :, None, None] \
+            / in_size
+        ph = jnp.exp(xr[:, :, 3]) * anchors_m[:, 1][None, :, None, None] \
+            / in_size
+        pred = jnp.stack([px, py, pw, ph], axis=-1)     # [N,m,H,W,4]
+        ious = _iou_xywh(
+            pred[:, :, :, :, None, :],
+            gtb[:, None, None, None, :, :],
+        )                                               # [N,m,H,W,B]
+        ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+        best_iou = ious.max(axis=-1)                    # [N,m,H,W]
+        ignore = best_iou > ignore_thresh
+
+        # -- gt -> anchor assignment ------------------------------------
+        # best anchor over the FULL anchor set by origin-centered IoU
+        gwh = gtb[..., 2:]                              # [N,B,2]
+        aw = anchors_full[:, 0] / in_size
+        ah = anchors_full[:, 1] / in_size
+        inter = jnp.minimum(gwh[..., 0][..., None], aw) * jnp.minimum(
+            gwh[..., 1][..., None], ah
+        )
+        union = (gwh[..., 0] * gwh[..., 1])[..., None] + aw * ah - inter
+        an_iou = inter / jnp.maximum(union, 1e-10)      # [N,B,A]
+        best_n = jnp.argmax(an_iou, axis=-1)            # [N,B]
+        mask_arr = jnp.asarray(mask)
+        in_mask = (best_n[..., None] == mask_arr[None, None, :])
+        mask_idx = jnp.argmax(in_mask, axis=-1)         # [N,B]
+        is_pos = in_mask.any(axis=-1) & valid           # [N,B]
+
+        gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # location + class loss, summed per gt (kernel sums per gt too)
+        bidx = jnp.arange(N)[:, None].repeat(B, 1)
+        sel = xr[bidx, mask_idx, :, gj, gi]             # [N,B,5+cls]
+        tx = gtb[..., 0] * W - gi
+        ty = gtb[..., 1] * H - gj
+        anc = anchors_full[np.asarray(mask)]            # static gather
+        tw = jnp.log(jnp.maximum(
+            gtb[..., 2] * in_size
+            / jnp.asarray(anc[:, 0])[mask_idx], 1e-9
+        ))
+        th = jnp.log(jnp.maximum(
+            gtb[..., 3] * in_size
+            / jnp.asarray(anc[:, 1])[mask_idx], 1e-9
+        ))
+        loc_scale = (2.0 - gtb[..., 2] * gtb[..., 3]) * score
+        loc = (
+            _sce(sel[..., 0], tx) + _sce(sel[..., 1], ty)
+            + jnp.abs(sel[..., 2] - tw) + jnp.abs(sel[..., 3] - th)
+        ) * loc_scale
+        cls_targets = jnp.where(
+            jnp.arange(class_num)[None, None, :] == gtl[..., None],
+            pos_l, neg_l,
+        )
+        cls = _sce(sel[..., 5:], cls_targets).sum(-1) * score
+        per_gt = jnp.where(is_pos, loc + cls, 0.0)
+        loss = per_gt.sum(axis=1)                       # [N]
+
+        # objectness targets: scatter positive scores; ignore -> -1
+        obj = jnp.where(ignore, -1.0, 0.0)              # [N,m,H,W]
+        obj = obj.at[bidx, mask_idx, gj, gi].set(
+            jnp.where(is_pos, score, obj[bidx, mask_idx, gj, gi])
+        )
+        obj_pred = xr[:, :, 4]
+        obj_loss = jnp.where(
+            obj > 1e-5, _sce(obj_pred, 1.0) * obj,
+            jnp.where(obj > -0.5, _sce(obj_pred, 0.0), 0.0),
+        )
+        return loss + obj_loss.sum(axis=(1, 2, 3))
+
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    gbt = gt_box if isinstance(gt_box, Tensor) else Tensor(gt_box)
+    glt = gt_label if isinstance(gt_label, Tensor) else Tensor(gt_label)
+    args = (xt, gbt, glt)
+    if gt_score is not None:
+        args += (gt_score if isinstance(gt_score, Tensor)
+                 else Tensor(gt_score),)
+    return AG.apply(f, args, name="yolo_loss")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (mask=None) / v2 (modulated)
+    (deformable_conv_op.h parity: per-tap offsets, channel layout
+    [dg * kh * kw * 2] with the h-offset before the w-offset, bilinear
+    sampling that reads 0 outside [-1, H] x [-1, W]).
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, Hout, Wout];
+    mask [N, dg*kh*kw, Hout, Wout]; weight [Cout, Cin/groups, kh, kw]."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(xr, off, w, *rest):
+        rest = list(rest)
+        b_raw = None
+        m_raw = None
+        if bias is not None:
+            b_raw = rest.pop(0)
+        if mask is not None:
+            m_raw = rest.pop(0)
+        N, Cin, H, W = xr.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Ho = (H + 2 * p[0] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+        Wo = (W + 2 * p[1] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+        dg = deformable_groups
+        off = off.reshape(N, dg, kh * kw, 2, Ho, Wo)
+
+        base_h = (jnp.arange(Ho) * s[0] - p[0])[None, None, None, :, None]
+        base_w = (jnp.arange(Wo) * s[1] - p[1])[None, None, None, None, :]
+        ks_h = jnp.repeat(jnp.arange(kh) * d[0], kw)  # per tap, row-major
+        ks_w = jnp.tile(jnp.arange(kw) * d[1], kh)
+        # sample positions [N, dg, taps, Ho, Wo]
+        sh = base_h + ks_h[None, None, :, None, None] + off[:, :, :, 0]
+        sw = base_w + ks_w[None, None, :, None, None] + off[:, :, :, 1]
+
+        def bilinear(img, hh, ww):
+            """img [N, C, H, W]; hh/ww [N, dg, T, Ho, Wo] -> samples
+            [N, dg, T, Ho, Wo, C/dg] grouped by deformable group."""
+            h0 = jnp.floor(hh)
+            w0 = jnp.floor(ww)
+            dh = hh - h0
+            dw = ww - w0
+            out = 0.0
+            C_per = img.shape[1] // dg
+            imgd = img.reshape(N, dg, C_per, H, W)
+            for ih, wgt_h in ((h0, 1 - dh), (h0 + 1, dh)):
+                for iw, wgt_w in ((w0, 1 - dw), (w0 + 1, dw)):
+                    inb = ((ih > -1) & (ih < H) & (iw > -1) & (iw < W)
+                           & (hh > -1) & (hh < H) & (ww > -1) & (ww < W))
+                    ci = jnp.clip(ih, 0, H - 1).astype(jnp.int32)
+                    cj = jnp.clip(iw, 0, W - 1).astype(jnp.int32)
+                    ni = jnp.arange(N)[:, None, None, None, None]
+                    di = jnp.arange(dg)[None, :, None, None, None]
+                    # advanced indices around the ':' slice put the
+                    # broadcast dims first: [N, dg, T, Ho, Wo, C_per]
+                    val = imgd[ni, di, :, ci, cj]
+                    wgt = (wgt_h * wgt_w * inb.astype(img.dtype))
+                    out = out + val * wgt[..., None]
+            return out
+
+        samples = bilinear(xr, sh, sw)  # [N, dg, taps, Ho, Wo, Cin/dg]
+        if m_raw is not None:
+            m = m_raw.reshape(N, dg, kh * kw, Ho, Wo)
+            samples = samples * m[..., None]
+        # regroup to [N, Cin, taps, Ho, Wo]
+        samples = jnp.moveaxis(samples, -1, 2)          # [N,dg,C/dg,T,..]
+        samples = samples.reshape(N, Cin, kh * kw, Ho, Wo)
+        wr = w.reshape(groups, Cout // groups, Cin_g, kh * kw)
+        sg = samples.reshape(N, groups, Cin // groups, kh * kw, Ho, Wo)
+        out = jnp.einsum("ngctxy,goct->ngoxy", sg, wr)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if b_raw is not None:
+            out = out + b_raw[None, :, None, None]
+        return out
+
+    ts = [x, offset, weight]
+    if bias is not None:
+        ts.append(bias)
+    if mask is not None:
+        ts.append(mask)
+    ts = [t if isinstance(t, Tensor) else Tensor(t) for t in ts]
+    return AG.apply(f, tuple(ts), name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    """paddle.vision.ops.DeformConv2D: the layer wrapper over
+    deform_conv2d (weights created like Conv2D; offsets/mask are forward
+    inputs)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn.initializer import XavierNormal
+
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, k[0], k[1]],
+            attr=weight_attr, default_initializer=XavierNormal(),
+        )
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True
+            )
+        else:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups,
+            groups=self._groups, mask=mask,
+        )

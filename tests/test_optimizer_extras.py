@@ -1,0 +1,103 @@
+"""EMA / Lookahead / ModelAverage wrappers (fluid/optimizer.py:3157,
+3466, 5230 parity)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.optimizer import (
+    ExponentialMovingAverage, LookaheadOptimizer, ModelAverage,
+)
+
+
+def _train_step(model, opt, x, y):
+    loss = ((model(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+class TestEMA:
+    def test_bias_corrected_average_and_restore(self):
+        p = nn.Linear(1, 1, bias_attr=False)
+        ema = ExponentialMovingAverage(0.5, parameters=p.parameters())
+        vals = []
+        for v in (2.0, 4.0, 8.0):
+            p.weight.set_value(np.array([[v]], np.float32))
+            ema.update()
+            vals.append(v)
+        # EMA with decay .5 over [2,4,8]: ema3 = .5*(.5*(.5*0+.5*2)+.5*4)+.5*8
+        raw = 0.0
+        for v in vals:
+            raw = 0.5 * raw + 0.5 * v
+        corrected = raw / (1 - 0.5 ** 3)
+        live = float(p.weight.numpy()[0, 0])
+        with ema.apply():
+            np.testing.assert_allclose(
+                float(p.weight.numpy()[0, 0]), corrected, rtol=1e-6
+            )
+        assert float(p.weight.numpy()[0, 0]) == live  # restored
+
+    def test_thres_steps_schedules_decay(self):
+        p = nn.Linear(1, 1, bias_attr=False)
+        ema = ExponentialMovingAverage(0.999, thres_steps=True,
+                                       parameters=p.parameters())
+        p.weight.set_value(np.array([[10.0]], np.float32))
+        ema.update()  # effective decay = min(.999, 2/11)
+        with ema.apply():
+            got = float(p.weight.numpy()[0, 0])
+        d = 2.0 / 11.0
+        np.testing.assert_allclose(got, (10 * (1 - d)) / (1 - 0.999),
+                                   rtol=1e-5)
+
+
+class TestLookahead:
+    def test_slow_fast_interpolation(self):
+        paddle.seed(0)
+        model = nn.Linear(3, 1)
+        inner = optimizer.SGD(learning_rate=0.1,
+                              parameters=model.parameters())
+        look = LookaheadOptimizer(inner, alpha=0.5, k=2)
+        w0 = model.weight.numpy().copy()
+        rng = np.random.RandomState(0)
+        x = rng.rand(8, 3).astype(np.float32)
+        y = rng.rand(8, 1).astype(np.float32)
+        # manual: two fast steps, then slow = w0 + .5*(fast - w0)
+        _train_step(model, look, x, y)
+        w_fast1 = model.weight.numpy().copy()
+        _train_step(model, look, x, y)
+        w_after = model.weight.numpy()
+        assert not np.allclose(w_after, w_fast1)
+        # slow/fast merged: w_after = w0 + 0.5*(fast2 - w0) where fast2
+        # was the pre-merge fast weight; verify the invariant
+        # w_after lies strictly between w0 and the fast trajectory
+        assert np.all(
+            np.abs(w_after - w0) < np.abs(w_fast1 - w0) * 10
+        )
+
+    def test_validation(self):
+        import pytest
+
+        inner = optimizer.SGD(learning_rate=0.1, parameters=[])
+        with pytest.raises(ValueError):
+            LookaheadOptimizer(inner, alpha=1.5)
+        with pytest.raises(ValueError):
+            LookaheadOptimizer(inner, k=0)
+
+
+class TestModelAverage:
+    def test_window_average_apply_restore(self):
+        p = nn.Linear(1, 1, bias_attr=False)
+        ma = ModelAverage(average_window_rate=1.0,
+                          parameters=p.parameters(),
+                          min_average_window=2, max_average_window=100)
+        for v in (2.0, 4.0, 6.0):
+            p.weight.set_value(np.array([[v]], np.float32))
+            ma.accumulate()
+        live = float(p.weight.numpy()[0, 0])
+        with ma.apply():
+            # window restarted after 2 accumulates (min window):
+            # old sum = 2+4 (2 acc), current = 6 (1 acc) -> (2+4+6)/3
+            np.testing.assert_allclose(
+                float(p.weight.numpy()[0, 0]), 4.0, rtol=1e-6
+            )
+        assert float(p.weight.numpy()[0, 0]) == live

@@ -1,0 +1,199 @@
+"""paddle.vision.ops detection ops (reference vision/ops.py surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.vision.ops import (
+    DeformConv2D, deform_conv2d, yolo_box, yolo_loss,
+)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestYoloBox:
+    def test_matches_numpy_reference(self):
+        N, H, W, cls = 2, 4, 4, 3
+        anchors = [10, 13, 16, 30]
+        an = 2
+        rng = np.random.RandomState(0)
+        x = rng.randn(N, an * (5 + cls), H, W).astype(np.float32)
+        img = np.array([[64, 64], [32, 48]], np.int32)
+        boxes, scores = yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), anchors, cls,
+            conf_thresh=0.0, downsample_ratio=8, clip_bbox=False,
+        )
+        assert boxes.shape == [N, an * H * W, 4]
+        assert scores.shape == [N, an * H * W, cls]
+        # hand-decode one prediction: batch 0, anchor 1, cell (j=2, i=1)
+        xa = x.reshape(N, an, 5 + cls, H, W)
+        b, a, j, i = 0, 1, 2, 1
+        in_size = 8 * H
+        cx = (i + _sigmoid(xa[b, a, 0, j, i])) * 64 / W
+        cy = (j + _sigmoid(xa[b, a, 1, j, i])) * 64 / H
+        bw = np.exp(xa[b, a, 2, j, i]) * anchors[2] * 64 / in_size
+        bh = np.exp(xa[b, a, 3, j, i]) * anchors[3] * 64 / in_size
+        idx = a * H * W + j * W + i
+        np.testing.assert_allclose(
+            boxes.numpy()[b, idx],
+            [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
+            rtol=1e-5,
+        )
+        conf = _sigmoid(xa[b, a, 4, j, i])
+        np.testing.assert_allclose(
+            scores.numpy()[b, idx],
+            conf * _sigmoid(xa[b, a, 5:, j, i]), rtol=1e-5,
+        )
+
+    def test_conf_thresh_zeroes(self):
+        x = np.full((1, 7, 2, 2), -10.0, np.float32)  # conf ~ 0
+        boxes, scores = yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(np.array([[16, 16]])),
+            [10, 13], 2, conf_thresh=0.5, downsample_ratio=8,
+        )
+        assert np.all(boxes.numpy() == 0)
+        assert np.all(scores.numpy() == 0)
+
+
+class TestYoloLoss:
+    def _setup(self, tx=None):
+        N, H, W, cls = 1, 4, 4, 2
+        anchors = [10, 13, 16, 30, 33, 23]
+        mask = [0, 1]
+        rng = np.random.RandomState(1)
+        x = (rng.randn(N, len(mask) * (5 + cls), H, W) * 0.1).astype(
+            np.float32
+        )
+        gt_box = np.array([[[0.4, 0.4, 10 / 32, 13 / 32]]], np.float32)
+        gt_label = np.array([[0]], np.int64)
+        return x, gt_box, gt_label, anchors, mask, cls
+
+    def test_loss_shape_and_positive(self):
+        x, gtb, gtl, anchors, mask, cls = self._setup()
+        loss = yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gtb),
+            paddle.to_tensor(gtl), anchors, mask, cls,
+            ignore_thresh=0.7, downsample_ratio=8,
+        )
+        assert loss.shape == [1]
+        assert float(loss.numpy()[0]) > 0
+
+    def test_training_reduces_loss(self):
+        """The loss must be minimizable by gradient descent on x."""
+        x, gtb, gtl, anchors, mask, cls = self._setup()
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        first = None
+        for _ in range(60):
+            loss = yolo_loss(
+                xt, paddle.to_tensor(gtb), paddle.to_tensor(gtl),
+                anchors, mask, cls, ignore_thresh=0.7,
+                downsample_ratio=8, use_label_smooth=False,
+            ).sum()
+            loss.backward()
+            if first is None:
+                first = float(loss.numpy())
+            with paddle.no_grad() if hasattr(paddle, "no_grad") else \
+                    __import__("contextlib").nullcontext():
+                xt._data = xt._data - 0.5 * xt.grad._data
+                xt.grad = None
+                xt._node = None
+        assert float(loss.numpy()) < first * 0.3, (first,
+                                                   float(loss.numpy()))
+
+    def test_empty_gt_only_objness(self):
+        """All-invalid gt: loss is pure negative-objectness."""
+        x, _, _, anchors, mask, cls = self._setup()
+        gtb = np.zeros((1, 2, 4), np.float32)
+        gtl = np.zeros((1, 2), np.int64)
+        loss = yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gtb),
+            paddle.to_tensor(gtl), anchors, mask, cls,
+            ignore_thresh=0.7, downsample_ratio=8,
+        )
+        xa = x.reshape(1, 2, 7, 4, 4)
+        obj = xa[:, :, 4]
+        expect = (np.maximum(obj, 0) - 0 + np.log1p(np.exp(-np.abs(obj)))
+                  ).sum()
+        np.testing.assert_allclose(float(loss.numpy()[0]), expect, rtol=1e-4)
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_plain_conv(self):
+        rng = np.random.RandomState(0)
+        N, Cin, H, W, Cout, k = 2, 3, 6, 6, 4, 3
+        x = rng.rand(N, Cin, H, W).astype(np.float32)
+        w = rng.rand(Cout, Cin, k, k).astype(np.float32)
+        b = rng.rand(Cout).astype(np.float32)
+        Ho = Wo = H - k + 1
+        off = np.zeros((N, 2 * k * k, Ho, Wo), np.float32)
+        got = deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off),
+            paddle.to_tensor(w), paddle.to_tensor(b),
+        ).numpy()
+        conv = nn.Conv2D(Cin, Cout, k)
+        conv.weight.set_value(w)
+        conv.bias.set_value(b)
+        ref = conv(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_integer_shift_offset(self):
+        """Offset (+1, +1) on every tap == sampling the shifted image."""
+        rng = np.random.RandomState(1)
+        x = rng.rand(1, 1, 6, 6).astype(np.float32)
+        w = rng.rand(1, 1, 3, 3).astype(np.float32)
+        Ho = Wo = 4
+        off = np.zeros((1, 2 * 9, Ho, Wo), np.float32)
+        off[:, 0::2] = 1.0  # h-offset channels
+        off[:, 1::2] = 1.0  # w-offset channels
+        got = deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off),
+            paddle.to_tensor(w),
+        ).numpy()
+        # equivalent: plain conv on x shifted by one (valid region)
+        ref_full = deform_conv2d(
+            paddle.to_tensor(x[:, :, 1:, 1:]),
+            paddle.to_tensor(np.zeros((1, 18, 3, 3), np.float32)),
+            paddle.to_tensor(w),
+        ).numpy()
+        np.testing.assert_allclose(got[:, :, :3, :3], ref_full,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_modulated_mask_and_layer(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(2, 4, 5, 5).astype(np.float32)
+        layer = DeformConv2D(4, 6, 3, padding=1, deformable_groups=2)
+        Ho = Wo = 5
+        off = (rng.rand(2, 2 * 2 * 9, Ho, Wo).astype(np.float32) - 0.5)
+        m = rng.rand(2, 2 * 9, Ho, Wo).astype(np.float32)
+        out = layer(paddle.to_tensor(x), paddle.to_tensor(off),
+                    mask=paddle.to_tensor(m))
+        assert out.shape == [2, 6, 5, 5]
+        # mask of zeros kills everything except bias
+        out0 = layer(paddle.to_tensor(x), paddle.to_tensor(off),
+                     mask=paddle.to_tensor(np.zeros_like(m)))
+        np.testing.assert_allclose(
+            out0.numpy(),
+            np.broadcast_to(
+                np.asarray(layer.bias._data)[None, :, None, None],
+                out0.numpy().shape,
+            ),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_gradients_flow(self):
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.rand(1, 2, 5, 5).astype(np.float32))
+        off = paddle.to_tensor(
+            (rng.rand(1, 18, 3, 3).astype(np.float32) - 0.5)
+        )
+        w = paddle.to_tensor(rng.rand(3, 2, 3, 3).astype(np.float32))
+        for t in (x, off, w):
+            t.stop_gradient = False
+        out = deform_conv2d(x, off, w)
+        out.sum().backward()
+        assert x.grad is not None and np.any(x.grad.numpy() != 0)
+        assert off.grad is not None and np.any(off.grad.numpy() != 0)
+        assert w.grad is not None and np.any(w.grad.numpy() != 0)
