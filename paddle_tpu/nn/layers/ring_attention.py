@@ -29,7 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...core.tensor import Tensor
 from ...distributed import comm
 
-__all__ = ["blockwise_attention", "ring_attention", "ring_attention_raw"]
+__all__ = ["blockwise_attention", "ring_attention", "ring_attention_raw",
+           "ulysses_attention"]
 
 _NEG = -1e30
 
@@ -244,3 +245,69 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
         x if isinstance(x, Tensor) else Tensor(x) for x in (q, k, v)
     )
     return AG.apply(f, ts, name="ring_attention")
+
+
+def _ulysses_raw(q, k, v, *, axis_name, causal, scale):
+    """Per-device body: all-to-all head-scatter/seq-gather, local exact
+    attention over the FULL sequence for H/sp heads, inverse all-to-all.
+    (SURVEY.md §5: the Ulysses-style alternative to the ppermute ring —
+    two all-to-alls instead of sp_size rotations; best when H >= sp and
+    the interconnect favors all-to-all.)"""
+    # local [B, Hl=H, Sl=S/sp, D] -> [B, H/sp, S, D]
+    q = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    out = _blockwise_raw(q, k, v, causal=causal, block_size=512,
+                         scale=scale)
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
+                      causal=False, scale=None):
+    """Sequence-parallel attention via head redistribution: q/k/v are
+    GLOBAL [B, H, S, D] with S sharded over `sp_axis`; heads must divide
+    by the sp size."""
+    from ...core import autograd as AG
+
+    mesh = mesh if mesh is not None else comm.hybrid_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "ulysses_attention needs a mesh with an 'sp' axis: fleet.init "
+            "with hybrid_configs sp_degree, or pass mesh="
+        )
+    sp = mesh.shape[sp_axis]
+    H, S = q.shape[1], q.shape[2]
+    if H % sp != 0:
+        raise ValueError(
+            f"ulysses_attention: num_heads {H} must be divisible by the "
+            f"'{sp_axis}' axis size {sp} (use ring attention otherwise)"
+        )
+    if S % sp != 0:
+        raise ValueError(
+            f"ulysses_attention: sequence length {S} must be divisible "
+            f"by the '{sp_axis}' axis size {sp}"
+        )
+    spec = P(None, None, sp_axis, None)
+
+    def f(qr, kr, vr):
+        qr, kr, vr = (
+            jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+            for x in (qr, kr, vr)
+        )
+        body = comm.shard_map(
+            partial(_ulysses_raw, axis_name=sp_axis, causal=causal,
+                    scale=scale),
+            mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return body(qr, kr, vr)
+
+    ts = tuple(
+        x if isinstance(x, Tensor) else Tensor(x) for x in (q, k, v)
+    )
+    return AG.apply(f, ts, name="ulysses_attention")

@@ -53,7 +53,7 @@ class MultiHeadAttention(Layer):
         # (sequence-parallel over the hybrid mesh's sp axis — the
         # long-context path the reference lacks, SURVEY.md §5)
         super().__init__()
-        if attn_impl not in ("dense", "blockwise", "ring"):
+        if attn_impl not in ("dense", "blockwise", "ring", "ulysses"):
             raise ValueError(f"unknown attn_impl {attn_impl!r}")
         self.attn_impl = attn_impl
         self.causal = causal
@@ -131,13 +131,17 @@ class MultiHeadAttention(Layer):
                     "the blockwise/ring paths do not implement yet; use "
                     "the dense impl for decoding"
                 )
-            from .ring_attention import blockwise_attention, ring_attention
+            from .ring_attention import (
+                blockwise_attention, ring_attention, ulysses_attention,
+            )
 
             if self.attn_impl == "blockwise":
                 out = blockwise_attention(
                     q, k, v, causal=self.causal,
                     block_size=self.block_size,
                 )
+            elif self.attn_impl == "ulysses":
+                out = ulysses_attention(q, k, v, causal=self.causal)
             else:
                 out = ring_attention(q, k, v, causal=self.causal)
             weights = None

@@ -34,6 +34,9 @@ class ExponentialMovingAverage:
         self._ema: Dict[int, jnp.ndarray] = {}
         self._backup: Dict[int, jnp.ndarray] = {}
         self._t = 0
+        # product of EFFECTIVE decays: the bias-correction divisor is
+        # 1 - prod(d_i), which equals 1 - decay^t only without scheduling
+        self._decay_prod = 1.0
 
     def _bind(self, parameters):
         if parameters is not None:
@@ -48,6 +51,7 @@ class ExponentialMovingAverage:
         d = self._decay
         if self._thres:
             d = min(d, (1.0 + self._t) / (10.0 + self._t))
+        self._decay_prod *= d
         for p in self._params:
             prev = self._ema.get(id(p))
             cur = p._data.astype(jnp.float32)
@@ -60,7 +64,7 @@ class ExponentialMovingAverage:
         """Swap EMA weights in (bias-corrected); context-manager friendly."""
         if self._t == 0:
             raise RuntimeError("EMA.apply() before any update()")
-        corr = 1.0 - self._decay ** self._t
+        corr = 1.0 - self._decay_prod
         for p in self._params:
             self._backup[id(p)] = p._data
             p._data = (self._ema[id(p)] / corr).astype(p._data.dtype)
@@ -102,12 +106,14 @@ class LookaheadOptimizer:
         return [p for p in self.inner_optimizer._get_params() if p.trainable]
 
     def step(self):
+        # slow params anchor at the INITIAL weights (optimizer.py:5230
+        # initializes slow_param = param before training starts)
+        for p in self._params():
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._data
         self.inner_optimizer.step()
         self._calls += 1
         params = self._params()
-        for p in params:
-            if id(p) not in self._slow:
-                self._slow[id(p)] = p._data
         if self._calls % self.k == 0:
             a = self.alpha
             for p in params:

@@ -169,14 +169,13 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         sel = xr[bidx, mask_idx, :, gj, gi]             # [N,B,5+cls]
         tx = gtb[..., 0] * W - gi
         ty = gtb[..., 1] * H - gj
-        anc = anchors_full[np.asarray(mask)]            # static gather
         tw = jnp.log(jnp.maximum(
             gtb[..., 2] * in_size
-            / jnp.asarray(anc[:, 0])[mask_idx], 1e-9
+            / jnp.asarray(anchors_m[:, 0])[mask_idx], 1e-9
         ))
         th = jnp.log(jnp.maximum(
             gtb[..., 3] * in_size
-            / jnp.asarray(anc[:, 1])[mask_idx], 1e-9
+            / jnp.asarray(anchors_m[:, 1])[mask_idx], 1e-9
         ))
         loc_scale = (2.0 - gtb[..., 2] * gtb[..., 3]) * score
         loc = (
@@ -191,10 +190,19 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         per_gt = jnp.where(is_pos, loc + cls, 0.0)
         loss = per_gt.sum(axis=1)                       # [N]
 
-        # objectness targets: scatter positive scores; ignore -> -1
+        # objectness targets: scatter positive scores; ignore -> -1.
+        # Only POSITIVE rows write (zero-padded gt rows all map to cell
+        # (0,0) and must not clobber a real positive there); duplicate
+        # real positives average deterministically.
         obj = jnp.where(ignore, -1.0, 0.0)              # [N,m,H,W]
-        obj = obj.at[bidx, mask_idx, gj, gi].set(
-            jnp.where(is_pos, score, obj[bidx, mask_idx, gj, gi])
+        pos_sum = jnp.zeros_like(obj).at[bidx, mask_idx, gj, gi].add(
+            jnp.where(is_pos, score, 0.0)
+        )
+        pos_cnt = jnp.zeros_like(obj).at[bidx, mask_idx, gj, gi].add(
+            jnp.where(is_pos, 1.0, 0.0)
+        )
+        obj = jnp.where(
+            pos_cnt > 0, pos_sum / jnp.maximum(pos_cnt, 1.0), obj
         )
         obj_pred = xr[:, :, 4]
         obj_loss = jnp.where(

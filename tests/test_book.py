@@ -1,0 +1,114 @@
+"""Book-style end-to-end mini-trainings (reference: python/paddle/fluid/
+tests/book/ — fit_a_line, word2vec, recognize_digits; recognize_digits
+is covered by test_e2e_lenet + test_static)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader
+from paddle_tpu.text.datasets import Imikolov, UCIHousing
+
+
+def _write_housing(tmp_path, n=64):
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, 13)
+    w = rng.rand(13)
+    y = X @ w + 0.1
+    rows = np.concatenate([X, y[:, None]], axis=1)
+    f = tmp_path / "housing.data"
+    with open(f, "w") as fh:
+        for r in rows:
+            fh.write(" ".join(f"{v:.6f}" for v in r) + "\n")
+    return str(f)
+
+
+def test_fit_a_line(tmp_path):
+    """book/test_fit_a_line: linear regression on UCIHousing through the
+    static Program/Executor path."""
+    data_file = _write_housing(tmp_path)
+    train = UCIHousing(data_file=data_file, mode="train")
+
+    paddle.enable_static()
+    from paddle_tpu.static import program as prog_mod
+
+    main, startup = prog_mod.Program(), prog_mod.Program()
+    try:
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data(name="x", shape=[-1, 13],
+                                   dtype="float32")
+            y = paddle.static.data(name="y", shape=[-1, 1],
+                                   dtype="float32")
+            pred = nn.Linear(13, 1)(x)
+            loss = ((pred - y) * (pred - y)).mean()
+            optimizer.SGD(learning_rate=0.05).minimize(loss)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            loader = DataLoader(train, batch_size=16, drop_last=True)
+            losses = []
+            for _ in range(8):
+                for feat, target in loader:
+                    (lv,) = exe.run(
+                        main,
+                        feed={"x": feat.numpy(), "y": target.numpy()},
+                        fetch_list=[loss],
+                    )
+                    losses.append(float(lv))
+    finally:
+        paddle.disable_static()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_word2vec(tmp_path):
+    """book/test_word2vec: NGRAM language model over Imikolov (PTB
+    format) — embedding concat + hidden + softmax, eager training."""
+    import tarfile, io
+
+    corpus = (b"the quick brown fox jumps over the lazy dog\n" * 8
+              + b"the dog sleeps\n" * 8)
+    f = tmp_path / "simple-examples.tar.gz"
+    with tarfile.open(f, "w:gz") as tf:
+        for name in ("./simple-examples/data/ptb.train.txt",
+                     "./simple-examples/data/ptb.valid.txt"):
+            info = tarfile.TarInfo(name)
+            info.size = len(corpus)
+            tf.addfile(info, io.BytesIO(corpus))
+    ds = Imikolov(data_file=str(f), data_type="NGRAM", window_size=4,
+                  mode="train", min_word_freq=1)
+    vocab = len(ds.word_idx)
+    emb_dim = 16
+
+    class Word2Vec(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, emb_dim)
+            self.fc = nn.Linear(emb_dim * 3, vocab)
+
+        def forward(self, ctx):
+            e = self.emb(ctx)            # [B, 3, emb]
+            flat = paddle.reshape(e, [e.shape[0], emb_dim * 3])
+            return self.fc(flat)
+
+    model = Word2Vec()
+    opt = optimizer.Adam(learning_rate=2e-2,
+                         parameters=model.parameters())
+
+    def collate(batch):
+        arr = np.stack([np.concatenate(s).astype(np.int64)
+                        for s in batch])
+        return arr[:, :3], arr[:, 3]
+
+    loader = DataLoader(ds, batch_size=32, shuffle=True,
+                        collate_fn=collate, drop_last=True)
+    epoch_means = []
+    for _ in range(15):
+        ep = []
+        for ctx, target in loader:
+            logits = model(ctx)
+            loss = F.cross_entropy(logits, target)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ep.append(float(loss.numpy()))
+        epoch_means.append(float(np.mean(ep)))
+    assert epoch_means[-1] < epoch_means[0] * 0.5, epoch_means

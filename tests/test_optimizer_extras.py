@@ -45,9 +45,9 @@ class TestEMA:
         ema.update()  # effective decay = min(.999, 2/11)
         with ema.apply():
             got = float(p.weight.numpy()[0, 0])
-        d = 2.0 / 11.0
-        np.testing.assert_allclose(got, (10 * (1 - d)) / (1 - 0.999),
-                                   rtol=1e-5)
+        # bias correction must use the EFFECTIVE decay product:
+        # ema = (1-d)*10, corr = 1-d  ->  applied == 10 exactly
+        np.testing.assert_allclose(got, 10.0, rtol=1e-5)
 
 
 class TestLookahead:

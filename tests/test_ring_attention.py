@@ -133,3 +133,46 @@ def test_ring_rejects_mask_and_dropout(sp_mesh):
     cache = mha_c.gen_cache(x)
     with pytest.raises(NotImplementedError, match="Cache"):
         mha_c(x, cache=cache)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense_on_8dev_mesh(causal, sp_mesh):
+    from paddle_tpu.nn.layers.ring_attention import ulysses_attention
+
+    # H=8 so heads divide the sp=8 axis
+    r = np.random.RandomState(5)
+    q, k, v = [
+        r.rand(2, 8, S, D).astype(np.float32) - 0.5 for _ in range(3)
+    ]
+    got = ulysses_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        causal=causal,
+    ).numpy()
+
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        pos = np.arange(S)
+        s = np.where(pos[None, :] > pos[:, None], -1e30, s)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", w, v)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_head_divisibility_raises(sp_mesh):
+    from paddle_tpu.nn.layers.ring_attention import ulysses_attention
+
+    q = paddle.to_tensor(np.random.rand(2, 6, S, D).astype(np.float32))
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, q, q)
+
+
+def test_mha_ulysses_matches_dense_mha(sp_mesh):
+    paddle.seed(13)
+    dense_mha = nn.MultiHeadAttention(32, 8, dropout=0.0)
+    uly = nn.MultiHeadAttention(32, 8, dropout=0.0, attn_impl="ulysses")
+    uly.set_state_dict(dense_mha.state_dict())
+    x = paddle.to_tensor(np.random.rand(2, S, 32).astype(np.float32))
+    np.testing.assert_allclose(
+        uly(x).numpy(), dense_mha(x).numpy(), rtol=2e-4, atol=2e-5
+    )
