@@ -15,8 +15,13 @@ Why rounds 1–3 read ~660–724 imgs/sec (~354 ms/step): the old bench
 updated params with an EAGER `tree_map(p - lr*g)` outside jit — 8 separate
 device-program launches per step, each paying the tunnel's host->device
 round-trip latency, serialized against the grad program. TrainStep issues
-ONE async program per step with donated buffers, so steps pipeline and the
-tunnel latency amortizes away.
+ONE async program per step with donated buffers, so steps pipeline.
+
+Measurement note (axon tunnel): `jax.block_until_ready` is a NO-OP on
+this platform — only a device_get truly waits. Every timed loop here
+ends with `np.asarray(...)` of a scalar/slice as the barrier; identical
+(executable, args) repeats can be served from a runtime cache, so timed
+calls never reuse the warmup arguments.
 
 vs_baseline: BASELINE.json publishes no reference numbers (BASELINE.md), so
 the recorded value IS the baseline (1.0); extra.vs_r02 carries the ratio
@@ -63,11 +68,11 @@ def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label,
         jnp.asarray(np.random.rand(batch, *x_shape).astype(np.float32))
     )
     y = jax.device_put(jnp.asarray((np.arange(batch) % y_classes).astype(np.int32)))
-    jax.block_until_ready(x)
+    _ = np.asarray(x.ravel()[:1])  # devget barrier: upload must finish here
 
     t0 = time.perf_counter()
     loss = step(x, y)  # compile + first step
-    jax.block_until_ready(loss._data)
+    _ = np.asarray(loss._data)  # devget barrier (block_until_ready no-ops)
     compile_s = time.perf_counter() - t0
 
     # steady state: async dispatch, one block at the end -> steps pipeline
@@ -83,20 +88,14 @@ def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label,
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(x, y)
-    jax.block_until_ready(loss._data)
+    _ = np.asarray(loss._data)  # waits for the whole queued sequence
     dt = time.perf_counter() - t0
     if trace_dir:
         prof.stop_profiler()
 
-    # one blocked step isolates device time from host dispatch overhead
-    t0 = time.perf_counter()
-    jax.block_until_ready(step(x, y)._data)
-    blocked_ms = (time.perf_counter() - t0) * 1e3
-
     step_ms = dt / steps * 1e3
     return steps * batch / dt, {
         f"{label}_step_ms": round(step_ms, 2),
-        f"{label}_blocked_step_ms": round(blocked_ms, 2),
         f"{label}_compile_s": round(compile_s, 1),
     }
 
@@ -159,16 +158,16 @@ def _bench_bert(steps=10, batch=32, seq=128):
         .astype(np.int32)
     ))
     y = jax.device_put(jnp.asarray((np.arange(batch) % 2).astype(np.int32)))
-    jax.block_until_ready(ids)
+    _ = np.asarray(ids.ravel()[:1])
 
     t0 = time.perf_counter()
     loss = step(ids, y)
-    jax.block_until_ready(loss._data)
+    _ = np.asarray(loss._data)
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(ids, y)
-    jax.block_until_ready(loss._data)
+    _ = np.asarray(loss._data)
     dt = time.perf_counter() - t0
     return steps * batch / dt, {
         "bert_base_bf16_step_ms": round(dt / steps * 1e3, 2),
@@ -176,9 +175,13 @@ def _bench_bert(steps=10, batch=32, seq=128):
     }
 
 
-def _bench_flash_attention(steps=30):
+def _bench_flash_attention(steps=100):
     """Long-context attention: the Pallas flash kernel vs XLA dense at
-    S=2048 causal (ops/pallas/flash_attention.py)."""
+    S=2048 causal. The `steps` iterations run INSIDE one jitted lax.scan
+    (each output chained into the next query), so a single dispatch
+    measures device time — per-call dispatch over the tunneled chip is
+    ~100ms RTT and identical-args repeats can be served from a cache,
+    both of which corrupt host-side loops."""
     import jax
     import jax.numpy as jnp
 
@@ -199,23 +202,38 @@ def _bench_flash_attention(steps=30):
         s = jnp.where(pos[None, :] > pos[:, None], -1e30, s)
         return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
 
-    flash = jax.jit(
+    def looped(attn):
+        @jax.jit
+        def run(q, k, v):
+            def body(qq, _):
+                return attn(qq, k, v), None
+
+            out, _ = jax.lax.scan(body, q, None, length=steps)
+            return out
+
+        return run
+
+    flash_l = looped(
         lambda q, k, v: flash_attention(q, k, v, True, 256, 256, None,
                                         False)
     )
-    dense_j = jax.jit(dense)
+    dense_l = looped(dense)
+
+    # the tunnel runtime serves identical (executable, args) repeats
+    # from a cache: compile/warm on one input set, time on another; the
+    # barrier is a tiny devget slice (block_until_ready no-ops on axon)
+    q2 = jax.device_put(q + 1.0)
+    _ = np.asarray(q2[0, 0, 0, :2])
 
     def ms(f):
-        jax.block_until_ready(f(q, k, v))
+        _ = np.asarray(f(q2, k, v)[0, 0, 0, :2])  # compile + real sync
         t0 = time.perf_counter()
-        for _ in range(steps):
-            o = f(q, k, v)
-        jax.block_until_ready(o)
+        _ = np.asarray(f(q, k, v)[0, 0, 0, :2])
         return (time.perf_counter() - t0) / steps * 1e3
 
     return {
-        "flash_attn_s2048_pallas_ms": round(ms(flash), 2),
-        "flash_attn_s2048_dense_ms": round(ms(dense_j), 2),
+        "flash_attn_s2048_pallas_ms": round(ms(flash_l), 2),
+        "flash_attn_s2048_dense_ms": round(ms(dense_l), 2),
     }
 
 
@@ -265,9 +283,11 @@ def main():
     extra["vs_r02"] = round(lenet_ips / 663.6, 1)
     extra["note"] = (
         "TrainStep hot path (fused fwd+bwd+opt, donated, device-staged "
-        "inputs); r1-r3's ~354ms LeNet step was the eager per-param "
-        "tree_map update: 8 device-program launches/step, each paying the "
-        "tunnel round-trip, serialized against the grad program"
+        "inputs; devget barriers — block_until_ready no-ops on the axon "
+        "tunnel); r1-r3's ~354ms LeNet step was the eager per-param "
+        "tree_map update paying a tunnel round-trip per dispatch; "
+        "LeNet's ~10-17ms step is tunnel per-program overhead-bound "
+        "(jitter with tunnel load; ResNet/BERT are compute-bound)"
     )
 
     print(

@@ -247,7 +247,7 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
     return AG.apply(f, ts, name="ring_attention")
 
 
-def _ulysses_raw(q, k, v, *, axis_name, causal, scale):
+def _ulysses_raw(q, k, v, *, axis_name, causal, scale, block_size=512):
     """Per-device body: all-to-all head-scatter/seq-gather, local exact
     attention over the FULL sequence for H/sp heads, inverse all-to-all.
     (SURVEY.md §5: the Ulysses-style alternative to the ppermute ring —
@@ -260,14 +260,14 @@ def _ulysses_raw(q, k, v, *, axis_name, causal, scale):
                            tiled=True)
     v = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                            tiled=True)
-    out = _blockwise_raw(q, k, v, causal=causal, block_size=512,
+    out = _blockwise_raw(q, k, v, causal=causal, block_size=block_size,
                          scale=scale)
     return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
 
 def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
-                      causal=False, scale=None):
+                      causal=False, scale=None, block_size=512):
     """Sequence-parallel attention via head redistribution: q/k/v are
     GLOBAL [B, H, S, D] with S sharded over `sp_axis`; heads must divide
     by the sp size."""
@@ -300,7 +300,7 @@ def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
         )
         body = comm.shard_map(
             partial(_ulysses_raw, axis_name=sp_axis, causal=causal,
-                    scale=scale),
+                    scale=scale, block_size=block_size),
             mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,

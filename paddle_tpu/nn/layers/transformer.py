@@ -141,7 +141,8 @@ class MultiHeadAttention(Layer):
                     block_size=self.block_size,
                 )
             elif self.attn_impl == "ulysses":
-                out = ulysses_attention(q, k, v, causal=self.causal)
+                out = ulysses_attention(q, k, v, causal=self.causal,
+                                        block_size=self.block_size)
             else:
                 out = ring_attention(q, k, v, causal=self.causal)
             weights = None

@@ -38,9 +38,14 @@ from .program import (  # noqa: E402,F401
     default_startup_program,
     program_guard,
 )
-from .executor import Executor, global_scope  # noqa: E402,F401
+from .executor import (  # noqa: E402,F401
+    CompiledProgram,
+    Executor,
+    global_scope,
+)
 
 __all__ = [
+    "CompiledProgram",
     "Program", "Variable", "data", "default_main_program",
     "default_startup_program", "program_guard", "Executor", "global_scope",
 ]

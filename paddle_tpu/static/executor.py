@@ -22,7 +22,28 @@ import numpy as np
 from ..core.tensor import Parameter, Tensor
 from .program import Program, Variable, default_main_program
 
-__all__ = ["Executor", "global_scope"]
+__all__ = ["CompiledProgram", "Executor", "global_scope"]
+
+
+class CompiledProgram:
+    """fluid/compiler.py:88 CompiledProgram.with_data_parallel analog.
+
+    Wrapping a Program marks it for SPMD data parallelism: Executor.run
+    feeds shard over the default mesh's dp axis and parameters replicate,
+    so XLA partitions the one compiled program across devices and inserts
+    the gradient all-reduce (the multi_devices_graph_pass +
+    ParallelExecutor pipeline collapsed into sharding propagation)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self._data_parallel = False
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self._data_parallel = True
+        self._loss_name = loss_name
+        return self
 
 
 class _Scope:
@@ -140,7 +161,16 @@ class Executor:
     # -- run -----------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list=None, return_numpy=True):
-        """executor.py:916. Returns fetched values in fetch_list order."""
+        """executor.py:916. Returns fetched values in fetch_list order.
+        A CompiledProgram.with_data_parallel shards feeds over the dp
+        mesh axis (ParallelExecutor path, executor.py:1112)."""
+        dp_mesh = None
+        if isinstance(program, CompiledProgram):
+            if program._data_parallel:
+                from ..distributed import comm
+
+                dp_mesh = comm._default_group().mesh
+            program = program.program
         program = program if program is not None else default_main_program()
         feed = dict(feed or {})
         fetch_list = list(fetch_list or [])
@@ -163,6 +193,18 @@ class Executor:
             f._data if isinstance(f, Tensor) else jnp.asarray(feed[n])
             for n, f in ((n, feed[n]) for n in feed_names)
         )
+        if dp_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axis = dp_mesh.axis_names[0]
+            feed_raws = tuple(
+                jax.device_put(
+                    r, NamedSharding(dp_mesh, PartitionSpec(axis))
+                )
+                if r.ndim > 0 and r.shape[0] % dp_mesh.devices.size == 0
+                else r
+                for r in feed_raws
+            )
         sig = tuple(
             (n, tuple(r.shape), str(r.dtype))
             for n, r in zip(feed_names, feed_raws)
