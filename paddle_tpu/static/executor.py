@@ -194,15 +194,19 @@ class Executor:
             for n, f in ((n, feed[n]) for n in feed_names)
         )
         if dp_mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
+            from ..distributed import comm as _comm
 
-            axis = dp_mesh.axis_names[0]
+            n_dev = dp_mesh.devices.size
+            for name, r in zip(feed_names, feed_raws):
+                if r.ndim > 0 and r.shape[0] % n_dev != 0:
+                    raise ValueError(
+                        f"CompiledProgram.with_data_parallel: feed "
+                        f"'{name}' batch {r.shape[0]} is not divisible "
+                        f"by the {n_dev} devices (ParallelExecutor "
+                        "raises here too; pad or drop the tail batch)"
+                    )
             feed_raws = tuple(
-                jax.device_put(
-                    r, NamedSharding(dp_mesh, PartitionSpec(axis))
-                )
-                if r.ndim > 0 and r.shape[0] % dp_mesh.devices.size == 0
-                else r
+                _comm.shard_rank_axis(r) if r.ndim > 0 else r
                 for r in feed_raws
             )
         sig = tuple(

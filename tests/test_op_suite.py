@@ -570,3 +570,70 @@ def test_misc_new_math_ops():
         rtol=1e-3)
     check_grad(P.ldexp, [A(2, 3), np.full((2, 3), 2.0, np.float32)],
                wrt=[0])
+
+
+# ---------------------------------------------------------------------------
+# nn.functional activations: numeric-grad coverage (op_test.py model)
+# ---------------------------------------------------------------------------
+ACTIVATIONS = [
+    ("relu", off_int(2, 3)),
+    ("relu6", off_int(2, 3)),
+    ("elu", off_int(2, 3)),
+    ("celu", off_int(2, 3)),
+    ("selu", off_int(2, 3)),
+    ("gelu", A(2, 3)),
+    ("silu", A(2, 3)),
+    ("swish", A(2, 3)),
+    ("mish", A(2, 3)),
+    ("softplus", A(2, 3)),
+    ("softsign", A(2, 3)),
+    ("tanhshrink", A(2, 3)),
+    ("log_sigmoid", A(2, 3)),
+    ("leaky_relu", off_int(2, 3)),
+    ("hardtanh", A(2, 3, lo=-0.8, hi=0.8)),
+    ("hardswish", A(2, 3, lo=0.5, hi=2.5)),
+    ("hardsigmoid", A(2, 3, lo=-2.5, hi=-0.5)),
+    ("hardshrink", A(2, 3, lo=1.0, hi=2.0)),
+    ("softshrink", A(2, 3, lo=1.0, hi=2.0)),
+    ("thresholded_relu", A(2, 3, lo=1.5, hi=3.0)),
+]
+
+
+@pytest.mark.parametrize("name,x", ACTIVATIONS,
+                         ids=[a[0] for a in ACTIVATIONS])
+def test_activation_grads(name, x):
+    import paddle_tpu.nn.functional as F
+
+    check_grad(getattr(F, name), [x])
+
+
+def test_softmax_family_grads():
+    import paddle_tpu.nn.functional as F
+
+    x = A(3, 4)
+    check_grad(F.softmax, [x], kwargs={"axis": -1})
+    check_grad(F.log_softmax, [x], kwargs={"axis": -1})
+    check_output(
+        F.softmax,
+        lambda a, axis: np.exp(a) / np.exp(a).sum(axis, keepdims=True),
+        [x], kwargs={"axis": -1}, rtol=1e-5,
+    )
+
+
+def test_loss_functional_grads():
+    import paddle_tpu.nn.functional as F
+
+    pred = A(4, 3, lo=0.2, hi=0.8)
+    tgt = A(4, 3, lo=0.2, hi=0.8)
+    check_grad(F.mse_loss, [pred, tgt], wrt=[0])
+    check_grad(F.l1_loss, [pred + 2.0, tgt], wrt=[0])
+    check_grad(F.smooth_l1_loss, [pred, tgt], wrt=[0])
+    check_grad(F.kl_div, [np.log(pred), tgt], wrt=[0])
+    logits = A(4, 3)
+    labels = (np.arange(4) % 3).astype(np.int64)
+    check_grad(F.cross_entropy, [logits, labels], wrt=[0])
+    check_grad(
+        F.binary_cross_entropy_with_logits,
+        [A(4, 1), (np.arange(4) % 2).reshape(4, 1).astype(np.float32)],
+        wrt=[0],
+    )
