@@ -1,4 +1,5 @@
 """paddle_tpu.core — runtime core (L1–L3 analog, SURVEY.md §7 stage 1)."""
+from . import compile_cache  # noqa: F401  (must win the race with first jit)
 from . import autograd, device, dtype, flags, random  # noqa: F401
 from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .device import (  # noqa: F401

@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache.
+
+The reference caches prepared programs per-process
+(python/paddle/fluid/executor.py:1253 `_ExecutorCache`); on TPU the
+expensive artifact is the XLA executable itself (20-60s cold compiles over
+a tunneled chip), so the TPU-native analog is jax's *persistent* compilation
+cache: compiled executables keyed by (HLO, compile options, backend) survive
+process restarts, making warm-process compile time a disk read.
+
+Enabled by default at ``~/.cache/paddle_tpu/xla_cache``. Controlled by
+``PADDLE_TPU_COMPILE_CACHE``:
+  - unset            -> default path above
+  - a path           -> that directory
+  - "0"/"off"/""     -> disabled
+"""
+from __future__ import annotations
+
+import os
+
+_DISABLE = {"0", "off", "false", "no"}
+
+
+def _setup() -> str | None:
+    raw = os.environ.get("PADDLE_TPU_COMPILE_CACHE")
+    if raw is not None and raw.strip().lower() in _DISABLE | {""}:
+        return None
+    path = raw or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache"
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache even fast compiles: over the axon tunnel every dispatch of a
+        # fresh executable pays RTT, and small programs (optimizer updates,
+        # unscale, metric reductions) recompile per process otherwise
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # cache is an optimization; never block import
+        return None
+    return path
+
+
+cache_dir = _setup()
