@@ -31,8 +31,12 @@ __all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate"]
 WHITE_LIST = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum",
               "bmm", "mm", "mv", "attention_scores", "attention_context"}
 BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "mean", "sum",
-              "layer_norm", "batch_norm", "exp", "log", "logsumexp",
+              "layer_norm", "exp", "log", "logsumexp",
               "softmax_with_cross_entropy"}
+# batch_norm is deliberately NOT black-listed: the functional keeps its
+# stat accumulation in f32 internally while applying in the input dtype,
+# so casting bf16 activations up before it would only double HBM traffic
+# (round-5 perf work, tools/PERF.md)
 
 
 class _AmpState(threading.local):

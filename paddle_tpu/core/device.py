@@ -137,6 +137,15 @@ def force_cpu_devices(n: int = 8):
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    try:
+        # the persistent compilation cache (core/compile_cache.py) exists
+        # for tens-of-seconds TPU compiles; XLA:CPU AOT cache entries embed
+        # target-tuning pseudo-features (+prefer-no-scatter/-gather) that
+        # the loader flags as machine mismatches with a SIGILL warning —
+        # not worth it for millisecond CPU compiles
+        jax.config.update("jax_enable_compilation_cache", False)
+    except Exception:
+        pass
     ndev = len(jax.devices())
     if ndev < n:
         raise RuntimeError(

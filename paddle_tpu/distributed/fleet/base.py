@@ -112,26 +112,32 @@ class _DistributedOptimizer:
     def _zero_constrain(self, x, force=False):
         """Shard a state leaf over dp on the FIRST dp-divisible axis.
 
-        Ownership policy vs the reference (sharding/shard.py assigns every
-        param an owner rank): XLA sharding constraints cannot reshape
-        storage, so leaves with no dp-divisible axis (e.g. a [10] bias on
-        dp=8) stay REPLICATED — documented deviation; their bytes are
-        O(small) by construction since weight matrices always carry a
-        divisible axis in practice. A flatten+pad global shard would
-        change the functional-state layout every optimizer rule consumes
-        and is deliberately not done."""
-        mesh = comm.hybrid_mesh()
+        Leaves with no dp-divisible axis (e.g. a [30522, 12] embedding on
+        dp=8) get an UNEVEN sharding constraint on their largest axis:
+        GSPMD pads the dimension internally to a shardable extent (the
+        pad-to-divisible of the reference's sharding/shard.py owner
+        assignment, done by the compiler instead of by reshaping the
+        state layout). Scalars and tiny leaves (< one tile) stay
+        replicated — distributing <1KiB costs more in collective latency
+        than it saves."""
+        mesh = getattr(self, "_constrain_mesh", None) or comm.hybrid_mesh()
         if mesh is None:
             return x
         dp = mesh.shape["dp"]
+
+        def constrain(axis):
+            spec = P(*(
+                [None] * axis + ["dp"] + [None] * (x.ndim - axis - 1)
+            ))
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec)
+            )
+
         for axis in range(x.ndim):
             if x.shape[axis] % dp == 0 and x.shape[axis] > 0:
-                spec = P(*(
-                    [None] * axis + ["dp"] + [None] * (x.ndim - axis - 1)
-                ))
-                return jax.lax.with_sharding_constraint(
-                    x, NamedSharding(mesh, spec)
-                )
+                return constrain(axis)
+        if x.ndim > 0 and x.size >= 1024:
+            return constrain(int(max(range(x.ndim), key=lambda a: x.shape[a])))
         return x
 
     @property
@@ -368,6 +374,11 @@ class Fleet:
                 model, mesh=mesh,
                 accumulate_steps=int(
                     self._strategy.pipeline_configs["accumulate_steps"]
+                ),
+                schedule_mode=str(
+                    self._strategy.pipeline_configs.get(
+                        "schedule_mode", "1F1B"
+                    )
                 ),
             )
         if mesh.shape["pp"] > 1:

@@ -111,6 +111,17 @@ class PipelineLayer(Layer):
                 for s in range(num_stages)]
 
 
+def _f_then_b_order(num_stages: int, num_micro: int):
+    """The F-then-B issue order (schedule_mode="F-then-B",
+    distributed_strategy.proto pipeline_configs): every microbatch's
+    forward completes before any backward — simpler, all M activations in
+    flight (higher memory than 1F1B, the reference's default for small M)."""
+    S, M = num_stages, num_micro
+    fwd = [("F", s, m) for m in range(M) for s in range(S)]
+    bwd = [("B", s, m) for m in range(M) for s in reversed(range(S))]
+    return fwd + bwd
+
+
 def _1f1b_order(num_stages: int, num_micro: int):
     """The 1F1B issue order: list of ("F"|"B", stage, microbatch).
 
@@ -231,8 +242,14 @@ class PipelineParallel(Layer):
 
     def __init__(self, layer: PipelineLayer, mesh: Optional[Mesh] = None,
                  num_stages: Optional[int] = None,
-                 accumulate_steps: int = 1):
+                 accumulate_steps: int = 1, schedule_mode: str = "1F1B"):
         super().__init__()
+        if schedule_mode not in ("1F1B", "F-then-B"):
+            raise NotImplementedError(
+                f"schedule_mode '{schedule_mode}': only '1F1B' and "
+                "'F-then-B' are built (interleaved/virtual stages are not)"
+            )
+        self.schedule_mode = schedule_mode
         self.pipeline = layer
         mesh = mesh if mesh is not None else comm.hybrid_mesh()
         if mesh is None:
@@ -331,9 +348,11 @@ class PipelineParallel(Layer):
             for s in range(S)
         ]
 
-        if (S, M) not in self._order_cache:
-            self._order_cache[(S, M)] = _1f1b_order(S, M)
-        order = self._order_cache[(S, M)]
+        mode = self.schedule_mode
+        if (S, M, mode) not in self._order_cache:
+            gen = _1f1b_order if mode == "1F1B" else _f_then_b_order
+            self._order_cache[(S, M, mode)] = gen(S, M)
+        order = self._order_cache[(S, M, mode)]
         stage_in: List[dict] = [dict() for _ in range(S)]   # (m) -> x
         saved: List[dict] = [dict() for _ in range(S)]      # (m) -> (p, b)
         gout: List[dict] = [dict() for _ in range(S)]       # (m) -> cotangent
@@ -373,32 +392,51 @@ class PipelineParallel(Layer):
                 )
 
         # -- optimizer: one update from microbatch-mean grads per stage ----
+        # Routed through the (possibly fleet-wrapped) optimizer's
+        # functional rule so sharding (ZeRO over each stage's dp axis) and
+        # gradient_merge (k_steps across train_batch calls, on top of the
+        # M-microbatch accumulation above) compose with pipeline — the
+        # reference's hybrid of sharding_optimizer.py:33 `hybrid_dp` with
+        # PipelineOptimizer. Each stage's update is ONE donated jitted
+        # program on its submesh (not per-param eager dispatches).
         opt = optimizer
-        strategy = getattr(opt, "user_defined_strategy", None)
-        if strategy is not None and (strategy.sharding
-                                     or strategy.gradient_merge):
-            # The wrapper's gm counter / ZeRO constraints assume ONE param
-            # list on the job-wide mesh; per-stage submesh updates need a
-            # per-stage composition that is not built yet. Refuse rather
-            # than silently dropping the configured strategy.
-            raise NotImplementedError(
-                "sharding/gradient_merge do not compose with pipeline yet; "
-                "microbatch accumulation (pipeline_configs.accumulate_steps)"
-                " already provides gradient accumulation"
-            )
+        is_wrapped = getattr(opt, "user_defined_strategy", None) is not None
         inner = getattr(opt, "_inner", opt)  # unwrap fleet decorator
         inner._step_count += 1
         lr = jnp.asarray(inner.get_lr(), jnp.float32)
         t = jnp.asarray(inner._step_count, jnp.float32)
         inv_m = 1.0 / M
+        fopt = opt if is_wrapped else inner
+        # snapshot every stage's state BEFORE any load: the wrapper's
+        # gradient-merge counter is global, and loading stage s would
+        # advance it under stage s+1's feet
+        states = [fopt._functional_state(st.p_objs) for st in self.stages]
+        if not hasattr(self, "_upd_jit"):
+            self._upd_jit = {}
+        results = []
         for s, st in enumerate(self.stages):
-            grads = [g * inv_m for g in gsum[s]]
-            p_raws = [p._data for p in st.p_objs]
-            state = inner._functional_state(st.p_objs)
-            new_p, new_state = inner._functional_update(
-                st.p_objs, p_raws, grads, state, lr, t
-            )
-            inner._load_functional_state(st.p_objs, new_state)
+            if s not in self._upd_jit:
+                def make(stage):
+                    def update(p_raws, grads, state, lr, t):
+                        grads = [g * inv_m for g in grads]
+                        return fopt._functional_update(
+                            stage.p_objs, p_raws, grads, state, lr, t
+                        )
+                    return jax.jit(update, donate_argnums=(0, 2))
+                self._upd_jit[s] = make(st)
+            if is_wrapped:
+                fopt._constrain_mesh = st.mesh  # trace-time ZeRO target
+            try:
+                new_p, new_state = self._upd_jit[s](
+                    [p._data for p in st.p_objs], list(gsum[s]),
+                    states[s], lr, t,
+                )
+            finally:
+                if is_wrapped:
+                    fopt._constrain_mesh = None
+            results.append((new_p, new_state))
+        for st, (new_p, new_state) in zip(self.stages, results):
+            fopt._load_functional_state(st.p_objs, new_state)
             for p, raw in zip(st.p_objs, new_p):
                 p._data = raw
                 p._node = None

@@ -6,6 +6,7 @@ mutating them inside the kernel (functional form — the Layer wrappers own the
 buffer update so the same code paths trace cleanly under jit)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...core import autograd as AG
@@ -43,18 +44,34 @@ def batch_norm(
     bshape[ch] = x._data.shape[ch]
 
     if use_batch_stats:
+        # TPU-first formulation (round-5 perf work, tools/PERF.md):
+        #  - stats accumulate in f32 but the normalization APPLIES in the
+        #    input dtype, so bf16 activations are never round-tripped
+        #    through f32 HBM writes (the reference's CUDA kernel does the
+        #    same internally: batch_norm_op.cu accumulates in float);
+        #  - one fused stat pass (mean, mean-of-squares) instead of
+        #    mean-then-var, and the apply is folded to out = x*scale+bias
+        #    with per-channel [C] vectors — 2 fusable elementwise ops whose
+        #    VJP reductions XLA fuses into a single variadic reduce.
         def f(a, *wb):
-            mean = jnp.mean(a, axis=axes)
-            var = jnp.var(a, axis=axes)
-            out = (a - mean.reshape(bshape)) / jnp.sqrt(
-                var.reshape(bshape) + epsilon
-            )
+            af = a.astype(jnp.float32) if a.dtype != jnp.float32 else a
+            mean = jnp.mean(af, axis=axes)
+            meansq = jnp.mean(jnp.square(af), axis=axes)
+            var = jnp.maximum(meansq - jnp.square(mean), 0.0)
+            r = jax.lax.rsqrt(var + epsilon)
             i = 0
             if weight is not None:
-                out = out * wb[i].reshape(bshape)
+                scale = wb[i].astype(jnp.float32) * r
                 i += 1
+            else:
+                scale = r
             if bias is not None:
-                out = out + wb[i].reshape(bshape)
+                shift = wb[i].astype(jnp.float32) - mean * scale
+            else:
+                shift = -mean * scale
+            out = a * scale.astype(a.dtype).reshape(bshape) + shift.astype(
+                a.dtype
+            ).reshape(bshape)
             return out, mean, var
 
         args = (x,) + tuple(p for p in (weight, bias) if p is not None)

@@ -1,0 +1,2 @@
+"""paddle_tpu.utils — developer tooling (op benchmarking, perf analysis)."""
+from . import op_bench  # noqa: F401

@@ -1,0 +1,310 @@
+"""ResNet-50 perf experiments (round-5 weak #1). Run one variant per process:
+    python tools/exp_resnet.py <variant> [batch]
+Variants: fw (framework bf16), purejax_nhwc, purejax_nchw.
+Prints one line: <variant> batch=<B> step_ms=<ms> imgs_s=<n>.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(step, args, steps=20, barrier=lambda out: None):
+    t0 = time.perf_counter()
+    out = step(*args)
+    barrier(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(*args)
+    barrier(out)
+    dt = time.perf_counter() - t0
+    return dt / steps * 1e3, compile_s
+
+
+def fw(batch):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    fleet.init(is_collective=True, strategy=strategy)
+    model = resnet50(num_classes=1000)
+    opt = fleet.distributed_optimizer(
+        optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                           parameters=model.parameters())
+    )
+    step = TrainStep(
+        model, lambda out, y: nn.functional.cross_entropy(out, y), opt
+    )
+    x = jax.device_put(jnp.asarray(
+        np.random.rand(batch, 3, 224, 224).astype(np.float32)))
+    y = jax.device_put(jnp.asarray(
+        (np.arange(batch) % 1000).astype(np.int32)))
+    _ = np.asarray(x.ravel()[:1])
+    return timeit(step, (x, y),
+                  barrier=lambda l: np.asarray(l._data))
+
+
+# ---------------- pure-jax ceiling ----------------
+
+def _pj_resnet50(nhwc, bn_dtype="bf16"):
+    """Hand-rolled ResNet-50 fwd in bf16 with BN (batch stats), returns
+    (init_params, apply). Layout nhwc or nchw decides conv dimension spec."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+
+    def conv_p(cin, cout, k):
+        return jnp.asarray(
+            rng.randn(cout, cin, k, k).astype(np.float32) * 0.05)
+
+    def bn_p(c):
+        return (jnp.ones((c,), jnp.float32), jnp.zeros((c,), jnp.float32))
+
+    layers = []  # (kind, params-spec)
+    # stem
+    params = {"stem_w": conv_p(3, 64, 7), "stem_bn": bn_p(64)}
+    cfg = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
+           (3, 512, 2048, 2)]
+    cin = 64
+    for si, (blocks, mid, cout, stride) in enumerate(cfg):
+        for bi in range(blocks):
+            s = stride if bi == 0 else 1
+            p = {}
+            p["w1"] = conv_p(cin, mid, 1)
+            p["bn1"] = bn_p(mid)
+            p["w2"] = conv_p(mid, mid, 3)
+            p["bn2"] = bn_p(mid)
+            p["w3"] = conv_p(mid, cout, 1)
+            p["bn3"] = bn_p(cout)
+            if bi == 0:
+                p["wd"] = conv_p(cin, cout, 1)
+                p["bnd"] = bn_p(cout)
+            params[f"s{si}b{bi}"] = p
+            cin = cout
+    params["fc_w"] = jnp.asarray(
+        rng.randn(2048, 1000).astype(np.float32) * 0.01)
+    params["fc_b"] = jnp.zeros((1000,), jnp.float32)
+
+    if nhwc:
+        dn_spec = ("NHWC", "HWIO", "NHWC")
+        ch_axis = 3
+        stat_axes = (0, 1, 2)
+    else:
+        dn_spec = ("NCHW", "OIHW", "NCHW")
+        ch_axis = 1
+        stat_axes = (0, 2, 3)
+
+    def conv(x, w, stride, pad):
+        if nhwc:
+            w = jnp.transpose(w, (2, 3, 1, 0))  # OIHW->HWIO
+        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_spec)
+        return jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype), (stride, stride),
+            [(pad, pad), (pad, pad)], dimension_numbers=dn)
+
+    def bn(x, g, b):
+        shape = [1] * 4
+        shape[ch_axis] = x.shape[ch_axis]
+        if bn_dtype == "nostats":  # affine only: measures the stat cost
+            return x * g.astype(x.dtype).reshape(shape) + b.astype(
+                x.dtype).reshape(shape)
+        if bn_dtype == "mmstats_ad":  # MXU stats fwd, plain autodiff bwd
+            C = x.shape[ch_axis]
+            n = x.size // C
+            x2d = x.reshape(n, C)
+            ones = jnp.ones((n,), x.dtype)
+            dd = (((0,), (0,)), ((), ()))
+            mean = jax.lax.dot_general(
+                ones, x2d, dd, preferred_element_type=jnp.float32) / n
+            meansq = jax.lax.dot_general(
+                ones, jnp.square(x2d), dd,
+                preferred_element_type=jnp.float32) / n
+            var = meansq - jnp.square(mean)
+            scale = g * jax.lax.rsqrt(var + 1e-5)
+            bias = b - mean * scale
+            return (x * scale.astype(x.dtype).reshape(shape)
+                    + bias.astype(x.dtype).reshape(shape))
+        if bn_dtype == "mmstats":  # ALL per-channel reductions on the MXU
+            C = x.shape[ch_axis]
+            n = x.size // C
+
+            def dot1(v, m):  # [n] @ [n,C] -> f32 [C] on the MXU
+                return jax.lax.dot_general(
+                    v, m, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            @jax.custom_vjp
+            def bn2d(x2d, g, b):
+                ones = jnp.ones((n,), x2d.dtype)
+                mean = dot1(ones, x2d) / n
+                meansq = dot1(ones, jnp.square(x2d)) / n
+                var = meansq - jnp.square(mean)
+                r = jax.lax.rsqrt(var + 1e-5)
+                scale = g * r
+                bias = b - mean * scale
+                return x2d * scale.astype(x2d.dtype) + bias.astype(x2d.dtype)
+
+            def bn2d_fwd(x2d, g, b):
+                ones = jnp.ones((n,), x2d.dtype)
+                mean = dot1(ones, x2d) / n
+                meansq = dot1(ones, jnp.square(x2d)) / n
+                var = meansq - jnp.square(mean)
+                r = jax.lax.rsqrt(var + 1e-5)
+                scale = g * r
+                bias = b - mean * scale
+                out = x2d * scale.astype(x2d.dtype) + bias.astype(x2d.dtype)
+                return out, (x2d, g, mean, r)
+
+            def bn2d_bwd(res, dy):
+                x2d, g, mean, r = res
+                ones = jnp.ones((n,), dy.dtype)
+                xhat = (x2d.astype(jnp.float32) - mean) * r
+                xhat = xhat.astype(x2d.dtype)
+                db = dot1(ones, dy)
+                dg = dot1(ones, dy * xhat)
+                k = (g * r / n).astype(jnp.float32)
+                dx = (k * (n * dy.astype(jnp.float32)
+                           - db - xhat.astype(jnp.float32) * dg)
+                      ).astype(x2d.dtype)
+                return dx, dg, db
+
+            bn2d.defvjp(bn2d_fwd, bn2d_bwd)
+            return bn2d(x.reshape(n, C), g, b).reshape(x.shape)
+        if bn_dtype == "onepass":  # fused mean/meansq, scale+shift form
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=stat_axes)
+            meansq = jnp.mean(jnp.square(xf), axis=stat_axes)
+            var = meansq - jnp.square(mean)
+            scale = g * jax.lax.rsqrt(var + 1e-5)
+            bias = b - mean * scale
+            return (x * scale.astype(x.dtype).reshape(shape)
+                    + bias.astype(x.dtype).reshape(shape))
+        cd = jnp.float32 if bn_dtype == "f32" else x.dtype
+        xx = x.astype(cd)
+        mean = jnp.mean(xx.astype(jnp.float32), axis=stat_axes)
+        var = jnp.var(xx.astype(jnp.float32), axis=stat_axes)
+        out = (xx - mean.astype(cd).reshape(shape)) * jax.lax.rsqrt(
+            var.astype(cd).reshape(shape) + 1e-5)
+        return (out * g.astype(cd).reshape(shape)
+                + b.astype(cd).reshape(shape)).astype(x.dtype)
+
+    def apply(params, x):
+        x = x.astype(jnp.bfloat16)
+        if nhwc:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        h = conv(x, params["stem_w"], 2, 3)
+        h = jax.nn.relu(bn(h, *params["stem_bn"]))
+        # 3x3 maxpool stride 2
+        pads = [(0, 0)] * 4
+        pads[1 if not nhwc else 1] = (1, 1)
+        if nhwc:
+            window = (1, 3, 3, 1)
+            strides = (1, 2, 2, 1)
+            pad4 = [(0, 0), (1, 1), (1, 1), (0, 0)]
+        else:
+            window = (1, 1, 3, 3)
+            strides = (1, 1, 2, 2)
+            pad4 = [(0, 0), (0, 0), (1, 1), (1, 1)]
+        h = jax.lax.reduce_window(
+            h, -jnp.inf, jax.lax.max, window, strides, pad4)
+        cfg = [(3, 1), (4, 2), (6, 2), (3, 2)]
+        for si, (blocks, stride) in enumerate(cfg):
+            for bi in range(blocks):
+                p = params[f"s{si}b{bi}"]
+                s = stride if bi == 0 else 1
+                idn = h
+                o = jax.nn.relu(bn(conv(h, p["w1"], 1, 0), *p["bn1"]))
+                o = jax.nn.relu(bn(conv(o, p["w2"], s, 1), *p["bn2"]))
+                o = bn(conv(o, p["w3"], 1, 0), *p["bn3"])
+                if "wd" in p:
+                    idn = bn(conv(h, p["wd"], s, 0), *p["bnd"])
+                h = jax.nn.relu(o + idn)
+        h = jnp.mean(h, axis=(1, 2) if nhwc else (2, 3))
+        return h.astype(jnp.float32) @ params["fc_w"] + params["fc_b"]
+
+    return params, apply
+
+
+def purejax(batch, nhwc, bn_dtype="bf16", fwd_only=False):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    params, apply = _pj_resnet50(nhwc, bn_dtype)
+    if fwd_only:
+        fwd = jax.jit(lambda p, x: apply(p, x).sum())
+        x = jax.device_put(jnp.asarray(
+            np.random.rand(batch, 3, 224, 224).astype(np.float32)))
+        _ = np.asarray(x.ravel()[:1])
+        return timeit(lambda x: fwd(params, x), (x,),
+                      barrier=lambda l: np.asarray(l))
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, x, y):
+        logits = apply(params, x)
+        return jnp.mean(
+            -jax.nn.log_softmax(logits)[jnp.arange(x.shape[0]), y])
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    x = jax.device_put(jnp.asarray(
+        np.random.rand(batch, 3, 224, 224).astype(np.float32)))
+    y = jax.device_put(jnp.asarray(
+        (np.arange(batch) % 1000).astype(np.int32)))
+    _ = np.asarray(x.ravel()[:1])
+
+    state = {"p": params, "o": opt_state}
+
+    def run(x, y):
+        state["p"], state["o"], loss = step(state["p"], state["o"], x, y)
+        return loss
+
+    return timeit(run, (x, y), barrier=lambda l: np.asarray(l))
+
+
+if __name__ == "__main__":
+    variant = sys.argv[1]
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    if variant == "fw":
+        ms, cs = fw(batch)
+    elif variant == "purejax_nhwc":
+        ms, cs = purejax(batch, True)
+    elif variant == "purejax_nchw":
+        ms, cs = purejax(batch, False)
+    elif variant == "purejax_nhwc_f32bn":
+        ms, cs = purejax(batch, True, "f32")
+    elif variant == "purejax_nostats":
+        ms, cs = purejax(batch, True, "nostats")
+    elif variant == "purejax_onepass":
+        ms, cs = purejax(batch, True, "onepass")
+    elif variant == "purejax_onepass_fwd":
+        ms, cs = purejax(batch, True, "onepass", fwd_only=True)
+    elif variant == "purejax_mmstats":
+        ms, cs = purejax(batch, True, "mmstats")
+    elif variant == "purejax_mmstats_ad":
+        ms, cs = purejax(batch, True, "mmstats_ad")
+    elif variant == "purejax_mmstats_fwd":
+        ms, cs = purejax(batch, True, "mmstats", fwd_only=True)
+    else:
+        raise SystemExit(f"unknown variant {variant}")
+    print(f"{variant} batch={batch} step_ms={ms:.2f} "
+          f"imgs_s={batch/ms*1e3:.0f} compile_s={cs:.1f}")
