@@ -40,6 +40,8 @@ from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear,
+    ParallelGPTBlock,
+    ParallelMultiHeadAttention,
     RowParallelLinear,
     VocabParallelEmbedding,
     split,

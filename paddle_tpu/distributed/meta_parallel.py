@@ -159,6 +159,99 @@ class VocabParallelEmbedding(Layer):
         return _constrain(out, self.mesh, P())
 
 
+class ParallelMultiHeadAttention(Layer):
+    """Megatron-style tensor-parallel self-attention.
+
+    Reference lineage: the fused qkv + head-partitioned attention the
+    reference reaches via `paddle.distributed.split` compositions
+    (collective.py:492) and its Megatron ERNIE/GPT configs — heads are
+    split over the 'mp' axis: the qkv projection is column-parallel
+    (gather_output=False keeps [B, T, 3D] feature-sharded), each mp shard
+    computes attention for its own heads locally (zero comm in the
+    softmax), and the output projection is row-parallel, whose contraction
+    all-reduce XLA inserts from sharding propagation.
+    """
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, causal=True,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.mesh = comm.mp_mesh()
+        mp = self.mesh.shape["mp"]
+        if embed_dim % num_heads != 0:
+            raise ValueError("embed_dim must divide into num_heads")
+        if num_heads % mp != 0:
+            raise ValueError(
+                f"num_heads={num_heads} not divisible by mp={mp}"
+            )
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.dropout = dropout
+        self.qkv = ColumnParallelLinear(
+            embed_dim, 3 * embed_dim, weight_attr=weight_attr,
+            bias_attr=bias_attr, gather_output=False,
+        )
+        self.out_proj = RowParallelLinear(
+            embed_dim, embed_dim, weight_attr=weight_attr,
+            bias_attr=bias_attr, input_is_parallel=True,
+        )
+
+    def forward(self, x):
+        from .. import ops
+
+        B, T = x.shape[0], x.shape[1]
+        H, dh = self.num_heads, self.head_dim
+        qkv = self.qkv(x)  # [B, T, 3D] sharded on the feature axis
+        # heads axis inherits the mp sharding (3D = 3*H*dh, H-major)
+        qkv = qkv.reshape([B, T, 3, H, dh]).transpose([2, 0, 3, 1, 4])
+        qkv = _constrain(qkv, self.mesh, P(None, None, "mp", None, None))
+        q, k, v = qkv[0], qkv[1], qkv[2]  # [B, H, T, dh]
+        scores = ops.matmul(q, k, transpose_y=True) * (dh ** -0.5)
+        if self.causal:
+            import numpy as np
+
+            mask = np.triu(
+                np.full((T, T), -1e9, dtype=np.float32), k=1
+            )
+            scores = scores + Tensor._wrap(
+                jax.numpy.asarray(mask), stop_gradient=True
+            )
+        attn = F.softmax(scores, axis=-1)
+        if self.dropout:
+            attn = F.dropout(attn, p=self.dropout, training=self.training)
+        ctx = ops.matmul(attn, v)  # [B, H, T, dh], heads sharded
+        ctx = ctx.transpose([0, 2, 1, 3]).reshape([B, T, H * dh])
+        ctx = _constrain(ctx, self.mesh, P(None, None, "mp"))
+        return self.out_proj(ctx)
+
+
+class ParallelGPTBlock(Layer):
+    """Pre-LN GPT decoder block with tensor-parallel attention + MLP —
+    the unit the BASELINE GPT-3 configs stack inside pipeline stages."""
+
+    def __init__(self, d_model, num_heads, dim_feedforward=None,
+                 dropout=0.0, causal=True):
+        super().__init__()
+        from ..nn.layers.norm import LayerNorm
+
+        ffn = dim_feedforward or 4 * d_model
+        self.ln1 = LayerNorm(d_model)
+        self.attn = ParallelMultiHeadAttention(
+            d_model, num_heads, dropout=dropout, causal=causal
+        )
+        self.ln2 = LayerNorm(d_model)
+        self.fc1 = ColumnParallelLinear(d_model, ffn, gather_output=False)
+        self.fc2 = RowParallelLinear(ffn, d_model, input_is_parallel=True)
+        self.dropout = dropout
+
+    def forward(self, x):
+        h = x + self.attn(self.ln1(x))
+        m = F.gelu(self.fc1(self.ln2(h)))
+        if self.dropout:
+            m = F.dropout(m, p=self.dropout, training=self.training)
+        return h + self.fc2(m)
+
+
 def split(x, size, operation: str, axis: int = 0, num_partitions: Optional[int] = None,
           gather_out: bool = True, weight_attr=None, bias_attr=None,
           name=None):

@@ -387,3 +387,68 @@ def test_pipeline_with_sharding_and_gradient_merge():
     np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-5)
     for a, b in zip(ref_p, pp_p):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_gpt_hybrid_dp_pp_mp_sharding_gm():
+    """The BASELINE config-5 composition on one GPT model: dp2 x pp2 x mp2
+    with ZeRO stage-1 and gradient_merge(k=2) — tensor-parallel attention
+    AND MLP (ParallelGPTBlock) inside 1F1B pipeline stages, batches
+    sharded over dp. Parity vs the identical model trained on the
+    single-device eager path."""
+    from paddle_tpu.distributed import ParallelGPTBlock
+
+    batch, T, D, H = 8, 4, 16, 4
+    rng = np.random.RandomState(13)
+    xs = [rng.rand(batch, T, D).astype(np.float32) for _ in range(2)]
+    ys = [(rng.randint(0, 10, size=(batch,))).astype(np.int64)
+          for _ in range(2)]
+    lr = 1e-2
+
+    def build():
+        paddle.seed(33)
+        return [ParallelGPTBlock(D, H, dropout=0.0) for _ in range(2)] + [
+            nn.Linear(D, 10)
+        ]
+
+    # single-device reference: same modules on a trivial (1,1,1,1) mesh,
+    # eager autograd, grads averaged over the 2 merged batches
+    comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+    try:
+        ref_model = PipelineLayer(build(), loss_fn=_loss_fn)
+        ref_opt = optimizer.Adam(learning_rate=lr,
+                                 parameters=ref_model.parameters())
+        ref_losses = []
+        for x, y in zip(xs, ys):
+            loss = ref_model(paddle.to_tensor(x), paddle.to_tensor(y))
+            (loss * 0.5).backward()
+            ref_losses.append(float(loss.numpy()))
+        ref_opt.step()
+    finally:
+        comm._state.hybrid_mesh = None
+
+    strategy = DistributedStrategy()
+    strategy.pipeline = True
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 1}
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    strategy.hybrid_configs = {
+        "dp_degree": 2, "pp_degree": 2, "mp_degree": 2,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        model = fleet.distributed_model(
+            PipelineLayer(build(), loss_fn=_loss_fn)
+        )
+        opt = fleet.distributed_optimizer(
+            optimizer.Adam(learning_rate=lr, parameters=model.parameters())
+        )
+        losses = [
+            float(model.train_batch([x, y], opt).numpy())
+            for x, y in zip(xs, ys)
+        ]
+    finally:
+        comm._state.hybrid_mesh = None
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=3e-4, atol=3e-5)
