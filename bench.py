@@ -7,9 +7,11 @@ program; the analog of the reference's generated `core.ops` bindings +
 run_program op, pybind/op_function_generator.cc:488) — exactly the harness
 `__graft_entry__.dryrun_multichip` drives on the virtual mesh.
 
-Headline metric stays `lenet_mnist_train_imgs_per_sec` for cross-round
-comparability (BENCH_r01–r03); `extra` carries the ResNet-50 synthetic
-throughput (BASELINE.json config 2) and a per-model step-time breakdown.
+Headline metric (round 5+): `resnet50_bf16_train_imgs_per_sec` — the
+compute-bound number (BASELINE.json config 2). The old headline
+`lenet_mnist_train_imgs_per_sec` (r01-r04) was tunnel-overhead-bound and
+rides in `extra` for continuity. `extra` also carries BERT-base and
+GPT-medium bf16 steps and per-model compile times.
 
 Why rounds 1–3 read ~660–724 imgs/sec (~354 ms/step): the old bench
 updated params with an EAGER `tree_map(p - lr*g)` outside jit — 8 separate
@@ -175,6 +177,97 @@ def _bench_bert(steps=10, batch=32, seq=128):
     }
 
 
+def _gpt_medium():
+    """GPT-medium-shaped causal decoder (the single-chip proxy for
+    BASELINE config 5's GPT-3 1.3B, which needs the dp x pp x mp hybrid
+    dryrun_multichip proves): 24 ParallelGPTBlock layers (trivial 1-chip
+    mesh — same code path the hybrid shards), d_model 1024, 16 heads,
+    seq 1024, tied-free 32k vocab head."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import ParallelGPTBlock, comm
+
+    if comm.hybrid_mesh() is None:
+        comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+
+    class GPT(nn.Layer):
+        def __init__(self, vocab=32000, d=1024, heads=16, layers=24,
+                     seq=1024):
+            super().__init__()
+            self.embed = nn.Embedding(vocab, d)
+            self.pos = nn.Embedding(seq, d)
+            self.blocks = nn.LayerList([
+                ParallelGPTBlock(d, heads, dropout=0.0)
+                for _ in range(layers)
+            ])
+            self.head = nn.Linear(d, vocab)
+
+        def forward(self, ids):
+            T = ids.shape[1]
+            pos_ids = paddle.arange(T, dtype="int64")
+            h = self.embed(ids) + self.pos(pos_ids)
+            for blk in self.blocks:
+                h = blk(h)
+            return self.head(h)
+
+    return GPT()
+
+
+def _bench_gpt(steps=10, batch=4, seq=1024):
+    """Causal-LM training step: next-token CE over the full sequence."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    fleet.init(is_collective=True, strategy=strategy)
+    model = _gpt_medium()
+    opt = fleet.distributed_optimizer(
+        optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                        parameters=model.parameters())
+    )
+
+    def lm_loss(logits, labels):
+        V = logits.shape[-1]
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, V]), labels.reshape([-1])
+        )
+
+    step = TrainStep(model, lm_loss, opt)
+    ids = jax.device_put(jnp.asarray(
+        (np.arange(batch * seq) % 31000).reshape(batch, seq)
+        .astype(np.int32)
+    ))
+    labels = jax.device_put(jnp.asarray(
+        ((np.arange(batch * seq) + 1) % 31000).reshape(batch, seq)
+        .astype(np.int32)
+    ))
+    _ = np.asarray(ids.ravel()[:1])
+
+    t0 = time.perf_counter()
+    loss = step(ids, labels)
+    _ = np.asarray(loss._data)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    _ = np.asarray(loss._data)
+    dt = time.perf_counter() - t0
+    tok_s = steps * batch * seq / dt
+    return {
+        "gpt_medium_bf16_step_ms": round(dt / steps * 1e3, 2),
+        "gpt_medium_bf16_tokens_per_sec": round(tok_s, 0),
+        "gpt_medium_bf16_compile_s": round(compile_s, 1),
+    }
+
+
 def _bench_flash_attention(steps=500):
     """Long-context attention: the Pallas flash kernel vs XLA dense at
     S=2048 causal. The `steps` iterations run INSIDE one jitted lax.scan
@@ -252,13 +345,18 @@ def main():
         (1, 28, 28), 10, batch=256, steps=50, label="lenet",
     )
     extra.update(bd)
+    # r01-r04 continuity: this was the headline metric; it is tunnel-
+    # per-program-overhead-bound (r02 663.6, r03 ~15-26k, r04 58196 —
+    # ±2x jitter with tunnel load), so round 5 promotes the compute-bound
+    # ResNet-50 bf16 number to `metric` instead (VERDICT r4 weak #8)
+    extra["lenet_mnist_train_imgs_per_sec"] = round(lenet_ips, 1)
 
     r50_ips, bd = _bench_train(
         lambda: resnet50(num_classes=1000),
         lambda m: optimizer.Momentum(
             learning_rate=0.1, momentum=0.9, parameters=m.parameters()
         ),
-        (3, 224, 224), 1000, batch=64, steps=20, label="resnet50",
+        (3, 224, 224), 1000, batch=256, steps=20, label="resnet50",
     )
     extra.update(bd)
     extra["resnet50_synthetic_imgs_per_sec"] = round(r50_ips, 1)
@@ -268,7 +366,7 @@ def main():
         lambda m: optimizer.Momentum(
             learning_rate=0.1, momentum=0.9, parameters=m.parameters()
         ),
-        (3, 224, 224), 1000, batch=64, steps=20, label="resnet50_bf16",
+        (3, 224, 224), 1000, batch=256, steps=20, label="resnet50_bf16",
         amp=True,
     )
     extra.update(bd)
@@ -277,25 +375,31 @@ def main():
     bert_ips, bd = _bench_bert()
     extra.update(bd)
     extra["bert_base_bf16_samples_per_sec"] = round(bert_ips, 1)
+    extra.update(_bench_gpt())
     import jax
 
     if jax.default_backend() == "tpu":  # compiled pallas is TPU-only
         extra.update(_bench_flash_attention())
-    extra["vs_r02"] = round(lenet_ips / 663.6, 1)
+    # r04 measured the same model/optimizer at batch 64 with two-pass
+    # f32-blacklisted batch norm: 41.78 ms / 64 imgs = 1531.7 imgs/sec
+    extra["vs_r04_resnet50_bf16"] = round(r50_bf16_ips / 1531.7, 2)
     extra["note"] = (
         "TrainStep hot path (fused fwd+bwd+opt, donated, device-staged "
         "inputs; devget barriers — block_until_ready no-ops on the axon "
-        "tunnel); r1-r3's ~354ms LeNet step was the eager per-param "
-        "tree_map update paying a tunnel round-trip per dispatch; "
-        "LeNet's ~10-17ms step is tunnel per-program overhead-bound "
-        "(jitter with tunnel load; ResNet/BERT are compute-bound)"
+        "tunnel). Round-5 ResNet work (tools/PERF.md): one-pass f32 BN "
+        "stats applied in bf16 (scale+shift form, batch_norm off the amp "
+        "black list) + batch 256; framework step now matches a "
+        "hand-written pure-JAX step within 1.5% — the residual vs MXU "
+        "peak is this chip's reduction/VPU throughput (per-op table in "
+        "PERF.md). compile_s values are warm-cache (persistent XLA "
+        "compilation cache, core/compile_cache.py)."
     )
 
     print(
         json.dumps(
             {
-                "metric": "lenet_mnist_train_imgs_per_sec",
-                "value": round(lenet_ips, 1),
+                "metric": "resnet50_bf16_train_imgs_per_sec",
+                "value": round(r50_bf16_ips, 1),
                 "unit": "imgs/sec",
                 "vs_baseline": 1.0,
                 "extra": extra,

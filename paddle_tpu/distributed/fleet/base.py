@@ -157,7 +157,11 @@ class _DistributedOptimizer:
             bufs = []
             for p in params:
                 if id(p) not in buf_store:
-                    buf_store[id(p)] = jnp.zeros_like(p._data)
+                    z = jnp.zeros_like(p._data)
+                    sh = getattr(p._data, "sharding", None)
+                    if sh is not None:  # match param placement (no retrace)
+                        z = jax.device_put(z, sh)
+                    buf_store[id(p)] = z
                 bufs.append(buf_store[id(p)])
             state["@gm_buf"] = tuple(bufs)
             state["@gm_cnt"] = jnp.asarray(self._gm_calls, jnp.int32)

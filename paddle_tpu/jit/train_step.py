@@ -140,15 +140,35 @@ class TrainStep:
         b_named = dict(model.named_buffers())
         self._b_names = list(b_named)
         self._b_objs = list(b_named.values())
+        # placement normalization: when a hybrid mesh is active, any
+        # param/buffer still on its default single-device placement gets
+        # a replicated NamedSharding on that mesh. Mixed placements make
+        # the first step's input avals carry a different mesh context
+        # ({} vs {Auto: axes}) than its outputs, which re-traces and
+        # re-compiles the entire step once on the second call.
+        from ..distributed import comm as _comm
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        mesh = _comm.hybrid_mesh()
+        if mesh is not None:
+            repl = NamedSharding(mesh, _P())
+            for o in self._p_objs + self._b_objs:
+                if not isinstance(
+                    getattr(o._data, "sharding", None), NamedSharding
+                ):
+                    o._data = jax.device_put(o._data, repl)
         self._donate = donate and jax.default_backend() != "cpu"
         # per-param "participates in the loss" mask, decided once by jaxpr
         # analysis at first call: unused params keep eager semantics (no
         # update at all) instead of receiving zero grads + decay.
         self._used_mask = None
-        self._jitted = jax.jit(
-            self._step_fn,
-            donate_argnums=(0, 1, 2) if self._donate else (),
-        )
+        # jit is built lazily at the first call so the state outputs can be
+        # PINNED to the input shardings (out_shardings): without pinning,
+        # GSPMD normalizes output shardings (SingleDevice -> NamedSharding,
+        # P(None,'mp') -> P() on trivial axes), the second call sees a new
+        # input signature, and the whole step re-traces and re-compiles
+        # once — tens of seconds on a large model.
+        self._jitted = None
 
     # -- the pure program ----------------------------------------------------
     def _amp_guard(self):
@@ -315,6 +335,28 @@ class TrainStep:
         if self._used_mask is None:
             self._used_mask = self._analyze_usage(
                 p_raws, b_raws, key, in_raws, label_raws
+            )
+        if self._jitted is None:
+            # pin state outputs to their input shardings — EXCEPT what the
+            # ZeRO strategy intentionally reshards (stage>=1 shards the
+            # optimizer state inside the update, stage 3 the params):
+            # those converge to their sharded form after one call instead
+            pin = lambda tree: jax.tree_util.tree_map(
+                lambda r: r.sharding, tree
+            )
+            stage = int(getattr(self.opt, "_sharding_stage", 0) or 0)
+            out_sh = (
+                None,                                    # loss
+                pin(p_raws) if stage < 3 else None,      # new_p
+                pin(opt_state) if stage < 1 else None,   # new_state
+                pin(b_raws),                             # new_b
+                None,                                    # outs
+                None,                                    # scaler_state
+            )
+            self._jitted = jax.jit(
+                self._step_fn,
+                donate_argnums=(0, 1, 2) if self._donate else (),
+                out_shardings=out_sh,
             )
         opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)

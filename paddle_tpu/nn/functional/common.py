@@ -40,9 +40,22 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         return x if isinstance(x, Tensor) else Tensor(x)
     if p == 1.0:
         return AG.apply(lambda a: jnp.zeros_like(a), (x,), name="dropout")
-    key = rnd.next_key()
+    # the key is an op INPUT, not a closure capture: under static-graph
+    # recording it becomes an rng placeholder the Executor feeds fresh per
+    # run (static/program.py rng_feed — a recorded closure key would
+    # replay the same mask every exe.run)
+    from ...static import _static_mode_on
+    from ...static.program import is_symbolic, rng_feed
 
-    def f(a):
+    if _static_mode_on() and is_symbolic(x):
+        key_t = rng_feed()
+    else:
+        key_t = Tensor._wrap(
+            jax.random.key_data(rnd.next_key()), stop_gradient=True
+        )
+
+    def f(a, kd):
+        key = jax.random.wrap_key_data(kd)
         shape = list(a.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
@@ -52,7 +65,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
             return jnp.where(keep, a / (1.0 - p), 0.0)
         return jnp.where(keep, a, 0.0)
 
-    return AG.apply(f, (x,), name="dropout")
+    return AG.apply(f, (x, key_t), name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

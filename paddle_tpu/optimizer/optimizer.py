@@ -190,7 +190,18 @@ class Optimizer:
     _acc_tree_names: tuple = ()
 
     def _acc_init(self, name: str, p: Parameter):
-        return jnp.zeros_like(p._data)
+        z = jnp.zeros_like(p._data)
+        # match the PARAM's placement: a moment born on the default device
+        # while its param carries a NamedSharding gives the first fused
+        # step a different input signature than every later one — one full
+        # retrace+recompile of the whole train step (tens of seconds on a
+        # big model) for nothing
+        sh = getattr(p._data, "sharding", None)
+        if sh is not None:
+            import jax
+
+            z = jax.device_put(z, sh)
+        return z
 
     def _functional_state(self, params: List[Parameter]):
         """State pytree: {acc_name: tuple aligned with params}. Seeds from /

@@ -74,11 +74,16 @@ class Executor:
         # leaf tensors: concrete Tensors recorded as op inputs (params +
         # captured constants); resolved from the live objects at call time
         leaves, leaf_idx = [], {}
+        rng_vars, rng_pos = [], {}
         for op in program.ops:
             for t in op.inputs:
                 if isinstance(t, Tensor) and id(t) not in leaf_idx:
                     leaf_idx[id(t)] = len(leaves)
                     leaves.append(t)
+                elif isinstance(t, Variable) and t.is_rng \
+                        and t.id not in rng_pos:
+                    rng_pos[t.id] = len(rng_vars)
+                    rng_vars.append(t)
         params = [
             t for t in leaves
             if isinstance(t, Parameter) and t.trainable
@@ -100,13 +105,15 @@ class Executor:
                     "tensors the ops consume"
                 )
 
-        def replay(p_raws, leaf_raws, feed_raws):
+        def replay(p_raws, leaf_raws, feed_raws, rng_raws):
             env = {}
 
             def resolve(inp):
                 if isinstance(inp, Variable):
                     if inp.id in env:
                         return env[inp.id]
+                    if inp.is_rng:
+                        return rng_raws[rng_pos[inp.id]]
                     if inp.is_data:
                         return feed_raws[feed_pos[inp.name]]
                     raise KeyError(
@@ -128,10 +135,13 @@ class Executor:
 
         directives = program.optimize_directives
         if not directives:
-            def run_fn(p_raws, leaf_raws, feed_raws):
-                return replay(p_raws, leaf_raws, feed_raws)[0], p_raws, ()
+            def run_fn(p_raws, leaf_raws, feed_raws, rng_raws):
+                return (
+                    replay(p_raws, leaf_raws, feed_raws, rng_raws)[0],
+                    p_raws, (),
+                )
 
-            return jax.jit(run_fn), leaves, params, None
+            return jax.jit(run_fn), leaves, params, None, rng_vars
 
         if len(directives) > 1:
             raise NotImplementedError(
@@ -141,9 +151,10 @@ class Executor:
 
         from ..jit.train_step import process_grads
 
-        def run_fn(p_raws, leaf_raws, feed_raws, opt_state, lr, t):
+        def run_fn(p_raws, leaf_raws, feed_raws, rng_raws, opt_state, lr, t):
             def loss_of(p_tuple):
-                fetches, env = replay(p_tuple, leaf_raws, feed_raws)
+                fetches, env = replay(p_tuple, leaf_raws, feed_raws,
+                                      rng_raws)
                 return env[loss_var.id], fetches
 
             (loss, fetches), grads = jax.value_and_grad(
@@ -155,8 +166,9 @@ class Executor:
             )
             return fetches, new_p, new_state
 
-        donate = (0, 3) if jax.default_backend() != "cpu" else ()
-        return jax.jit(run_fn, donate_argnums=donate), leaves, params, opt
+        donate = (0, 4) if jax.default_backend() != "cpu" else ()
+        return (jax.jit(run_fn, donate_argnums=donate), leaves, params, opt,
+                rng_vars)
 
     # -- run -----------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
@@ -222,19 +234,26 @@ class Executor:
         )
         if key not in self._cache:
             self._cache[key] = self._build(program, feed_names, fetch_vars)
-        run_fn, leaves, params, opt = self._cache[key]
+        run_fn, leaves, params, opt, rng_vars = self._cache[key]
 
         p_raws = tuple(p._data for p in params)
         leaf_raws = tuple(t._data for t in leaves)
+        # fresh key data per run for every rng placeholder (dropout masks
+        # vary across runs; see program.rng_feed)
+        from ..core import random as rnd
+
+        rng_raws = tuple(
+            jax.random.key_data(rnd.next_key()) for _ in rng_vars
+        )
         if opt is None:
-            fetches, _, _ = run_fn(p_raws, leaf_raws, feed_raws)
+            fetches, _, _ = run_fn(p_raws, leaf_raws, feed_raws, rng_raws)
         else:
             opt_state = opt._functional_state(params)
             opt._step_count += 1
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             t = jnp.asarray(opt._step_count, jnp.float32)
             fetches, new_p, new_state = run_fn(
-                p_raws, leaf_raws, feed_raws, opt_state, lr, t
+                p_raws, leaf_raws, feed_raws, rng_raws, opt_state, lr, t
             )
             for p, raw in zip(params, new_p):
                 p._data = raw

@@ -44,10 +44,12 @@ class Variable:
         self.shape = tuple(shape)
         self.dtype = dtype
         self.is_data = is_data  # a feed placeholder
+        self.is_rng = False     # a per-run RNG key feed (see rng_feed)
 
-    def aval(self):
+    def aval(self, dyn: int = 1):
+        """Concrete aval with dynamic (-1/None) dims placed at `dyn`."""
         return jax.ShapeDtypeStruct(
-            tuple(1 if (d is None or d < 0) else d for d in self.shape),
+            tuple(dyn if (d is None or d < 0) else d for d in self.shape),
             self.dtype,
         )
 
@@ -171,25 +173,73 @@ def is_symbolic(t) -> bool:
     return getattr(t, "_static_var", None) is not None
 
 
+def rng_feed() -> Tensor:
+    """A per-run RNG key placeholder (raw uint32 key data).
+
+    Random ops recorded into a Program (dropout, uniform noise) must NOT
+    bake a concrete key into their closure — that would replay the same
+    mask on every `exe.run` (the reference reseeds its Generator per
+    dropout kernel launch, operators/dropout_op.h). The Executor feeds
+    each rng Variable a fresh `key_data(next_key())` on every run, as an
+    implicit feed argument of the compiled program."""
+    import numpy as np
+
+    var = Variable(None, (2,), np.uint32)
+    var.is_rng = True
+    _main_program._add_var(var)
+    t = Tensor._wrap(var.aval(), stop_gradient=True)
+    t._static_var = var
+    return t
+
+
 def record_apply(raw_fn, tensors, name, differentiable=True):
     """The AG.apply hook in static mode: symbolic inputs mean 'record into
     the program' instead of executing (LayerHelper.append_op analog).
 
     Differentiability is decided at Executor compile time by jax.grad over
-    the replayed program, so `differentiable` is advisory only."""
-    avals = []
+    the replayed program, so `differentiable` is advisory only.
+
+    Dynamic-dim propagation: placeholder dims declared -1/None are
+    shape-inferred TWICE (at probe extents 1 and 2); output dims that
+    move with the probe are recorded as -1 so interior variables report
+    the batch dim the way feed placeholders do (framework.py Variable
+    shape semantics)."""
+    avals1, avals2, any_dyn = [], [], False
     for t in tensors:
         if is_symbolic(t):
-            avals.append(t._static_var.aval())
+            v = t._static_var
+            avals1.append(v.aval(1))
+            avals2.append(v.aval(2))
+            any_dyn = any_dyn or any(
+                d is None or (isinstance(d, int) and d < 0) for d in v.shape
+            )
         else:
-            avals.append(t._data)
-    out_aval = jax.eval_shape(raw_fn, *avals)
+            avals1.append(t._data)
+            avals2.append(t._data)
+    out_aval = jax.eval_shape(raw_fn, *avals1)
     multi = isinstance(out_aval, (tuple, list))
     outs = tuple(out_aval) if multi else (out_aval,)
+    dyn_masks = [None] * len(outs)
+    if any_dyn:
+        try:
+            out2 = jax.eval_shape(raw_fn, *avals2)
+            outs2 = tuple(out2) if multi else (out2,)
+            dyn_masks = [
+                tuple(a != b for a, b in zip(o1.shape, o2.shape))
+                if len(o1.shape) == len(o2.shape) else None
+                for o1, o2 in zip(outs, outs2)
+            ]
+        except Exception:
+            pass  # op incompatible with the probe extent: static shapes
     inputs = [
         t._static_var if is_symbolic(t) else t for t in tensors
     ]
     out_vars = _main_program.record(raw_fn, inputs, outs, multi, name or "op")
+    for v, mask in zip(out_vars, dyn_masks):
+        if mask:
+            v.shape = tuple(
+                -1 if d else s for s, d in zip(v.shape, mask)
+            )
     wrapped = []
     for v in out_vars:
         w = Tensor._wrap(v.aval(), stop_gradient=not differentiable)
