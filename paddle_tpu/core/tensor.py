@@ -136,6 +136,16 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self._data.shape[0]
 
+    def __iter__(self):
+        """Iterate rows (axis 0). Explicit: without this, Python's
+        sequence-protocol fallback loops __getitem__ until IndexError —
+        which jnp indexing never raises (out-of-range clamps), so a
+        `for row in tensor` would spin forever."""
+        if self._data.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        for i in range(self._data.shape[0]):
+            yield self[i]
+
     def __repr__(self):
         if _is_tracer(self._data):
             return f"Tensor(traced, shape={self.shape}, dtype={dtype_name(self.dtype)})"

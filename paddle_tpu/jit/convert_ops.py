@@ -1,0 +1,194 @@
+"""Converted-control-flow runtime.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py
+(convert_ifelse :210, convert_while_loop :43, convert_logical_and/or/not,
+convert_len) — the functions the AST rewriter targets. Each dispatches at
+RUN time: tensor condition under trace -> structured control flow
+(jit.cond / jit.while_loop -> lax); anything else -> plain Python
+semantics (including short-circuit evaluation for and/or).
+
+TPU-first difference from the reference: the converted functions lower to
+XLA's functional control flow, so both branches/bodies must produce
+matching pytrees of tensors — mismatches raise jax's structural errors
+(the analog of the reference's "variable may not be initialized" checks).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+
+
+class _Undefined:
+    """Placeholder for a name with no binding before a converted block
+    (reference: dygraph_to_static/utils.py UndefinedVar). Any use raises."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name="<var>"):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            f"local variable '{self.name}' is referenced before assignment "
+            "(it is only assigned inside one branch of a converted "
+            "if/while)"
+        )
+
+    __bool__ = __call__ = __getitem__ = _raise
+    __add__ = __radd__ = __sub__ = __mul__ = __iter__ = _raise
+
+    def __getattr__(self, item):
+        # AttributeError (not NameError) so hasattr() probes stay probes
+        raise AttributeError(item)
+
+    def __repr__(self):
+        return f"Undefined({self.name})"
+
+
+UNDEFINED = _Undefined
+
+
+def _is_traceable(v):
+    if isinstance(v, _Undefined):
+        return False
+    return isinstance(v, (Tensor, jax.Array, int, float, bool)) or (
+        hasattr(v, "shape") and hasattr(v, "dtype")
+    )
+
+
+def _tensor_pred(pred):
+    return isinstance(pred, Tensor) and AG.in_trace()
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   init: Sequence, names: Sequence[str]):
+    """convert_operators.py:210. `init` holds the current values of every
+    name either branch assigns; returns their post-if values as a tuple.
+
+    Non-traceable slots (Undefined placeholders, python objects) are
+    closed over rather than passed through lax.cond; if a traced branch
+    rebinds one of them the structural mismatch raises with the variable
+    name."""
+    if not _tensor_pred(pred):
+        cond = bool(pred)
+        out = true_fn(*init) if cond else false_fn(*init)
+        return out
+
+    from .control_flow import cond as jcond
+
+    live = [i for i, v in enumerate(init) if _is_traceable(v)]
+    static = {i: v for i, v in enumerate(init) if i not in set(live)}
+
+    def wrap(branch):
+        def g(*traced_vals):
+            full = list(init)
+            for i, v in zip(live, traced_vals):
+                full[i] = v
+            out = branch(*full)
+            for i, v in enumerate(out):
+                if not _is_traceable(v):
+                    raise TypeError(
+                        f"converted `if` over a tensor condition: variable "
+                        f"'{names[i]}' is bound to non-tensor "
+                        f"{type(v).__name__!r} by a branch — both branches "
+                        "must produce tensors for every assigned variable "
+                        "(reference convert_ifelse requires the same)"
+                    )
+            return tuple(out)
+
+        return g
+
+    return jcond(pred, wrap(true_fn), wrap(false_fn),
+                 *[init[i] for i in live])
+
+
+def convert_while_loop(test_fn: Callable, body_fn: Callable,
+                       init: Sequence, names: Sequence[str]):
+    """convert_operators.py:43. Dispatch on the FIRST test evaluation:
+    tensor under trace -> lax.while_loop; else plain Python."""
+    first = test_fn(*init)
+    if not _tensor_pred(first):
+        vals = tuple(init)
+        cond = bool(first)
+        while cond:
+            vals = tuple(body_fn(*vals))
+            cond = bool(test_fn(*vals))
+        return vals
+
+    for i, v in enumerate(init):
+        if not _is_traceable(v):
+            raise TypeError(
+                f"converted `while` over a tensor condition: loop variable "
+                f"'{names[i]}' is {type(v).__name__!r} before the loop — "
+                "every variable assigned in the body must be a tensor "
+                "before the loop starts (initialize it)"
+            )
+    from .control_flow import while_loop as jwhile
+
+    out = jwhile(test_fn, body_fn, list(init))
+    return tuple(out)
+
+
+def convert_len(seq):
+    """convert_operators.py convert_len: tensor -> leading dim."""
+    if isinstance(seq, Tensor):
+        return seq.shape[0]
+    try:
+        return len(seq)
+    except TypeError:
+        return len(list(seq))
+
+
+def convert_getitem(seq, i):
+    if isinstance(seq, (list, tuple)) and isinstance(i, Tensor):
+        raise TypeError(
+            "indexing a python list with a tensor loop index inside a "
+            "converted loop; convert the list to a tensor first"
+        )
+    return seq[i]
+
+
+def convert_logical_and(x, y_fn: Callable):
+    """Short-circuit-preserving `and` (convert_operators.py
+    convert_logical_and): python values keep python semantics and lazy
+    evaluation; tensors evaluate both sides eagerly (XLA has no
+    short-circuit)."""
+    if isinstance(x, Tensor):
+        y = y_fn()
+        if isinstance(y, Tensor) or _tensor_pred(x):
+            from ..ops import logic
+
+            return logic.logical_and(
+                x, y if isinstance(y, Tensor) else Tensor(y)
+            )
+        return y if bool(x) else x
+    if not x:
+        return x
+    return y_fn()
+
+
+def convert_logical_or(x, y_fn: Callable):
+    if isinstance(x, Tensor):
+        y = y_fn()
+        if isinstance(y, Tensor) or _tensor_pred(x):
+            from ..ops import logic
+
+            return logic.logical_or(
+                x, y if isinstance(y, Tensor) else Tensor(y)
+            )
+        return x if bool(x) else y
+    if x:
+        return x
+    return y_fn()
+
+
+def convert_logical_not(x):
+    if isinstance(x, Tensor):
+        from ..ops import logic
+
+        return logic.logical_not(x)
+    return not x

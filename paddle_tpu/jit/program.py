@@ -243,6 +243,16 @@ class StaticFunction:
 
     def __init__(self, fn, layer: Optional[Layer] = None, input_spec=None,
                  build_strategy=None, layers=None):
+        import os
+
+        if os.environ.get("PADDLE_TPU_NO_AST") != "1":
+            # AST conversion (program_translator.py:756): tensor-dependent
+            # if/while/for compile without manual jit.cond/while_loop
+            # rewrites; falls back to the trace-only path for sources it
+            # cannot rewrite (jit/ast_transform.py)
+            from .ast_transform import convert_to_static
+
+            fn = convert_to_static(fn)
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec

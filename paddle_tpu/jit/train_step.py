@@ -341,9 +341,19 @@ class TrainStep:
             # ZeRO strategy intentionally reshards (stage>=1 shards the
             # optimizer state inside the update, stage 3 the params):
             # those converge to their sharded form after one call instead
-            pin = lambda tree: jax.tree_util.tree_map(
-                lambda r: r.sharding, tree
-            )
+            from jax.sharding import NamedSharding as _NS
+
+            def pin(tree):
+                # only NamedSharding leaves are pinned; single-device
+                # leaves (e.g. freshly made scalar counters) stay
+                # unconstrained — pinning them to device 0 conflicts
+                # with mesh-placed operands
+                return jax.tree_util.tree_map(
+                    lambda r: r.sharding
+                    if isinstance(getattr(r, "sharding", None), _NS)
+                    else None,
+                    tree,
+                )
             stage = int(getattr(self.opt, "_sharding_stage", 0) or 0)
             out_sh = (
                 None,                                    # loss
