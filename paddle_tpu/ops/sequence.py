@@ -126,3 +126,209 @@ segment_sum = _segment("sum")
 segment_mean = _segment("mean")
 segment_max = _segment("max")
 segment_min = _segment("min")
+
+
+# ---------------------------------------------------------------------------
+# round 5 (VERDICT r4 missing #4): the sequence_ops tail in padded-dense
+# form — [batch, maxlen, ...] values + [batch] lengths, the TPU encoding
+# of a LoD batch (static shapes; masks instead of row offsets).
+# ---------------------------------------------------------------------------
+
+__all__ += [
+    "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_conv", "sequence_expand", "sequence_first_step",
+    "sequence_last_step", "sequence_slice", "sequence_enumerate",
+]
+
+
+def _mask_for(x_shape, lens, T):
+    pos = jnp.arange(T)
+    return pos[None, :] < lens[:, None]  # [B, T]
+
+
+def sequence_pool(x, pool_type, lengths, name=None):
+    """sequence_pool_op.cc in padded form: [B, T, ...] + lengths -> [B, ...].
+    pool_type: sum | average/mean | sqrt | max | min | first | last."""
+    x, lengths = as_tensor(x), as_tensor(lengths)
+    pt = pool_type.lower()
+    if pt == "average":
+        pt = "mean"
+
+    def f(vals, lens):
+        B, T = vals.shape[0], vals.shape[1]
+        mask = _mask_for(vals.shape, lens, T)
+        m = mask.reshape(mask.shape + (1,) * (vals.ndim - 2))
+        if pt in ("sum", "mean", "sqrt"):
+            s = jnp.sum(jnp.where(m, vals, 0), axis=1)
+            if pt == "sum":
+                return s
+            denom = jnp.maximum(lens, 1).astype(vals.dtype)
+            denom = denom.reshape((B,) + (1,) * (s.ndim - 1))
+            if pt == "mean":
+                return s / denom
+            return s / jnp.sqrt(denom)
+        if pt == "max":
+            neg = jnp.finfo(vals.dtype).min if jnp.issubdtype(
+                vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).min
+            return jnp.max(jnp.where(m, vals, neg), axis=1)
+        if pt == "min":
+            pos_ = jnp.finfo(vals.dtype).max if jnp.issubdtype(
+                vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).max
+            return jnp.min(jnp.where(m, vals, pos_), axis=1)
+        if pt == "first":
+            return vals[:, 0]
+        if pt == "last":
+            idx = jnp.maximum(lens - 1, 0)
+            return jnp.take_along_axis(
+                vals, idx.reshape((B,) + (1,) * (vals.ndim - 1)), axis=1
+            )[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    return AG.apply(f, (x, lengths), name="sequence_pool")
+
+
+def sequence_first_step(x, lengths, name=None):
+    return sequence_pool(x, "first", lengths)
+
+
+def sequence_last_step(x, lengths, name=None):
+    return sequence_pool(x, "last", lengths)
+
+
+def sequence_softmax(x, lengths, name=None):
+    """sequence_softmax_op.cc: softmax over each row's valid prefix;
+    padded positions get 0."""
+    x, lengths = as_tensor(x), as_tensor(lengths)
+
+    def f(vals, lens):
+        T = vals.shape[1]
+        mask = _mask_for(vals.shape, lens, T)
+        mask = mask.reshape(mask.shape + (1,) * (vals.ndim - 2))
+        neg = jnp.asarray(-1e30, vals.dtype)
+        z = jnp.where(mask, vals, neg)
+        z = z - jax.lax.stop_gradient(jnp.max(z, axis=1, keepdims=True))
+        e = jnp.exp(z) * mask.astype(vals.dtype)
+        return e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-30)
+
+    return AG.apply(f, (x, lengths), name="sequence_softmax")
+
+
+def sequence_reverse(x, lengths, name=None):
+    """sequence_reverse_op.h: reverse each row's valid prefix, keep the
+    padding in place."""
+    x, lengths = as_tensor(x), as_tensor(lengths)
+
+    def f(vals, lens):
+        T = vals.shape[1]
+        pos = jnp.arange(T)
+        rev = lens[:, None] - 1 - pos[None, :]          # [B, T]
+        idx = jnp.where(pos[None, :] < lens[:, None], rev, pos[None, :])
+        idx = jnp.clip(idx, 0, T - 1)
+        return jnp.take_along_axis(
+            vals, idx.reshape(idx.shape + (1,) * (vals.ndim - 2)), axis=1
+        )
+
+    return AG.apply(f, (x, lengths), name="sequence_reverse")
+
+
+def sequence_conv(x, weight, lengths, context_length, context_start=None,
+                  bias=None, name=None):
+    """sequence_conv_op in padded form: a context-window projection.
+
+    x [B, T, D]; weight [context_length * D, M]; positions outside the
+    row's valid prefix (and outside [0, T)) contribute zeros, matching
+    the reference's im2col over sequence boundaries
+    (operators/sequence_ops/sequence_conv_op.h ContextProjection)."""
+    x, weight, lengths = as_tensor(x), as_tensor(weight), as_tensor(lengths)
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    cs = int(context_start)
+    cl = int(context_length)
+
+    def f(vals, w, lens, *b):
+        B, T, D = vals.shape
+        mask = _mask_for(vals.shape, lens, T)[..., None]  # [B, T, 1]
+        masked = jnp.where(mask, vals, 0)
+        cols = []
+        pos = jnp.arange(T)
+        for k in range(cl):
+            off = cs + k
+            idx = jnp.clip(pos + off, 0, T - 1)
+            shifted = masked[:, idx]
+            ok = ((pos + off >= 0) & (pos + off < T))[None, :, None]
+            # also zero context rows beyond the row's own length
+            ok = ok & (pos[None, :, None] + off < lens[:, None, None])
+            cols.append(jnp.where(ok, shifted, 0))
+        ctx = jnp.concatenate(cols, axis=-1)           # [B, T, cl*D]
+        out = jnp.einsum("btc,cm->btm", ctx, w.astype(ctx.dtype))
+        if b:
+            out = out + b[0]
+        return jnp.where(mask, out, 0)
+
+    args = (x, weight, lengths) + ((bias,) if bias is not None else ())
+    return AG.apply(f, args, name="sequence_conv")
+
+
+def sequence_expand(x, lengths, name=None):
+    """sequence_expand_op: repeat row i of x `lengths[i]` times into a
+    concatenated [sum(lengths), ...] tensor. The output row count is
+    data-dependent, so lengths must be host-concrete (eager / outside
+    jit), like sequence_unpad."""
+    import numpy as np
+
+    x, lengths = as_tensor(x), as_tensor(lengths)
+    lens = np.asarray(jax.device_get(lengths._data)).astype(np.int64)
+
+    def f(vals):
+        return jnp.repeat(
+            vals, jnp.asarray(lens), axis=0,
+            total_repeat_length=int(lens.sum()),
+        )
+
+    return AG.apply(f, (x,), name="sequence_expand")
+
+
+def sequence_slice(x, offset, length, lengths=None, name=None):
+    """sequence_slice_op: per-row slice [offset[i], offset[i]+length[i])
+    of the valid prefix. Output is padded to max(length) with new
+    lengths returned: (sliced, out_lengths)."""
+    import numpy as np
+
+    x, offset, length = as_tensor(x), as_tensor(offset), as_tensor(length)
+    max_out = int(np.asarray(jax.device_get(length._data)).max())
+
+    def f(vals, off, ln):
+        T = vals.shape[1]
+        pos = jnp.arange(max_out)
+        idx = off[:, None] + pos[None, :]
+        valid = pos[None, :] < ln[:, None]
+        idx = jnp.clip(idx, 0, T - 1)
+        out = jnp.take_along_axis(
+            vals, idx.reshape(idx.shape + (1,) * (vals.ndim - 2)), axis=1
+        )
+        m = valid.reshape(valid.shape + (1,) * (vals.ndim - 2))
+        return jnp.where(m, out, 0)
+
+    out = AG.apply(f, (x, offset, length), name="sequence_slice")
+    return out, length
+
+
+def sequence_enumerate(x, win_size, pad_value=0, lengths=None, name=None):
+    """sequence_enumerate_op: [B, T] ids -> [B, T, win_size] sliding
+    windows; positions past the row end (or T) fill with pad_value."""
+    x = as_tensor(x)
+    args = (x,) if lengths is None else (x, as_tensor(lengths))
+
+    def f(ids, *ln):
+        B, T = ids.shape
+        pos = jnp.arange(T)
+        lens = ln[0] if ln else jnp.full((B,), T, jnp.int32)
+        wins = []
+        for k in range(win_size):
+            idx = jnp.clip(pos + k, 0, T - 1)
+            v = ids[:, idx]
+            ok = (pos[None, :] + k < lens[:, None])
+            wins.append(jnp.where(ok, v, jnp.asarray(pad_value, ids.dtype)))
+        return jnp.stack(wins, axis=-1)
+
+    return AG.apply_nondiff(f, args)

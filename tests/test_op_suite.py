@@ -637,3 +637,217 @@ def test_loss_functional_grads():
         [A(4, 1), (np.arange(4) % 2).reshape(4, 1).astype(np.float32)],
         wrt=[0],
     )
+
+
+# ---------------------------------------------------------------------------
+# round-5 sequence-op tail (padded-dense LoD policy, VERDICT r4 missing #4)
+# ---------------------------------------------------------------------------
+
+
+def _mask(lens, T):
+    return np.arange(T)[None, :] < np.asarray(lens)[:, None]
+
+
+def test_sequence_pool_types():
+    rng = np.random.RandomState(0)
+    x = rng.rand(3, 5, 4).astype(np.float32)
+    lens = np.array([5, 2, 3], np.int64)
+    m = _mask(lens, 5)[..., None]
+
+    refs = {
+        "sum": (x * m).sum(1),
+        "average": (x * m).sum(1) / lens[:, None],
+        "sqrt": (x * m).sum(1) / np.sqrt(lens)[:, None],
+        "max": np.where(m, x, -np.inf).max(1),
+        "min": np.where(m, x, np.inf).min(1),
+        "first": x[:, 0],
+        "last": x[np.arange(3), lens - 1],
+    }
+    for pt, want in refs.items():
+        got = P.sequence_pool(P.to_tensor(x), pt, P.to_tensor(lens))
+        np.testing.assert_allclose(got.numpy(), want.astype(np.float32),
+                                   rtol=1e-5, atol=1e-6, err_msg=pt)
+    check_grad(
+        lambda v: P.sequence_pool(v, "mean", P.to_tensor(lens)), [x]
+    )
+
+
+def test_sequence_softmax():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 4).astype(np.float32)
+    lens = np.array([4, 2], np.int64)
+    got = P.sequence_softmax(P.to_tensor(x), P.to_tensor(lens)).numpy()
+    for i, l in enumerate(lens):
+        e = np.exp(x[i, :l] - x[i, :l].max())
+        np.testing.assert_allclose(got[i, :l], e / e.sum(), rtol=1e-5)
+        assert (got[i, l:] == 0).all()
+    np.testing.assert_allclose(got.sum(1), 1.0, rtol=1e-5)
+    check_grad(
+        lambda v: P.sequence_softmax(v, P.to_tensor(lens)), [x]
+    )
+
+
+def test_sequence_reverse():
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    lens = np.array([4, 2], np.int64)
+    got = P.sequence_reverse(P.to_tensor(x), P.to_tensor(lens)).numpy()
+    np.testing.assert_array_equal(got[0], x[0, ::-1])
+    np.testing.assert_array_equal(got[1, :2], x[1, 1::-1])
+    np.testing.assert_array_equal(got[1, 2:], x[1, 2:])  # padding stays
+    check_grad(
+        lambda v: P.sequence_reverse(v, P.to_tensor(lens)), [x]
+    )
+
+
+def test_sequence_conv():
+    rng = np.random.RandomState(2)
+    B, T, D, M, CL = 2, 5, 3, 4, 3
+    x = rng.rand(B, T, D).astype(np.float32)
+    w = rng.rand(CL * D, M).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+
+    # numpy ref: context window [-1, 0, 1] rows (context_start = -1)
+    ref = np.zeros((B, T, M), np.float32)
+    for b in range(B):
+        for t in range(T):
+            if t >= lens[b]:
+                continue
+            ctx = []
+            for k in range(CL):
+                p = t - 1 + k
+                if 0 <= p < lens[b]:
+                    ctx.append(x[b, p])
+                else:
+                    ctx.append(np.zeros(D, np.float32))
+            ref[b, t] = np.concatenate(ctx) @ w
+    got = P.sequence_conv(P.to_tensor(x), P.to_tensor(w),
+                          P.to_tensor(lens), context_length=CL).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    check_grad(
+        lambda v, ww: P.sequence_conv(v, ww, P.to_tensor(lens),
+                                      context_length=CL), [x, w]
+    )
+
+
+def test_sequence_expand_slice_enumerate():
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    lens = np.array([2, 0, 3], np.int64)
+    got = P.sequence_expand(P.to_tensor(x), P.to_tensor(lens)).numpy()
+    np.testing.assert_array_equal(got, np.repeat(x, lens, axis=0))
+
+    xs = np.arange(20, dtype=np.float32).reshape(2, 10)
+    off = np.array([1, 4], np.int64)
+    ln = np.array([3, 2], np.int64)
+    sl, out_lens = P.sequence_slice(P.to_tensor(xs), P.to_tensor(off),
+                                    P.to_tensor(ln))
+    np.testing.assert_array_equal(sl.numpy()[0], xs[0, 1:4])
+    np.testing.assert_array_equal(sl.numpy()[1, :2], xs[1, 4:6])
+    assert sl.numpy()[1, 2] == 0  # padded
+
+    ids = np.array([[1, 2, 3, 4]], np.int64)
+    win = P.sequence_enumerate(P.to_tensor(ids), 2, pad_value=0).numpy()
+    np.testing.assert_array_equal(
+        win[0], [[1, 2], [2, 3], [3, 4], [4, 0]]
+    )
+
+
+# ---------------------------------------------------------------------------
+# round-5 detection-op tail
+# ---------------------------------------------------------------------------
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    from paddle_tpu.vision.ops import iou_similarity
+
+    got = iou_similarity(P.to_tensor(a), P.to_tensor(b)).numpy()
+    # IoU(a0,b0)=1; IoU(a0,b1)=0; IoU(a1,b0)=1/7; IoU(a1,b1)=1/7
+    np.testing.assert_allclose(
+        got, [[1.0, 0.0], [1 / 7, 1 / 7]], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_prior_box_single_cell():
+    from paddle_tpu.vision.ops import prior_box
+
+    feat = np.zeros((1, 8, 1, 1), np.float32)
+    img = np.zeros((1, 3, 100, 100), np.float32)
+    boxes, var = prior_box(P.to_tensor(feat), P.to_tensor(img),
+                           min_sizes=[40.0], aspect_ratios=[1.0])
+    # one cell centered at 50,50 with a 40x40 box, normalized by 100
+    np.testing.assert_allclose(
+        boxes.numpy()[0, 0, 0], [0.3, 0.3, 0.7, 0.7], rtol=1e-5
+    )
+    np.testing.assert_allclose(var.numpy()[0, 0, 0],
+                               [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
+
+
+def test_box_coder_roundtrip():
+    from paddle_tpu.vision.ops import box_coder
+
+    rng = np.random.RandomState(3)
+    priors = np.array([[0.1, 0.1, 0.5, 0.5], [0.4, 0.2, 0.9, 0.8]],
+                      np.float32)
+    pvar = np.full((2, 4), 0.1, np.float32)
+    targets = np.array([[0.2, 0.2, 0.6, 0.7]], np.float32)
+    enc = box_coder(P.to_tensor(priors), P.to_tensor(pvar),
+                    P.to_tensor(targets), "encode_center_size")
+    dec = box_coder(P.to_tensor(priors), P.to_tensor(pvar), enc,
+                    "decode_center_size")
+    got = dec.numpy()  # [1, 2, 4]: decoding the encoding restores target
+    for m in range(2):
+        np.testing.assert_allclose(got[0, m], targets[0], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_roi_align_constant_and_grad():
+    from paddle_tpu.vision.ops import roi_align
+
+    # constant feature map -> every roi bin equals the constant
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    boxes = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+    out = roi_align(P.to_tensor(x), P.to_tensor(boxes),
+                    P.to_tensor(np.array([1], np.int32)), output_size=2)
+    assert out.shape == [1, 2, 2, 2]
+    np.testing.assert_allclose(out.numpy(), 3.5, rtol=1e-6)
+
+    # linear ramp in x: bin means must increase left->right
+    ramp = np.tile(np.arange(8, dtype=np.float32)[None, None, None, :],
+                   (1, 1, 8, 1))
+    out = roi_align(P.to_tensor(ramp), P.to_tensor(boxes),
+                    P.to_tensor(np.array([1], np.int32)),
+                    output_size=2).numpy()
+    assert (out[0, 0, :, 1] > out[0, 0, :, 0]).all()
+
+    rng = np.random.RandomState(5)
+    feat = rng.rand(1, 2, 8, 8).astype(np.float32)
+    check_grad(
+        lambda v: roi_align(v, P.to_tensor(boxes),
+                            P.to_tensor(np.array([1], np.int32)),
+                            output_size=2),
+        [feat],
+    )
+
+
+def test_multiclass_nms_suppression():
+    from paddle_tpu.vision.ops import multiclass_nms
+
+    # 3 boxes: two heavily overlapping (scores .9/.8), one separate (.7)
+    boxes = np.array([[
+        [0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5], [20, 20, 30, 30],
+    ]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]   # class 1 (class 0 = background)
+    out, counts = multiclass_nms(
+        P.to_tensor(boxes), P.to_tensor(scores),
+        score_threshold=0.05, nms_top_k=3, keep_top_k=3,
+        nms_threshold=0.5, background_label=0,
+    )
+    out = out.numpy()[0]
+    assert int(counts.numpy()[0]) == 2  # the .8 box is suppressed
+    kept = out[out[:, 0] >= 0]
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                               [0.9, 0.7], rtol=1e-6)
+    # the suppressed overlapping box is absent
+    assert not any(abs(row[2] - 0.5) < 1e-6 for row in kept)
