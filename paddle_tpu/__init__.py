@@ -85,3 +85,4 @@ def in_dynamic_mode() -> bool:
 from . import text  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import utils  # noqa: F401,E402

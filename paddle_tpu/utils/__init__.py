@@ -1,2 +1,3 @@
-"""paddle_tpu.utils — developer tooling (op benchmarking, perf analysis)."""
-from . import op_bench  # noqa: F401
+"""paddle_tpu.utils — developer tooling (custom ops, op benchmarking)."""
+from . import custom_op, op_bench  # noqa: F401
+from .custom_op import register_op  # noqa: F401

