@@ -60,31 +60,15 @@ def build_cluster_env(nproc: int, ips: str = "127.0.0.1",
     return envs
 
 
-def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
-           ips: str = "127.0.0.1", start_port: int = 6170,
-           backend: str = None, node_rank: int = None) -> int:
-    """Spawn THIS node's ranks and babysit them (launch_collective :208).
-
-    `node_rank` selects which host of `ips` this invocation is (default
-    env PADDLE_NODE_RANK, else 0); only that host's ranks spawn here —
-    remote hosts run the same command with their own node_rank. Returns
-    the first non-zero exit code (0 on full success); on any failure the
-    remaining ranks are terminated (the watch-loop teardown).
-    """
-    if node_rank is None:
-        node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
-    hosts = [h.strip() for h in ips.split(",") if h.strip()]
-    if not 0 <= node_rank < len(hosts):
-        raise ValueError(
-            f"node_rank {node_rank} out of range for {len(hosts)} hosts"
-        )
-    envs = build_cluster_env(nproc_per_node, ips=ips, start_port=start_port)
-    lo = node_rank * nproc_per_node
-    envs = envs[lo:lo + nproc_per_node]
+def _run_once(script, script_args, envs, backend, attempt) -> int:
+    """Spawn the ranks once and babysit them (TrainerProc watch loop,
+    launch_utils.py:996-1118). Returns the first non-zero exit code."""
     procs = []
     for env in envs:
+        env = dict(env)
         if backend:
             env["JAX_PLATFORM_NAME"] = backend
+        env["PADDLE_LAUNCH_ATTEMPT"] = str(attempt)
         p = subprocess.Popen(
             [sys.executable, script] + list(script_args), env=env
         )
@@ -116,6 +100,50 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
     return rc
 
 
+def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
+           ips: str = "127.0.0.1", start_port: int = 6170,
+           backend: str = None, node_rank: int = None,
+           elastic_retries: int = 0) -> int:
+    """Spawn THIS node's ranks and babysit them (launch_collective :208).
+
+    `node_rank` selects which host of `ips` this invocation is (default
+    env PADDLE_NODE_RANK, else 0); only that host's ranks spawn here —
+    remote hosts run the same command with their own node_rank. Returns
+    the first non-zero exit code (0 on full success); on any failure the
+    remaining ranks are terminated (the watch-loop teardown).
+
+    `elastic_retries` > 0 is the fault-tolerance policy (the elastic
+    restart of launch_utils.py watch_local_trainers + ElasticManager):
+    after a failed attempt the WHOLE job relaunches — scripts resume
+    from their auto-checkpoint (incubate.checkpoint.TrainEpochRange) so a
+    preempted/crashed rank costs at most the epochs since the last
+    snapshot. Children see the attempt index in PADDLE_LAUNCH_ATTEMPT.
+    """
+    if node_rank is None:
+        node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
+    hosts = [h.strip() for h in ips.split(",") if h.strip()]
+    if not 0 <= node_rank < len(hosts):
+        raise ValueError(
+            f"node_rank {node_rank} out of range for {len(hosts)} hosts"
+        )
+    envs = build_cluster_env(nproc_per_node, ips=ips, start_port=start_port)
+    lo = node_rank * nproc_per_node
+    envs = envs[lo:lo + nproc_per_node]
+    rc = 0
+    for attempt in range(int(elastic_retries) + 1):
+        rc = _run_once(script, script_args, envs, backend, attempt)
+        if rc == 0:
+            return 0
+        if attempt < elastic_retries:
+            print(
+                f"paddle_tpu.launch: attempt {attempt} failed rc={rc}; "
+                f"relaunching ({elastic_retries - attempt} retries left)",
+                file=sys.stderr,
+            )
+            time.sleep(0.5)
+    return rc
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="paddle_tpu.distributed.launch",
@@ -130,13 +158,16 @@ def main(argv=None):
                         default=int(os.environ.get("PADDLE_PORT", 6170)))
     parser.add_argument("--backend", type=str, default=None,
                         help="force a jax backend in children (e.g. cpu)")
+    parser.add_argument("--elastic_retries", type=int, default=0,
+                        help="relaunch the whole job up to N times after "
+                             "a failure (auto-checkpoint resumes)")
     parser.add_argument("script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     rc = launch(
         args.script, args.script_args, nproc_per_node=args.nproc_per_node,
         ips=args.ips, start_port=args.start_port, backend=args.backend,
-        node_rank=args.node_rank,
+        node_rank=args.node_rank, elastic_retries=args.elastic_retries,
     )
     sys.exit(rc)
 

@@ -1,0 +1,52 @@
+"""Fault-tolerance E2E helper: deterministic training under
+TrainEpochRange that crashes at a chosen epoch on the first launch
+attempt. Run via paddle_tpu.distributed.launch with --elastic_retries.
+
+Env:
+  ACP_LOG         path to append one JSON line per epoch
+  ACP_CRASH_EPOCH epoch at which attempt 0 exits(17) BEFORE finishing
+  PADDLE_LAUNCH_ATTEMPT  set by the launcher
+"""
+import json
+import os
+import sys
+
+from paddle_tpu.core.device import force_cpu_devices
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import (  # noqa: E402
+    TrainEpochRange,
+)
+
+EPOCHS = 6
+attempt = int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0"))
+crash_epoch = int(os.environ.get("ACP_CRASH_EPOCH", "-1"))
+log_path = os.environ["ACP_LOG"]
+
+paddle.seed(0)
+model = nn.Linear(4, 4)
+opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+rng = np.random.RandomState(0)
+data = [rng.rand(8, 4).astype(np.float32) for _ in range(EPOCHS)]
+
+r = TrainEpochRange(EPOCHS, name="acp_e2e")
+r.register(model=model, optimizer=opt)
+for epoch in r.get():
+    if attempt == 0 and epoch == crash_epoch:
+        sys.exit(17)  # simulated preemption BEFORE this epoch trains
+    x = paddle.to_tensor(data[epoch])
+    loss = ((model(x) - 1.0) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    with open(log_path, "a") as f:
+        f.write(json.dumps({
+            "attempt": attempt, "epoch": epoch,
+            "restored_from": r._restored_epoch,
+            "loss": float(loss.numpy()),
+        }) + "\n")
