@@ -46,6 +46,7 @@ _RT = {
     "__ptu_while__": convert_ops.convert_while_loop,
     "__ptu_len__": convert_ops.convert_len,
     "__ptu_getitem__": convert_ops.convert_getitem,
+    "__ptu_to_seq__": convert_ops.convert_to_sequence,
     "__ptu_and__": convert_ops.convert_logical_and,
     "__ptu_or__": convert_ops.convert_logical_or,
     "__ptu_not__": convert_ops.convert_logical_not,
@@ -420,7 +421,8 @@ class _Converter(ast.NodeTransformer):
                                     value=_name(i_)), node)]
         else:
             prologue.append(_loc(ast.Assign(
-                targets=[_name(seq, ast.Store())], value=node.iter
+                targets=[_name(seq, ast.Store())],
+                value=_call_rt("__ptu_to_seq__", node.iter),
             ), node))
             prologue.append(_loc(ast.Assign(
                 targets=[_name(n_, ast.Store())],

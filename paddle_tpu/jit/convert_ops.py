@@ -143,6 +143,14 @@ def convert_len(seq):
         return len(list(seq))
 
 
+def convert_to_sequence(it):
+    """Materialize a for-loop iterable into something indexable (tensors
+    and sequences pass through; views/generators become lists)."""
+    if isinstance(it, Tensor) or hasattr(it, "__getitem__"):
+        return it
+    return list(it)
+
+
 def convert_getitem(seq, i):
     if isinstance(seq, (list, tuple)) and isinstance(i, Tensor):
         raise TypeError(
