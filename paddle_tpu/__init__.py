@@ -86,3 +86,4 @@ from . import text  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
+from . import device  # noqa: F401,E402

@@ -47,6 +47,72 @@ def _proc_worker_fetch(indices):
     return _PROC_STATE["collate"]([ds[i] for i in indices])
 
 
+# Shared-memory return transport (reference: the use_shared_memory path of
+# fluid/dataloader/dataloader_iter.py — workers place batch arrays in
+# /dev/shm segments and send only metadata through the result pipe,
+# instead of pickling megabytes of batch data through it).
+_SHM_MIN_BYTES = 1 << 16  # small arrays pickle cheaper than a shm segment
+
+
+def _shm_encode(obj):
+    import numpy as _np
+
+    if isinstance(obj, _np.ndarray) and obj.nbytes >= _SHM_MIN_BYTES:
+        from multiprocessing import resource_tracker, shared_memory
+
+        arr = _np.ascontiguousarray(obj)
+        shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+        _np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)[...] = arr
+        name = shm.name
+        shm.close()
+        # the PARENT owns the segment's lifetime (it unlinks after the
+        # device transfer); stop this process's resource tracker from
+        # unlinking it again at worker exit
+        try:
+            resource_tracker.unregister("/" + name, "shared_memory")
+        except Exception:
+            pass
+        return ("__shm__", name, arr.shape, str(arr.dtype))
+    if isinstance(obj, tuple):
+        return tuple(_shm_encode(o) for o in obj)
+    if isinstance(obj, list):
+        return [_shm_encode(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _shm_encode(v) for k, v in obj.items()}
+    return obj
+
+
+def _shm_decode(obj):
+    import numpy as _np
+
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        from multiprocessing import shared_memory
+
+        _, name, shape, dtype = obj
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            view = _np.ndarray(shape, _np.dtype(dtype), buffer=shm.buf)
+            out = _np.array(view)  # own the data before freeing the block
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return out
+    if isinstance(obj, tuple):
+        return tuple(_shm_decode(o) for o in obj)
+    if isinstance(obj, list):
+        return [_shm_decode(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _shm_decode(v) for k, v in obj.items()}
+    return obj
+
+
+def _proc_worker_fetch_shm(indices):
+    return _shm_encode(_proc_worker_fetch(indices))
+
+
 def default_collate_fn(batch):
     """Stack samples into batch arrays (reference:
     fluid/dataloader/collate.py default_collate_fn)."""
@@ -240,10 +306,19 @@ class DataLoader:
 
         def submit(indices):
             if is_proc:
-                return pool.submit(_proc_worker_fetch, list(indices))
+                return pool.submit(_proc_worker_fetch_shm, list(indices))
             return pool.submit(self._fetch, indices)
 
         stop = threading.Event()
+
+        def reap(fut):
+            """Cancel a pending fetch; if it already completed, decode its
+            shm descriptors so the segments are unlinked, not leaked."""
+            if not fut.cancel() and is_proc:
+                try:
+                    _shm_decode(fut.result(timeout=5))
+                except Exception:
+                    pass
 
         def put_or_cancel(item):
             """Blocking put that aborts when the consumer is gone — the
@@ -255,7 +330,7 @@ class DataLoader:
                 except queue.Full:
                     continue
             if item is not sentinel and hasattr(item, "cancel"):
-                item.cancel()
+                reap(item)
             return False
 
         def producer():
@@ -270,7 +345,7 @@ class DataLoader:
                             break
                 for f in futures:
                     if stop.is_set():
-                        f.cancel()
+                        reap(f)
                     else:
                         put_or_cancel(f)
             finally:
@@ -283,7 +358,10 @@ class DataLoader:
                 item = q.get()
                 if item is sentinel:
                     break
-                yield _to_tensor_tree(item.result())
+                out = item.result()
+                if is_proc:
+                    out = _shm_decode(out)
+                yield _to_tensor_tree(out)
         finally:
             # early break: stop the producer and cancel queued fetches so
             # a persistent pool is clean for the next epoch; q is drained
@@ -295,7 +373,7 @@ class DataLoader:
                 except queue.Empty:
                     break
                 if item is not sentinel:
-                    item.cancel()
+                    reap(item)
             if pool is not self._pool:
                 pool.shutdown(wait=False, cancel_futures=True)
 

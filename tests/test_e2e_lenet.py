@@ -74,3 +74,62 @@ def test_dataloader_multiworker_prefetch():
     # same content as sync path (order preserved)
     sync = list(DataLoader(ds, batch_size=16))
     np.testing.assert_allclose(batches[0][0].numpy(), sync[0][0].numpy())
+
+
+def test_dataloader_shared_memory_worker_transport():
+    """Round 5 (VERDICT r4 component #3): process workers return batches
+    through /dev/shm segments (metadata-only result pipe), parity with
+    the sync path, segments freed after consumption."""
+    import glob
+
+    from paddle_tpu.io.dataloader import _shm_decode, _shm_encode
+
+    # codec roundtrip incl. nesting and the small-array pickle path
+    rng = np.random.RandomState(0)
+    big = rng.rand(256, 256).astype(np.float32)     # > threshold -> shm
+    small = rng.rand(4).astype(np.float32)          # < threshold -> inline
+    tree = {"a": big, "b": (small, 7)}
+    before = set(glob.glob("/dev/shm/psm_*"))
+    dec = _shm_decode(_shm_encode(tree))
+    np.testing.assert_array_equal(dec["a"], big)
+    np.testing.assert_array_equal(dec["b"][0], small)
+    assert dec["b"][1] == 7
+    assert set(glob.glob("/dev/shm/psm_*")) == before  # nothing leaked
+
+    class _Rows:
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            r = np.random.RandomState(i)
+            return r.rand(64, 64).astype(np.float32), i % 10
+
+    ds = _Rows()
+    got = [
+        (b[0].numpy(), b[1].numpy())
+        for b in DataLoader(ds, batch_size=16, num_workers=2,
+                            use_shared_memory=True)
+    ]
+    ref = [
+        (b[0].numpy(), b[1].numpy())
+        for b in DataLoader(ds, batch_size=16)
+    ]
+    assert len(got) == len(ref) == 4
+    for (gx, gy), (rx, ry) in zip(got, ref):
+        np.testing.assert_array_equal(gx, rx)
+        np.testing.assert_array_equal(gy, ry)
+    assert set(glob.glob("/dev/shm/psm_*")) == before
+
+
+def test_device_memory_budget_surface():
+    import paddle_tpu as paddle
+
+    stats = paddle.device.memory_stats()
+    # CPU backend reports no stats; the API shape is what is pinned here
+    assert isinstance(stats, dict)
+    assert paddle.device.memory_allocated() >= 0
+    assert paddle.device.max_memory_allocated() >= 0
+    assert paddle.device.memory_reserved() >= 0
+    assert paddle.device.device_count() >= 1
+    assert paddle.device.cuda.device_count() >= 1
+    paddle.device.cuda.empty_cache()
