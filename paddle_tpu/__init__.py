@@ -87,3 +87,4 @@ from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402

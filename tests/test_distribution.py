@@ -1,0 +1,81 @@
+"""paddle.distribution parity (reference: python/paddle/distribution.py;
+tests modeled on unittests/test_distribution.py numeric checks against
+scipy-style closed forms)."""
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+def test_uniform():
+    u = Uniform(1.0, 3.0)
+    s = u.sample([1000], seed=7).numpy()
+    assert s.shape == (1000,)
+    assert (s >= 1.0).all() and (s < 3.0).all()
+    np.testing.assert_allclose(u.entropy().numpy(), math.log(2.0),
+                               rtol=1e-6)
+    lp = u.log_prob(paddle.to_tensor(np.array([2.0, 5.0], np.float32)))
+    np.testing.assert_allclose(lp.numpy()[0], -math.log(2.0), rtol=1e-6)
+    assert lp.numpy()[1] == -np.inf
+    np.testing.assert_allclose(
+        u.probs(paddle.to_tensor(np.float32(2.0))).numpy(), 0.5, rtol=1e-6
+    )
+
+
+def test_normal():
+    n = Normal(1.0, 2.0)
+    s = n.sample([4000], seed=3).numpy()
+    assert abs(s.mean() - 1.0) < 0.15 and abs(s.std() - 2.0) < 0.15
+    np.testing.assert_allclose(
+        n.entropy().numpy(),
+        0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0), rtol=1e-6,
+    )
+    v = np.array([1.0, 3.0], np.float32)
+    lp = n.log_prob(paddle.to_tensor(v)).numpy()
+    ref = -((v - 1.0) ** 2) / 8.0 - math.log(2.0) - 0.5 * math.log(
+        2 * math.pi)
+    np.testing.assert_allclose(lp, ref, rtol=1e-5)
+    np.testing.assert_allclose(
+        n.probs(paddle.to_tensor(v)).numpy(), np.exp(ref), rtol=1e-5
+    )
+    # KL(N0||N1) closed form
+    n2 = Normal(0.0, 1.0)
+    kl = n.kl_divergence(n2).numpy()
+    ref_kl = 0.5 * (4.0 + 1.0) - 0.5 - math.log(2.0)
+    np.testing.assert_allclose(kl, ref_kl, rtol=1e-5)
+    # log_prob differentiates (policy-gradient use)
+    t = paddle.to_tensor(v)
+    t.stop_gradient = False
+    n.log_prob(t).sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), -(v - 1.0) / 4.0,
+                               rtol=1e-5)
+
+
+def test_categorical_weight_semantics():
+    w = np.array([1.0, 2.0, 1.0], np.float32)  # reference: weights
+    c = Categorical(paddle.to_tensor(w))
+    np.testing.assert_allclose(
+        c.probs(paddle.to_tensor(np.array([0, 1, 2]))).numpy(),
+        [0.25, 0.5, 0.25], rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        c.log_prob(paddle.to_tensor(np.array([1]))).numpy(),
+        [math.log(0.5)], rtol=1e-6,
+    )
+    p = np.array([0.25, 0.5, 0.25])
+    np.testing.assert_allclose(
+        c.entropy().numpy(), -(p * np.log(p)).sum(), rtol=1e-6
+    )
+    c2 = Categorical(paddle.to_tensor(np.array([1.0, 1.0, 2.0],
+                                               np.float32)))
+    q = np.array([0.25, 0.25, 0.5])
+    np.testing.assert_allclose(
+        c.kl_divergence(c2).numpy(), (p * np.log(p / q)).sum(), rtol=1e-5
+    )
+    paddle.seed(11)
+    s = c.sample([2000]).numpy()
+    assert s.shape == (2000,)
+    freq = np.bincount(s, minlength=3) / 2000.0
+    np.testing.assert_allclose(freq, p, atol=0.05)
