@@ -88,3 +88,6 @@ from . import inference  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from .batch import batch  # noqa: F401,E402

@@ -1,0 +1,21 @@
+"""paddle.onnx parity surface.
+
+Reference: python/paddle/onnx/export.py (paddle.onnx.export via
+paddle2onnx). ONNX targets CUDA/CPU inference runtimes; the TPU-native
+serialization is StableHLO — `paddle_tpu.jit.save` produces a
+`jax.export` artifact that `paddle_tpu.inference.Predictor` (and any
+PJRT runtime) loads. This module keeps the API name resolvable and
+points callers at that path instead of failing with AttributeError."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "paddle.onnx.export targets the onnxruntime/CUDA deployment "
+        "stack; the TPU deployment artifact is StableHLO — use "
+        "paddle_tpu.jit.save(layer, path, input_spec=...) and load it "
+        "with paddle_tpu.inference.Config/Predictor (or any PJRT "
+        "runtime)"
+    )

@@ -1,0 +1,21 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py) — install
+introspection for build tooling (the custom-op SDK's compile helpers)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the native sources/headers (staging.cpp lives here —
+    the TPU build has no C++ op headers to export beyond it)."""
+    return os.path.join(_ROOT, "native")
+
+
+def get_lib() -> str:
+    """Directory holding the compiled native library (built lazily by
+    paddle_tpu.native on first use)."""
+    return os.path.join(_ROOT, "native")

@@ -256,3 +256,35 @@ class TestDistributedBatchSampler:
         s.epoch = 1
         c = [i for bt in s for i in bt]
         assert a != c
+
+
+class TestNamespaceParity:
+    """Round-5 namespace tail: paddle.batch / sysconfig / onnx /
+    distribution / device resolve with the reference semantics."""
+
+    def test_batch_reader(self):
+        import paddle_tpu as paddle
+
+        def reader():
+            yield from range(7)
+
+        out = [b for b in paddle.batch(reader, 3)()]
+        assert out == [[0, 1, 2], [3, 4, 5], [6]]
+        out = [b for b in paddle.batch(reader, 3, drop_last=True)()]
+        assert out == [[0, 1, 2], [3, 4, 5]]
+
+    def test_sysconfig_paths_exist(self):
+        import os
+
+        import paddle_tpu as paddle
+
+        assert os.path.isdir(paddle.sysconfig.get_include())
+        assert os.path.isdir(paddle.sysconfig.get_lib())
+
+    def test_onnx_export_points_to_stablehlo(self):
+        import pytest
+
+        import paddle_tpu as paddle
+
+        with pytest.raises(NotImplementedError, match="StableHLO"):
+            paddle.onnx.export(None, "/tmp/x")
