@@ -99,17 +99,18 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Lookup rows of weight (reference: operators/lookup_table_v2_op.*).
     sparse=True (SelectedRows grads) has no TPU analog — dense grads are
-    correct and XLA scatters them efficiently."""
-    idx = x._data
+    correct and XLA scatters them efficiently. The ids ride as an op
+    INPUT (not a closure capture) so static-graph recording and traced
+    feeds see them."""
 
-    def f(w):
-        out = jnp.take(w, idx, axis=0)
+    def f(w, ids):
+        out = jnp.take(w, ids, axis=0)
         if padding_idx is not None:
-            mask = (idx == padding_idx)[..., None]
+            mask = (ids == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
 
-    return AG.apply(f, (weight,), name="embedding")
+    return AG.apply(f, (weight, x), name="embedding")
 
 
 def one_hot(x, num_classes, name=None):

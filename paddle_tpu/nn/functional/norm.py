@@ -79,8 +79,28 @@ def batch_norm(
         mean_t.stop_gradient = True
         var_t.stop_gradient = True
         # EMA update (paddle: mean = mean*momentum + batch_mean*(1-m)).
-        # set_value is trace-safe: under to_static capture the buffer holds a
-        # traced value which the program wrapper threads out as extra state.
+        if getattr(mean_t, "_static_var", None) is not None:
+            # static-graph recording: the EMA is recorded as ops and the
+            # buffers registered as persistable-state writes the Executor
+            # writes back after each run (the scope-variable update of
+            # batch_norm_op's MeanOut/VarianceOut)
+            from ...static.program import default_main_program
+
+            ema = AG.apply(
+                lambda rm, rv, mt, vt: (
+                    rm * momentum + mt * (1 - momentum),
+                    rv * momentum + vt * (1 - momentum),
+                ),
+                (running_mean, running_var, mean_t, var_t),
+                name="bn_stat_ema",
+            )
+            prog = default_main_program()
+            prog.record_state_write(running_mean, ema[0])
+            prog.record_state_write(running_var, ema[1])
+            return out
+        # eager / jit trace: set_value is trace-safe (under to_static
+        # capture the buffer holds a traced value which the program
+        # wrapper threads out as extra state)
         running_mean.set_value(
             running_mean._data * momentum + mean_t._data * (1 - momentum)
         )

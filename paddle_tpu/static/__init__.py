@@ -30,6 +30,7 @@ def _static_mode_on() -> bool:
     return _STATIC_MODE
 
 
+from . import nn  # noqa: E402,F401
 from .program import (  # noqa: E402,F401
     Program,
     Variable,

@@ -131,15 +131,18 @@ class Executor:
                 for var, o in zip(op.out_vars, outs):
                     env[var.id] = o
             fetches = tuple(resolve(v) for v in fetch_vars)
-            return fetches, env
+            state_vals = tuple(
+                resolve(var) for _, var in program.state_writes
+            )
+            return fetches, env, state_vals
 
         directives = program.optimize_directives
         if not directives:
             def run_fn(p_raws, leaf_raws, feed_raws, rng_raws):
-                return (
-                    replay(p_raws, leaf_raws, feed_raws, rng_raws)[0],
-                    p_raws, (),
+                fetches, _, state_vals = replay(
+                    p_raws, leaf_raws, feed_raws, rng_raws
                 )
+                return fetches, p_raws, (), state_vals
 
             return jax.jit(run_fn), leaves, params, None, rng_vars
 
@@ -153,18 +156,19 @@ class Executor:
 
         def run_fn(p_raws, leaf_raws, feed_raws, rng_raws, opt_state, lr, t):
             def loss_of(p_tuple):
-                fetches, env = replay(p_tuple, leaf_raws, feed_raws,
-                                      rng_raws)
-                return env[loss_var.id], fetches
+                fetches, env, state_vals = replay(
+                    p_tuple, leaf_raws, feed_raws, rng_raws
+                )
+                return env[loss_var.id], (fetches, state_vals)
 
-            (loss, fetches), grads = jax.value_and_grad(
+            (loss, (fetches, state_vals)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(tuple(p_raws))
             grads = process_grads(opt, params, list(p_raws), list(grads))
             new_p, new_state = opt._functional_update(
                 params, list(p_raws), grads, opt_state, lr, t
             )
-            return fetches, new_p, new_state
+            return fetches, new_p, new_state, state_vals
 
         donate = (0, 4) if jax.default_backend() != "cpu" else ()
         return (jax.jit(run_fn, donate_argnums=donate), leaves, params, opt,
@@ -246,13 +250,15 @@ class Executor:
             jax.random.key_data(rnd.next_key()) for _ in rng_vars
         )
         if opt is None:
-            fetches, _, _ = run_fn(p_raws, leaf_raws, feed_raws, rng_raws)
+            fetches, _, _, state_vals = run_fn(
+                p_raws, leaf_raws, feed_raws, rng_raws
+            )
         else:
             opt_state = opt._functional_state(params)
             opt._step_count += 1
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             t = jnp.asarray(opt._step_count, jnp.float32)
-            fetches, new_p, new_state = run_fn(
+            fetches, new_p, new_state, state_vals = run_fn(
                 p_raws, leaf_raws, feed_raws, rng_raws, opt_state, lr, t
             )
             for p, raw in zip(params, new_p):
@@ -260,6 +266,11 @@ class Executor:
                 p._node = None
                 p.grad = None
             opt._load_functional_state(params, new_state)
+        # persistable-state write-back (batch-norm running stats):
+        # updated values land in the LIVE buffer objects after each run
+        for (obj, _), val in zip(program.state_writes, state_vals):
+            obj._data = val
+            obj._node = None
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor._wrap(f, stop_gradient=True) for f in fetches]

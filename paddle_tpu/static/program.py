@@ -80,6 +80,11 @@ class Program:
         self.vars = {}
         # recorded `opt.minimize(loss)` directives: (optimizer, loss_var)
         self.optimize_directives = []
+        # persistable-state writes: (live Tensor, producing Variable) —
+        # the executor fetches the var each run and writes it back into
+        # the live object (the scope-variable update of batch-norm
+        # running stats, executor.cc persistable vars)
+        self.state_writes = []
         self._version = 0
 
     def _add_var(self, var: Variable):
@@ -113,10 +118,18 @@ class Program:
     def list_vars(self):
         return list(self.vars.values())
 
+    def record_state_write(self, tensor, symbolic):
+        var = getattr(symbolic, "_static_var", None)
+        if var is None:
+            raise ValueError("state write source must be symbolic")
+        self.state_writes.append((tensor, var))
+        self._version += 1
+
     def clone(self, for_test=False):
         p = Program()
         p.ops = list(self.ops)
         p.vars = dict(self.vars)
+        p.state_writes = list(self.state_writes)
         if not for_test:
             p.optimize_directives = list(self.optimize_directives)
         return p

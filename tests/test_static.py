@@ -211,3 +211,59 @@ def test_static_interior_vars_report_dynamic_batch(static_mode):
         getattr(w_like, "_static_var").shape
         if hasattr(w_like, "_static_var") else w_like.shape
     ) == (16, 8)
+
+
+def test_static_nn_builders_train_with_bn_stats(static_mode):
+    """paddle.static.nn fluid-style builders (fc/conv2d/batch_norm/
+    embedding) inside a recorded program, incl. the persistable-state
+    write-back of batch-norm running stats (executor.cc scope update)."""
+    from paddle_tpu.static import nn as static_nn
+
+    main, startup = static_mode
+    img = paddle.static.data("img", [-1, 1, 8, 8], "float32")
+    y = paddle.static.data("y", [-1], "int64")
+    h = static_nn.conv2d(img, 4, 3, padding=1, act="relu")
+    h = static_nn.batch_norm(h, act="relu")
+    h = static_nn.fc(h, 10)
+    loss = F.cross_entropy(h, y)
+    optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    # find the BN layer's running-mean buffer through the program leaves
+    bn_buffers = [
+        t for op in main.ops for t in op.inputs
+        if hasattr(t, "_data") and not getattr(t, "trainable", True)
+        and getattr(t, "persistable", True) and t.__class__.__name__ == "Tensor"
+    ]
+    assert main.state_writes, "batch_norm must register stat writes"
+    rm_obj = main.state_writes[0][0]
+    rm_before = np.asarray(rm_obj._data).copy()
+
+    exe = paddle.static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(10):
+        lv, = exe.run(
+            feed={"img": rng.rand(16, 1, 8, 8).astype(np.float32) + 1.0,
+                  "y": rng.randint(0, 10, 16).astype(np.int64)},
+            fetch_list=[loss],
+        )
+        losses.append(float(lv))
+    assert losses[-1] < losses[0]
+    rm_after = np.asarray(rm_obj._data)
+    assert not np.allclose(rm_before, rm_after)  # stats actually moved
+
+
+def test_static_nn_embedding_and_layer_norm(static_mode):
+    from paddle_tpu.static import nn as static_nn
+
+    ids = paddle.static.data("ids", [-1, 5], "int64")
+    emb = static_nn.embedding(ids, size=[20, 8])
+    h = static_nn.layer_norm(emb, begin_norm_axis=2)
+    out = static_nn.fc(h, 3)
+    exe = paddle.static.Executor()
+    vals = exe.run(
+        feed={"ids": np.arange(10).reshape(2, 5).astype(np.int64)},
+        fetch_list=[out],
+    )
+    assert vals[0].shape == (2, 3)
