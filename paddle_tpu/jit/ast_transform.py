@@ -51,6 +51,7 @@ _RT = {
     "__ptu_or__": convert_ops.convert_logical_or,
     "__ptu_not__": convert_ops.convert_logical_not,
     "__ptu_undef__": convert_ops.UNDEFINED,
+    "__ptu_call__": convert_ops.convert_call,
 }
 
 _RET_FLAG = "__ptu_ret_flag__"
@@ -285,6 +286,23 @@ class _Converter(ast.NodeTransformer):
             return _loc(_call_rt("__ptu_not__", node.operand), node)
         return node
 
+    def visit_Call(self, node: ast.Call):
+        """foo(x) -> __ptu_call__(foo)(x): callees convert lazily at call
+        time (convert_operators.py convert_call), so tensor control flow
+        in UNDECORATED helper functions compiles too. Generated __ptu_*
+        runtime calls and super() are left direct."""
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and (
+            f.id.startswith("__ptu_") or f.id == "super"
+        ):
+            return node
+        new = ast.Call(
+            func=_call_rt("__ptu_call__", node.func),
+            args=node.args, keywords=node.keywords,
+        )
+        return _loc(new, node)
+
     # nested defs/lambdas keep their own control flow un-converted (they
     # may run outside the trace; the reference converts callees lazily at
     # call time — out of this subset's scope)
@@ -470,8 +488,9 @@ def convert_to_static(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, ast.FunctionDef):
         return fn
-    if not _contains([fdef], (ast.If, ast.While, ast.For, ast.BoolOp)):
-        return fn  # nothing to convert
+    if not _contains([fdef], (ast.If, ast.While, ast.For, ast.BoolOp,
+                              ast.Call)):
+        return fn  # no control flow and no callees to convert
     if _contains([fdef], (ast.Global, ast.Nonlocal)):
         return fn  # branch-fn extraction would shadow these bindings
     fdef.decorator_list = []

@@ -200,3 +200,46 @@ def convert_logical_not(x):
 
         return logic.logical_not(x)
     return not x
+
+
+# -- recursive callee conversion (convert_operators.py convert_call) --------
+
+_SKIP_MODULE_PREFIXES = (
+    "paddle_tpu", "jax", "numpy", "builtins", "math", "functools",
+    "itertools", "operator", "np",
+)
+_CALL_CACHE = {}
+
+
+def convert_call(fn):
+    """Convert a CALLED function lazily (dygraph_to_static convert_call):
+    plain user functions/methods get the same AST rewrite as the
+    decorated entry point, so tensor control flow in undecorated helpers
+    compiles too. Framework/library callables, classes, Layers and
+    builtins pass through untouched."""
+    from ..nn.layer import Layer
+
+    raw = getattr(fn, "__func__", fn)
+    if not callable(fn) or isinstance(fn, (type, Layer)):
+        return fn
+    if not hasattr(raw, "__code__"):
+        return fn  # builtins / C extensions
+    mod = getattr(raw, "__module__", "") or ""
+    if mod.split(".")[0] in _SKIP_MODULE_PREFIXES:
+        return fn
+    key = id(raw)
+    cached = _CALL_CACHE.get(key)
+    if cached is None:
+        from .ast_transform import convert_to_static
+
+        try:
+            cached = convert_to_static(raw)
+        except Exception:
+            cached = raw
+        _CALL_CACHE[key] = cached
+    if cached is raw:
+        return fn
+    inst = getattr(fn, "__self__", None)
+    if inst is not None:
+        return cached.__get__(inst, type(inst))
+    return cached

@@ -211,3 +211,36 @@ class TestFallbacks:
     def test_unconvertible_source_falls_back(self):
         # builtins have no source: conversion must not explode
         assert convert_to_static(len) is len
+
+
+class TestConvertCall:
+    def test_undecorated_helper_with_tensor_if_converts(self):
+        """convert_call: tensor control flow inside a called, UNDECORATED
+        helper compiles (dygraph_to_static convert_call semantics)."""
+
+        def helper(v):
+            if v.mean() > 0.5:
+                return v * 2
+            return v - 2
+
+        def outer(x):
+            y = helper(x) + 1
+            return y
+
+        sf = to_static(outer)
+        for fill in (0.9, 0.1):
+            arr = np.full((4,), fill, np.float32)
+            got = sf(paddle.to_tensor(arr)).numpy()
+            want = (arr * 2 + 1) if fill > 0.5 else (arr - 2 + 1)
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_library_calls_pass_through(self):
+        def outer(x):
+            s = len(x.shape) + max(1, 2)  # builtins untouched
+            return paddle.abs(x) * s     # framework fns untouched
+
+        sf = to_static(outer)
+        arr = np.array([-1.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            sf(paddle.to_tensor(arr)).numpy(), np.abs(arr) * 3, rtol=1e-6
+        )
