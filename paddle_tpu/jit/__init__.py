@@ -9,6 +9,13 @@ from .train_step import TrainStep  # noqa: F401
 
 
 def not_to_static(fn):
-    """Marker parity shim: function is left eager inside to_static programs
-    (everything traced here is already eager-compatible)."""
+    """Leave `fn` out of dygraph-to-static AST conversion (reference:
+    dygraph_to_static convert_call's not-to-static registry): the marked
+    function runs as plain Python inside to_static programs — tensor
+    control flow in it will NOT be rewritten."""
+    raw = getattr(fn, "__func__", fn)
+    try:
+        raw.__ptu_not_to_static__ = True
+    except (AttributeError, TypeError):
+        pass  # builtins can't carry the mark; they are never converted
     return fn

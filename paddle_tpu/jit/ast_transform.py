@@ -412,6 +412,17 @@ class _Converter(ast.NodeTransformer):
 
     # -- for -> index while --------------------------------------------------
     def visit_For(self, node: ast.For):
+        # `range(x)` detection must look at the ORIGINAL iter expression:
+        # generic_visit wraps calls into __ptu_call__(range)(x), after
+        # which the pattern would never match (and tensor bounds would
+        # reach the python range() eagerly)
+        is_range = (
+            isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and len(node.iter.args) == 1
+            and not node.iter.keywords
+        )
         self.generic_visit(node)
         if node.orelse:
             raise _Unsupported("for/else")
@@ -423,18 +434,20 @@ class _Converter(ast.NodeTransformer):
         # for TARGET in EXPR  ->  seq = EXPR; n = __ptu_len__(seq); i = 0
         #                         while i < n: TARGET = seq[i]; BODY; i += 1
         # `range(x)` iterates indices directly (no getitem).
-        is_range = (
-            isinstance(node.iter, ast.Call)
-            and isinstance(node.iter.func, ast.Name)
-            and node.iter.func.id == "range"
-            and len(node.iter.args) == 1
-            and not node.iter.keywords
-        )
         prologue = []
         if is_range:
+            # after generic_visit the iter may be __ptu_call__(range)(x);
+            # the bound expression is the (possibly transformed) sole arg
             prologue.append(_loc(ast.Assign(
                 targets=[_name(n_, ast.Store())], value=node.iter.args[0]
             ), node))
+            if isinstance(node.target, ast.Name):
+                # the index is a while carry: it needs a pre-loop binding
+                # for the tensor-bound (lax.while_loop) case
+                prologue.append(_loc(ast.Assign(
+                    targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+                    value=_const(0),
+                ), node))
             bind = [_loc(ast.Assign(targets=[node.target],
                                     value=_name(i_)), node)]
         else:
@@ -473,27 +486,37 @@ class _Converter(ast.NodeTransformer):
 # ---------------------------------------------------------------------------
 
 
-def convert_to_static(fn):
-    """program_translator.py:756 convert_to_static. Returns the rewritten
-    function (``fn2.__ptu_converted__ == True``) or `fn` unchanged when
-    conversion is not possible."""
-    raw = getattr(fn, "__func__", fn)
-    if getattr(raw, "__ptu_converted__", False):
-        return fn
+# transformed CODE objects, keyed by the original code object: one entry
+# per source location (closure instances sharing code share the entry),
+# None = conversion not possible. The FUNCTION is rebuilt per conversion
+# request from the original's LIVE globals and closure cells, so a
+# converted helper never computes with a stale snapshot.
+_CODE_CACHE: dict = {}
+
+
+def _transform_code(raw):
+    """Compile `raw`'s rewritten source and extract the inner code object
+    (the def is compiled nested inside a synthetic outer that declares
+    the original free variables, so the inner code has real freevars —
+    a top-level def could not). Never executed: only the code is taken,
+    so nothing is exec'd into any namespace."""
     try:
         src = textwrap.dedent(inspect.getsource(raw))
         tree = ast.parse(src)
     except (OSError, TypeError, SyntaxError, IndentationError):
-        return fn
+        return None
     fdef = tree.body[0]
     if not isinstance(fdef, ast.FunctionDef):
-        return fn
+        return None
     if not _contains([fdef], (ast.If, ast.While, ast.For, ast.BoolOp,
                               ast.Call)):
-        return fn  # no control flow and no callees to convert
+        return None  # no control flow and no callees to convert
     if _contains([fdef], (ast.Global, ast.Nonlocal)):
-        return fn  # branch-fn extraction would shadow these bindings
+        return None  # branch-fn extraction would shadow these bindings
     fdef.decorator_list = []
+    # defaults are reused from the live function object, not re-evaluated
+    fdef.args.defaults = []
+    fdef.args.kw_defaults = [None] * len(fdef.args.kwonlyargs)
     try:
         _rewrite_returns(fdef)
         conv = _Converter()
@@ -504,8 +527,7 @@ def convert_to_static(fn):
         fdef.body = new_body
         ast.fix_missing_locations(fdef)
     except _Unsupported:
-        return fn
-    # wrap in an outer def binding the free variables as parameters
+        return None
     freevars = list(raw.__code__.co_freevars)
     outer = ast.FunctionDef(
         name="__ptu_outer__",
@@ -520,25 +542,63 @@ def convert_to_static(fn):
     mod = ast.Module(body=[outer], type_ignores=[])
     ast.fix_missing_locations(mod)
     try:
-        code = compile(
+        module_code = compile(
             mod,
             filename=f"<to_static {getattr(raw, '__qualname__', '?')}>",
             mode="exec",
         )
     except (SyntaxError, ValueError):
+        return None
+    import types
+
+    for outer_code in module_code.co_consts:
+        if isinstance(outer_code, types.CodeType) \
+                and outer_code.co_name == "__ptu_outer__":
+            for inner in outer_code.co_consts:
+                if isinstance(inner, types.CodeType) \
+                        and inner.co_name == fdef.name:
+                    return inner
+    return None
+
+
+def convert_to_static(fn):
+    """program_translator.py:756 convert_to_static. Returns the rewritten
+    function (``fn2.__ptu_converted__ == True``) or `fn` unchanged when
+    conversion is not possible.
+
+    The rewritten function shares the ORIGINAL's ``__globals__`` dict and
+    closure cells (types.FunctionType over the cached transformed code),
+    so rebinding a module global or a closed-over variable is visible to
+    the converted code exactly as it is to the eager original. The
+    __ptu_* runtime helpers are installed into that globals dict under
+    their reserved names."""
+    import types
+
+    raw = getattr(fn, "__func__", fn)
+    if getattr(raw, "__ptu_converted__", False):
         return fn
-    glb = dict(raw.__globals__)
-    glb.update(_RT)
-    ns = {}
-    exec(code, glb, ns)  # noqa: S102 — rewritten USER source, same scope
-    cells = []
-    if raw.__closure__:
-        for c in raw.__closure__:
-            try:
-                cells.append(c.cell_contents)
-            except ValueError:
-                cells.append(None)
-    new_fn = ns["__ptu_outer__"](*cells)
+    if getattr(raw, "__ptu_not_to_static__", False):
+        return fn  # jit.not_to_static opt-out
+    if not isinstance(raw, types.FunctionType):
+        return fn
+    key = raw.__code__
+    if key not in _CODE_CACHE:
+        _CODE_CACHE[key] = _transform_code(raw)
+    inner = _CODE_CACHE[key]
+    if inner is None:
+        return fn
+    glb = raw.__globals__
+    for k, v in _RT.items():
+        glb.setdefault(k, v)
+    cell_of = dict(zip(raw.__code__.co_freevars, raw.__closure__ or ()))
+    try:
+        closure = tuple(cell_of[v] for v in inner.co_freevars)
+    except KeyError:
+        return fn  # freevar set mismatch: fall back
+    new_fn = types.FunctionType(
+        inner, glb, raw.__name__, raw.__defaults__, closure or None
+    )
+    new_fn.__kwdefaults__ = raw.__kwdefaults__
     new_fn.__ptu_converted__ = True
     new_fn.__wrapped__ = raw
     inst = getattr(fn, "__self__", None)

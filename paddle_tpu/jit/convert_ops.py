@@ -208,15 +208,19 @@ _SKIP_MODULE_PREFIXES = (
     "paddle_tpu", "jax", "numpy", "builtins", "math", "functools",
     "itertools", "operator", "np",
 )
-_CALL_CACHE = {}
 
 
 def convert_call(fn):
     """Convert a CALLED function lazily (dygraph_to_static convert_call):
     plain user functions/methods get the same AST rewrite as the
     decorated entry point, so tensor control flow in undecorated helpers
-    compiles too. Framework/library callables, classes, Layers and
-    builtins pass through untouched."""
+    compiles too. Framework/library callables, classes, Layers, builtins
+    and jit.not_to_static-marked functions pass through untouched.
+
+    The expensive work (parse+compile) is cached per CODE OBJECT inside
+    convert_to_static; the function itself is rebuilt per call over the
+    original's live globals/closure, so no per-instance cache pins stale
+    scopes (and no unbounded growth for per-call lambdas)."""
     from ..nn.layer import Layer
 
     raw = getattr(fn, "__func__", fn)
@@ -227,19 +231,9 @@ def convert_call(fn):
     mod = getattr(raw, "__module__", "") or ""
     if mod.split(".")[0] in _SKIP_MODULE_PREFIXES:
         return fn
-    key = id(raw)
-    cached = _CALL_CACHE.get(key)
-    if cached is None:
-        from .ast_transform import convert_to_static
+    from .ast_transform import convert_to_static
 
-        try:
-            cached = convert_to_static(raw)
-        except Exception:
-            cached = raw
-        _CALL_CACHE[key] = cached
-    if cached is raw:
+    try:
+        return convert_to_static(fn)
+    except Exception:
         return fn
-    inst = getattr(fn, "__self__", None)
-    if inst is not None:
-        return cached.__get__(inst, type(inst))
-    return cached

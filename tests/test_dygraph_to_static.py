@@ -244,3 +244,107 @@ class TestConvertCall:
         np.testing.assert_allclose(
             sf(paddle.to_tensor(arr)).numpy(), np.abs(arr) * 3, rtol=1e-6
         )
+
+
+SCALE = 2.0
+
+
+def _scaled_helper(v):
+    if v.mean() > -1e9:       # tensor condition: forces conversion
+        return paddle.abs(v) * SCALE
+    return v
+
+
+class TestConvertCallScoping:
+    """Code-review regressions: converted callees must see LIVE module
+    globals and closure cells (function rebuilt over the original's
+    scope per conversion; transformed CODE cached by code object)."""
+
+    def test_rebinding_module_global_is_visible(self):
+        global SCALE
+
+        def outer(x):
+            return _scaled_helper(x) + 0
+
+        sf = to_static(outer)
+        SCALE = 2.0
+        a = sf(paddle.to_tensor(np.ones((2,), np.float32))).numpy()
+        np.testing.assert_allclose(a, 2.0)
+        SCALE = 10.0
+        try:
+            # new shape -> retrace; the helper must read the NEW global
+            b = sf(paddle.to_tensor(np.ones((3,), np.float32))).numpy()
+            np.testing.assert_allclose(b, 10.0)
+        finally:
+            SCALE = 2.0
+
+    def test_closure_cells_stay_live(self):
+        state = {"k": 3.0}
+
+        def make():
+            k = paddle.to_tensor(np.float32(3.0))
+
+            def helper(v):
+                if v.mean() > -1e9:
+                    return v * k
+                return v
+
+            def rebind(new):
+                nonlocal k
+                k = new
+
+            return helper, rebind
+
+        helper, rebind = make()
+
+        def outer(x):
+            return helper(x) + 0
+
+        sf = to_static(outer)
+        a = sf(paddle.to_tensor(np.ones((2,), np.float32))).numpy()
+        np.testing.assert_allclose(a, 3.0)
+        rebind(paddle.to_tensor(np.float32(7.0)))
+        b = sf(paddle.to_tensor(np.ones((3,), np.float32))).numpy()
+        np.testing.assert_allclose(b, 7.0)
+
+    def test_not_to_static_opt_out(self):
+        from paddle_tpu.jit import not_to_static
+        from paddle_tpu.jit.convert_ops import convert_call
+
+        @not_to_static
+        def keep_eager(v):
+            return v + 1
+
+        assert convert_call(keep_eager) is keep_eager
+        assert convert_to_static(keep_eager) is keep_eager
+
+    def test_for_range_tensor_bound(self):
+        """The range fast path must survive call-wrapping: a TENSOR trip
+        count lowers to a converted while (not an eager range(tracer))."""
+
+        def f(x):
+            n = (x.sum() * 0 + 3).astype("int32")
+            s = x * 0
+            for _i in range(n):
+                s = s + x
+            return s
+
+        sf = to_static(f)
+        out = sf(paddle.to_tensor(np.ones((2,), np.float32))).numpy()
+        np.testing.assert_allclose(out, 3.0)
+
+    def test_default_args_reused_not_reevaluated(self):
+        def f(x, k=2.0):
+            if x.mean() > -1e9:
+                return x * k
+            return x
+
+        conv = convert_to_static(f)
+        assert conv.__ptu_converted__
+        np.testing.assert_allclose(
+            conv(paddle.to_tensor(np.ones((2,), np.float32))).numpy(), 2.0
+        )
+        np.testing.assert_allclose(
+            conv(paddle.to_tensor(np.ones((2,), np.float32)), k=5.0
+                 ).numpy(), 5.0
+        )
