@@ -49,11 +49,15 @@ class MultiHeadAttention(Layer):
                  need_weights=False, weight_attr=None, bias_attr=None,
                  attn_impl="dense", causal=False, block_size=512):
         # attn_impl: "dense" (materialized scores, reference semantics),
-        # "blockwise" (online-softmax, O(block) memory), or "ring"
+        # "blockwise" (online-softmax, O(block) memory; Pallas-routed on
+        # a single TPU chip), "ring"/"ring_pallas" (sp-axis sequence
+        # parallel; _pallas runs each step's local attention as the hand
+        # kernel), or "ulysses"
         # (sequence-parallel over the hybrid mesh's sp axis — the
         # long-context path the reference lacks, SURVEY.md §5)
         super().__init__()
-        if attn_impl not in ("dense", "blockwise", "ring", "ulysses"):
+        if attn_impl not in ("dense", "blockwise", "ring",
+                             "ring_pallas", "ulysses"):
             raise ValueError(f"unknown attn_impl {attn_impl!r}")
         self.attn_impl = attn_impl
         self.causal = causal
@@ -144,7 +148,10 @@ class MultiHeadAttention(Layer):
                 out = ulysses_attention(q, k, v, causal=self.causal,
                                         block_size=self.block_size)
             else:
-                out = ring_attention(q, k, v, causal=self.causal)
+                out = ring_attention(
+                    q, k, v, causal=self.causal,
+                    use_pallas=(self.attn_impl == "ring_pallas"),
+                )
             weights = None
         else:
             out = None

@@ -316,3 +316,15 @@ def test_flash_32k_forward_backward_smoke():
     for gr in grads:
         assert bool(jnp.isfinite(gr).all())
     assert bool(jnp.isfinite(val))
+
+
+def test_mha_ring_pallas_impl(sp_mesh):
+    """attn_impl='ring_pallas' on the layer surface == attn_impl='ring'."""
+    paddle.seed(0)
+    a = nn.MultiHeadAttention(16, 2, attn_impl="ring_pallas", causal=True)
+    paddle.seed(0)
+    b = nn.MultiHeadAttention(16, 2, attn_impl="ring", causal=True)
+    x = paddle.to_tensor(np.random.rand(2, 16, 16).astype(np.float32))
+    np.testing.assert_allclose(
+        a(x, x, x).numpy(), b(x, x, x).numpy(), rtol=1e-5, atol=1e-6
+    )
