@@ -91,3 +91,4 @@ from . import distribution  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402
 from .batch import batch  # noqa: F401,E402
+from . import reader  # noqa: F401,E402

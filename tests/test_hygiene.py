@@ -288,3 +288,45 @@ class TestNamespaceParity:
 
         with pytest.raises(NotImplementedError, match="StableHLO"):
             paddle.onnx.export(None, "/tmp/x")
+
+
+class TestReaderDecorators:
+    """paddle.reader decorator parity (reference reader/decorator.py)."""
+
+    def test_compose_map_shuffle_chain_cache_firstn(self):
+        import paddle_tpu as paddle
+
+        r1 = lambda: iter([1, 2, 3])
+        r2 = lambda: iter([10, 20, 30])
+        assert list(paddle.reader.compose(r1, r2)()) == [
+            (1, 10), (2, 20), (3, 30)]
+        with pytest.raises(paddle.reader.ComposeNotAligned):
+            list(paddle.reader.compose(r1, lambda: iter([1]))())
+        assert list(paddle.reader.map_readers(
+            lambda a, b: a + b, r1, r2)()) == [11, 22, 33]
+        assert list(paddle.reader.chain(r1, r2)()) == [1, 2, 3, 10, 20, 30]
+        assert sorted(paddle.reader.shuffle(r1, 2)()) == [1, 2, 3]
+        assert list(paddle.reader.firstn(r1, 2)()) == [1, 2]
+        assert list(paddle.reader.buffered(r1, 2)()) == [1, 2, 3]
+
+        calls = []
+
+        def counting():
+            calls.append(1)
+            return iter([5, 6])
+
+        cached = paddle.reader.cache(counting)
+        assert list(cached()) == [5, 6]
+        assert list(cached()) == [5, 6]
+        assert len(calls) == 1
+
+        assert list(paddle.reader.xmap_readers(
+            lambda s: s * 2, r1, 2, 4)()) == [2, 4, 6]
+
+    def test_batch_composes_with_reader(self):
+        import paddle_tpu as paddle
+
+        r = paddle.reader.shuffle(lambda: iter(range(10)), 10)
+        batches = list(paddle.batch(r, 4)())
+        assert [len(b) for b in batches] == [4, 4, 2]
+        assert sorted(sum(batches, [])) == list(range(10))
