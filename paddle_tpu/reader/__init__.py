@@ -82,6 +82,10 @@ def compose(*readers, **kwargs):
     (a, (b, c)) -> (a, b, c). check_alignment=True (default) raises
     ComposeNotAligned when one reader ends early."""
     check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(
+            f"compose() got unexpected keyword arguments {sorted(kwargs)}"
+        )
 
     def make_tuple(x):
         return x if isinstance(x, tuple) else (x,)
@@ -105,26 +109,47 @@ def compose(*readers, **kwargs):
 
 def buffered(reader, size):
     """Background-thread prefetch queue of `size` samples
-    (decorator.py:307)."""
+    (decorator.py:307). Reader exceptions re-raise in the CONSUMER (a
+    truncated stream must not look like a clean end), and abandoning
+    the generator early releases the fill thread instead of leaving it
+    blocked on a full queue forever."""
 
     def buffered_():
         q: "_queue.Queue" = _queue.Queue(maxsize=size)
         end = object()
+        stop = threading.Event()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def fill():
             try:
                 for s in reader():
-                    q.put(s)
-            finally:
-                q.put(end)
+                    if not put(s):
+                        return
+            except BaseException as e:  # propagate to the consumer
+                put(e)
+                return
+            put(end)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
-        while True:
-            s = q.get()
-            if s is end:
-                return
-            yield s
+        try:
+            while True:
+                s = q.get()
+                if s is end:
+                    return
+                if isinstance(s, BaseException):
+                    raise s
+                yield s
+        finally:
+            stop.set()  # unblock + retire the fill thread on early exit
 
     return buffered_
 
@@ -142,21 +167,24 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     """Parallel map over samples (decorator.py:411). Thread workers (the
     reference forks processes around the GIL for CPU-bound python
     mappers; on this stack numpy mappers release the GIL and true
-    process parallelism belongs to io.DataLoader's spawned workers)."""
+    process parallelism belongs to io.DataLoader's spawned workers).
+    `order` is accepted for API parity; submission order is always
+    preserved here. Early generator exit cancels the in-flight window
+    instead of draining it."""
+    del order
     from concurrent.futures import ThreadPoolExecutor
 
     def xmapped():
-        with ThreadPoolExecutor(max_workers=process_num) as pool:
+        pool = ThreadPoolExecutor(max_workers=process_num)
+        try:
             futures = []
-            it = reader()
-            for s in it:
+            for s in reader():
                 futures.append(pool.submit(mapper, s))
                 if len(futures) >= buffer_size:
                     yield futures.pop(0).result()
             for f in futures:
                 yield f.result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
 
-    if order:
-        return xmapped
-
-    return xmapped  # submission order is preserved either way here
+    return xmapped

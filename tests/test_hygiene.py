@@ -330,3 +330,32 @@ class TestReaderDecorators:
         batches = list(paddle.batch(r, 4)())
         assert [len(b) for b in batches] == [4, 4, 2]
         assert sorted(sum(batches, [])) == list(range(10))
+
+    def test_buffered_propagates_reader_errors_and_releases_thread(self):
+        import threading
+        import time
+
+        import paddle_tpu as paddle
+
+        def bad_reader():
+            yield 1
+            raise IOError("disk gone")
+
+        it = paddle.reader.buffered(bad_reader, 2)()
+        assert next(it) == 1
+        with pytest.raises(IOError, match="disk gone"):
+            list(it)
+
+        # early abandonment must retire the fill thread (no leak)
+        before = threading.active_count()
+        gen = paddle.reader.buffered(lambda: iter(range(1000)), 1)()
+        assert next(gen) == 0
+        gen.close()
+        time.sleep(0.2)
+        assert threading.active_count() <= before + 1
+
+    def test_compose_rejects_typoed_kwargs(self):
+        import paddle_tpu as paddle
+
+        with pytest.raises(TypeError, match="check_aligment"):
+            paddle.reader.compose(lambda: iter([1]), check_aligment=False)
