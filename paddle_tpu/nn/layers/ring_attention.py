@@ -414,12 +414,14 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
     return AG.apply(f, ts, name="ring_attention")
 
 
-def _ulysses_raw(q, k, v, *, axis_name, causal, scale, block_size=512):
+def _ulysses_raw(q, k, v, *, axis_name, causal, scale, block_size=512,
+                 use_pallas=False, interpret=False):
     """Per-device body: all-to-all head-scatter/seq-gather, local exact
     attention over the FULL sequence for H/sp heads, inverse all-to-all.
     (SURVEY.md §5: the Ulysses-style alternative to the ppermute ring —
     two all-to-alls instead of sp_size rotations; best when H >= sp and
-    the interconnect favors all-to-all.)"""
+    the interconnect favors all-to-all.) `use_pallas` runs the local
+    attention as the hand flash kernel."""
     # local [B, Hl=H, Sl=S/sp, D] -> [B, H/sp, S, D]
     q = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
                            tiled=True)
@@ -427,17 +429,26 @@ def _ulysses_raw(q, k, v, *, axis_name, causal, scale, block_size=512):
                            tiled=True)
     v = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                            tiled=True)
-    out = _blockwise_raw(q, k, v, causal=causal, block_size=block_size,
-                         scale=scale)
+    S = q.shape[2]
+    b = min(block_size, S)
+    if use_pallas and S % b == 0:
+        from ...ops.pallas.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal, b, b, scale, interpret)
+    else:
+        out = _blockwise_raw(q, k, v, causal=causal,
+                             block_size=block_size, scale=scale)
     return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
 
 def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
-                      causal=False, scale=None, block_size=512):
+                      causal=False, scale=None, block_size=512,
+                      use_pallas=False, interpret=None):
     """Sequence-parallel attention via head redistribution: q/k/v are
     GLOBAL [B, H, S, D] with S sharded over `sp_axis`; heads must divide
-    by the sp size."""
+    by the sp size. `use_pallas` routes the per-device local attention
+    through the Pallas flash kernel (interpret auto off-TPU)."""
     from ...core import autograd as AG
 
     mesh = mesh if mesh is not None else comm.hybrid_mesh()
@@ -467,7 +478,10 @@ def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, sp_axis="sp",
         )
         body = comm.shard_map(
             partial(_ulysses_raw, axis_name=sp_axis, causal=causal,
-                    scale=scale, block_size=block_size),
+                    scale=scale, block_size=block_size,
+                    use_pallas=use_pallas,
+                    interpret=(jax.default_backend() != "tpu"
+                               if interpret is None else interpret)),
             mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
