@@ -325,10 +325,33 @@ def _bench_flash_attention(steps=500):
         _ = np.asarray(f(q, k, v)[0, 0, 0, :2])
         return (time.perf_counter() - t0) / steps * 1e3
 
-    return {
+    out = {
         "flash_attn_s2048_pallas_ms": round(ms(flash_l), 2),
         "flash_attn_s2048_dense_ms": round(ms(dense_l), 2),
     }
+
+    # long context: 32k causal fwd+bwd through the K/V-streaming kernel
+    # (impossible for the dense path: the 32k x 32k score matrix alone is
+    # 4GB; the old VMEM-resident kernel capped at 16k)
+    q32, k32, v32 = [
+        jax.device_put(jnp.asarray(
+            np.random.rand(1, 1, 32768, 128).astype(np.float32) - 0.5))
+        for _ in range(3)
+    ]
+    vg = jax.jit(jax.value_and_grad(
+        lambda a, b, c: flash_attention(
+            a, b, c, True, 512, 512, None, False).sum(),
+        (0, 1, 2),
+    ))
+    val, _ = vg(q32 + 1.0, k32, v32)  # compile+warm on different values
+    _ = np.asarray(val)
+    t0 = time.perf_counter()
+    val, grads = vg(q32, k32, v32)
+    _ = np.asarray(val)
+    out["flash_attn_s32k_fwdbwd_ms"] = round(
+        (time.perf_counter() - t0) * 1e3, 1
+    )
+    return out
 
 
 def main():
