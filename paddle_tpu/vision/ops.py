@@ -659,3 +659,62 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
 
     res = AG.apply_nondiff(f, (bb, sc))  # non-differentiable (hard select)
     return res[0], res[1]
+
+
+__all__ += ["box_clip", "anchor_generator"]
+
+
+def box_clip(input, im_info, name=None):
+    """operators/detection/box_clip_op.h: clip corner boxes to image
+    bounds. input [N, M, 4] (or [M, 4]); im_info [N, 3] rows
+    (height, width, scale) — boxes clip to [0, dim/scale - 1]."""
+    b = input if isinstance(input, Tensor) else Tensor(input)
+    info = im_info if isinstance(im_info, Tensor) else Tensor(im_info)
+
+    def f(boxes, im):
+        squeeze = boxes.ndim == 2
+        if squeeze:
+            boxes = boxes[None]
+        h = im[:, 0] / im[:, 2] - 1.0
+        w = im[:, 1] / im[:, 2] - 1.0
+        x1 = jnp.clip(boxes[..., 0], 0.0, w[:, None])
+        y1 = jnp.clip(boxes[..., 1], 0.0, h[:, None])
+        x2 = jnp.clip(boxes[..., 2], 0.0, w[:, None])
+        y2 = jnp.clip(boxes[..., 3], 0.0, h[:, None])
+        out = jnp.stack([x1, y1, x2, y2], axis=-1)
+        return out[0] if squeeze else out
+
+    return AG.apply(f, (b, info), name="box_clip")
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """operators/detection/anchor_generator_op.h (RPN anchors): one
+    anchor per (size, ratio) at every feature-map cell, in UNnormalized
+    xmin/ymin/xmax/ymax. Returns (anchors [H, W, A, 4],
+    variances [H, W, A, 4])."""
+    inp = input if isinstance(input, Tensor) else Tensor(input)
+    H, W = int(inp._data.shape[2]), int(inp._data.shape[3])
+    whs = []
+    for r in aspect_ratios:
+        for s in anchor_sizes:
+            area = float(s) * float(s)
+            w = np.sqrt(area / float(r))
+            whs.append((w, w * float(r)))
+    wh = jnp.asarray(whs, jnp.float32)            # [A, 2]
+    A = wh.shape[0]
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * float(stride[0])
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * float(stride[1])
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxg, cyg = cxg[..., None], cyg[..., None]     # [H, W, 1]
+    hw = wh[None, None, :, 0] / 2.0
+    hh = wh[None, None, :, 1] / 2.0
+    anchors = jnp.stack(
+        [cxg - hw, cyg - hh, cxg + hw, cyg + hh], axis=-1
+    )
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (H, W, A, 4)
+    )
+    return (Tensor._wrap(anchors, stop_gradient=True),
+            Tensor._wrap(var, stop_gradient=True))

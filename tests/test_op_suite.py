@@ -851,3 +851,38 @@ def test_multiclass_nms_suppression():
                                [0.9, 0.7], rtol=1e-6)
     # the suppressed overlapping box is absent
     assert not any(abs(row[2] - 0.5) < 1e-6 for row in kept)
+
+
+def test_box_clip():
+    from paddle_tpu.vision.ops import box_clip
+
+    boxes = np.array([[[-5.0, -5.0, 120.0, 90.0],
+                       [10.0, 10.0, 50.0, 60.0]]], np.float32)
+    im_info = np.array([[100.0, 110.0, 1.0]], np.float32)  # h, w, scale
+    out = box_clip(P.to_tensor(boxes), P.to_tensor(im_info)).numpy()
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 109.0, 90.0])
+    np.testing.assert_allclose(out[0, 1], [10.0, 10.0, 50.0, 60.0])
+    check_grad(lambda b: box_clip(b, P.to_tensor(im_info)), [boxes])
+
+
+def test_anchor_generator_single_cell():
+    from paddle_tpu.vision.ops import anchor_generator
+
+    feat = np.zeros((1, 8, 1, 1), np.float32)
+    anchors, var = anchor_generator(
+        P.to_tensor(feat), anchor_sizes=[64.0], aspect_ratios=[1.0],
+        stride=(16.0, 16.0),
+    )
+    # cell center (8, 8), 64x64 box
+    np.testing.assert_allclose(
+        anchors.numpy()[0, 0, 0], [-24.0, -24.0, 40.0, 40.0], rtol=1e-6
+    )
+    assert var.numpy().shape == (1, 1, 1, 4)
+    # aspect ratio 2 halves width-ish: w*h = 64^2, h/w = 2
+    anchors2, _ = anchor_generator(
+        P.to_tensor(feat), anchor_sizes=[64.0], aspect_ratios=[2.0],
+    )
+    a = anchors2.numpy()[0, 0, 0]
+    w, h = a[2] - a[0], a[3] - a[1]
+    np.testing.assert_allclose(h / w, 2.0, rtol=1e-5)
+    np.testing.assert_allclose(w * h, 64.0 * 64.0, rtol=1e-5)
