@@ -1,2 +1,4 @@
 """paddle_tpu.incubate (reference: python/paddle/fluid/incubate/)."""
 from . import checkpoint  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import ExpertParallelMoE  # noqa: F401

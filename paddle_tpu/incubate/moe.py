@@ -1,0 +1,171 @@
+"""Mixture-of-Experts with expert parallelism (GShard-style).
+
+Reference lineage: the reference line ships MoE later as
+paddle.incubate.distributed.models.moe (MoELayer over alltoall
+GlobalScatter/GlobalGather custom ops); SURVEY.md's distributed design
+makes expert parallelism ("ep") a first-class axis of the sharding story.
+
+TPU-first: routing is the GShard dense-dispatch formulation — top-k
+gating builds a dispatch mask [B, S, E, C] and the two dispatch/combine
+einsums move tokens to experts; the expert dimension of the expert FFN
+weights is SHARDED over a mesh axis (default 'mp'), so GSPMD partitions
+the per-expert matmuls and inserts the all-to-all that the reference's
+GlobalScatter op performs explicitly. No data-dependent shapes: capacity
+is static, overflow tokens drop (standard GShard semantics).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from ..distributed import comm
+from ..nn.initializer import XavierNormal
+from ..nn.layer import Layer
+
+__all__ = ["ExpertParallelMoE", "moe_dispatch_combine"]
+
+
+def _top2_dispatch(gates, capacity):
+    """gates [N, E] -> (dispatch [N, E, C] 0/1, combine [N, E, C]).
+
+    GShard top-2: per token, the best and second-best expert; tokens past
+    an expert's capacity drop. Position within each expert's buffer is
+    the token's rank among that expert's assignees (cumsum over the
+    flattened token axis — deterministic, order-of-arrival priority)."""
+    N, E = gates.shape
+    C = capacity
+
+    idx1 = jnp.argmax(gates, axis=-1)                        # [N]
+    mask1 = jax.nn.one_hot(idx1, E, dtype=gates.dtype)       # [N, E]
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=gates.dtype)
+
+    # positions: first-choice tokens take priority over second choices
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1                 # [N, E]
+    count1 = mask1.sum(axis=0, keepdims=True)
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + count1
+    keep1 = mask1 * (pos1 < C)
+    keep2 = mask2 * (pos2 < C)
+
+    g1 = (gates * keep1).sum(-1)                             # [N]
+    g2 = (gates * keep2).sum(-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    oh1 = jax.nn.one_hot(
+        jnp.clip((pos1 * mask1).sum(-1), 0, C - 1).astype(jnp.int32),
+        C, dtype=gates.dtype,
+    )                                                         # [N, C]
+    oh2 = jax.nn.one_hot(
+        jnp.clip((pos2 * mask2).sum(-1), 0, C - 1).astype(jnp.int32),
+        C, dtype=gates.dtype,
+    )
+    disp = (keep1[:, :, None] * oh1[:, None, :]
+            + keep2[:, :, None] * oh2[:, None, :])           # [N, E, C]
+    comb = (g1[:, None, None] * keep1[:, :, None] * oh1[:, None, :]
+            + g2[:, None, None] * keep2[:, :, None] * oh2[:, None, :])
+    return disp, comb, mask1
+
+
+def moe_dispatch_combine(x, gates, capacity):
+    """Functional GShard routing for testing: x [N, M], gates [N, E] ->
+    (expert_inputs [E, C, M], combine [N, E, C], dispatch [N, E, C])."""
+    disp, comb, _ = _top2_dispatch(gates, capacity)
+    expert_in = jnp.einsum("nec,nm->ecm", disp, x)
+    return expert_in, comb, disp
+
+
+class ExpertParallelMoE(Layer):
+    """Expert-parallel MoE FFN block.
+
+    Experts' weights [E, ...] are sharded over `expert_axis` of the
+    hybrid mesh (one expert group per mesh slice — the 'ep' placement);
+    the dispatch einsum's output inherits that sharding, so XLA emits the
+    token all-to-all over the axis. Capacity defaults to
+    ceil(2 * tokens / E) * capacity_factor.
+
+    Returns (out, aux_loss): aux_loss is the GShard load-balancing term
+    mean(E * f_e * p_e), differentiable through the gates.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, k=2,
+                 capacity_factor=1.25, expert_axis="mp",
+                 mesh: Optional[object] = None, name=None):
+        super().__init__()
+        if k != 2:
+            raise NotImplementedError("top-2 gating only (GShard default)")
+        self.num_experts = int(num_experts)
+        self.capacity_factor = float(capacity_factor)
+        self.expert_axis = expert_axis
+        self.mesh = mesh if mesh is not None else comm.hybrid_mesh()
+        self.gate = self.create_parameter(
+            shape=[d_model, num_experts],
+            default_initializer=XavierNormal(),
+        )
+        self.wi = self.create_parameter(
+            shape=[num_experts, d_model, d_hidden],
+            default_initializer=XavierNormal(),
+        )
+        self.wo = self.create_parameter(
+            shape=[num_experts, d_hidden, d_model],
+            default_initializer=XavierNormal(),
+        )
+        if self.mesh is not None and self.expert_axis in self.mesh.shape:
+            if num_experts % self.mesh.shape[self.expert_axis] == 0:
+                spec = P(self.expert_axis, None, None)
+                for p in (self.wi, self.wo):
+                    p._data = jax.device_put(
+                        p._data, NamedSharding(self.mesh, spec)
+                    )
+                    p._tp_spec = spec
+
+    def forward(self, x):
+        """x [B, S, M] -> (out [B, S, M], aux_loss scalar)."""
+        E = self.num_experts
+        cf = self.capacity_factor
+        mesh, axis = self.mesh, self.expert_axis
+
+        def f(xr, wg, wi, wo):
+            B, S, M = xr.shape
+            N = B * S
+            C = max(int(math.ceil(2 * N / E * cf)), 1)
+            xf = xr.reshape(N, M)
+            logits = xf.astype(jnp.float32) @ wg.astype(jnp.float32)
+            gates = jax.nn.softmax(logits, axis=-1)          # [N, E]
+            disp, comb, mask1 = _top2_dispatch(gates, C)
+            expert_in = jnp.einsum(
+                "nec,nm->ecm", disp.astype(xr.dtype), xf
+            )                                                # [E, C, M]
+            if mesh is not None and axis in mesh.shape \
+                    and E % mesh.shape[axis] == 0:
+                expert_in = jax.lax.with_sharding_constraint(
+                    expert_in, NamedSharding(mesh, P(axis, None, None))
+                )
+            h = jax.nn.gelu(jnp.einsum(
+                "ecm,emh->ech", expert_in, wi.astype(expert_in.dtype)
+            ))
+            expert_out = jnp.einsum(
+                "ech,ehm->ecm", h, wo.astype(h.dtype)
+            )
+            out = jnp.einsum(
+                "nec,ecm->nm", comb.astype(xr.dtype), expert_out
+            )
+            # load balancing (GShard aux): E * mean(fraction routed) *
+            # mean(gate prob) per expert
+            f_e = mask1.mean(axis=0)                         # [E]
+            p_e = gates.mean(axis=0)
+            aux = (f_e * p_e).sum() * E
+            return out.reshape(B, S, M), aux.astype(xr.dtype)
+
+        xt = x if isinstance(x, Tensor) else Tensor(x)
+        out, aux = AG.apply(
+            f, (xt, self.gate, self.wi, self.wo), name="moe"
+        )
+        return out, aux

@@ -32,6 +32,7 @@ def test_dryrun_multichip_8(capsys):
     assert "dp4xpp2 1F1B" in out
     assert "dp4xmp2 TP" in out
     assert "GPT dp2xpp2xmp2 +zero1+gm2" in out
+    assert "ep8 MoE" in out
     assert "sp8 ring attention" in out
     # state cleaned up for subsequent tests
     from paddle_tpu.distributed import comm

@@ -1,0 +1,139 @@
+"""Expert-parallel MoE (GShard-style, paddle_tpu.incubate.moe).
+
+Parity model: routing/compute checked against a direct per-token python
+reference; expert parallelism checked by sharding inspection (weights and
+dispatched tokens land on the expert axis) and by value-equality with the
+unsharded run (sharding constraints are value-neutral)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import comm
+from paddle_tpu.incubate.moe import ExpertParallelMoE, moe_dispatch_combine
+
+
+def _ref_top2(gates, capacity):
+    """Python reference of GShard top-2 capacity routing."""
+    N, E = gates.shape
+    counts = np.zeros(E, int)
+    out = []  # (token, expert, pos, weight) entries
+    choice1 = gates.argmax(-1)
+    g2 = gates.copy()
+    g2[np.arange(N), choice1] = -np.inf
+    choice2 = g2.argmax(-1)
+    pos1 = np.full(N, -1)
+    for n in range(N):
+        e = choice1[n]
+        if counts[e] < capacity:
+            pos1[n] = counts[e]
+            counts[e] += 1
+    pos2 = np.full(N, -1)
+    for n in range(N):
+        e = choice2[n]
+        if counts[e] < capacity:
+            pos2[n] = counts[e]
+            counts[e] += 1
+    return choice1, pos1, choice2, pos2
+
+
+def test_dispatch_matches_python_reference():
+    rng = np.random.RandomState(0)
+    N, E, C, M = 12, 4, 3, 5
+    x = rng.rand(N, M).astype(np.float32)
+    gates = jax.nn.softmax(
+        jnp.asarray(rng.rand(N, E).astype(np.float32) * 3), -1
+    )
+    expert_in, comb, disp = moe_dispatch_combine(
+        jnp.asarray(x), gates, C
+    )
+    g = np.asarray(gates)
+    c1, p1, c2, p2 = _ref_top2(g, C)
+    want = np.zeros((E, C, M), np.float32)
+    for n in range(N):
+        if p1[n] >= 0:
+            want[c1[n], p1[n]] += x[n]
+        if p2[n] >= 0:
+            want[c2[n], p2[n]] += x[n]
+    np.testing.assert_allclose(np.asarray(expert_in), want, rtol=1e-5,
+                               atol=1e-6)
+    # combine weights: renormalized top-2 gate probs at the same slots
+    for n in range(N):
+        tot = (g[n, c1[n]] if p1[n] >= 0 else 0.0) + (
+            g[n, c2[n]] if p2[n] >= 0 else 0.0)
+        if p1[n] >= 0:
+            np.testing.assert_allclose(
+                np.asarray(comb)[n, c1[n], p1[n]], g[n, c1[n]] / tot,
+                rtol=1e-5,
+            )
+
+
+def test_moe_layer_matches_dense_reference_when_capacity_ample():
+    paddle.seed(3)
+    B, S, M, H, E = 2, 6, 8, 16, 4
+    layer = ExpertParallelMoE(M, H, E, capacity_factor=4.0, mesh=None)
+    x = np.random.RandomState(1).rand(B, S, M).astype(np.float32)
+    out, aux = layer(paddle.to_tensor(x))
+    assert out.shape == [B, S, M]
+    assert float(aux.numpy()) > 0
+
+    # python reference: with ample capacity nothing drops
+    wg = np.asarray(layer.gate._data)
+    wi = np.asarray(layer.wi._data)
+    wo = np.asarray(layer.wo._data)
+    xf = x.reshape(-1, M)
+    gates = np.asarray(jax.nn.softmax(jnp.asarray(xf @ wg), -1))
+    c1, p1, c2, p2 = _ref_top2(gates, int(np.ceil(2 * B * S / E * 4.0)))
+    want = np.zeros_like(xf)
+
+    def expert(e, v):
+        h = np.asarray(jax.nn.gelu(jnp.asarray(v @ wi[e])))
+        return h @ wo[e]
+
+    for n in range(xf.shape[0]):
+        g1, g2v = gates[n, c1[n]], gates[n, c2[n]]
+        tot = g1 + g2v
+        want[n] = (g1 / tot) * expert(c1[n], xf[n]) \
+            + (g2v / tot) * expert(c2[n], xf[n])
+    np.testing.assert_allclose(
+        out.numpy().reshape(-1, M), want, rtol=2e-4, atol=2e-5
+    )
+
+
+def test_moe_grads_flow_to_gate_and_experts():
+    paddle.seed(5)
+    layer = ExpertParallelMoE(8, 16, 4, capacity_factor=2.0, mesh=None)
+    x = paddle.to_tensor(
+        np.random.RandomState(2).rand(2, 4, 8).astype(np.float32))
+    out, aux = layer(x)
+    (out.sum() + 0.01 * aux).backward()
+    for p in (layer.gate, layer.wi, layer.wo):
+        assert p.grad is not None
+        assert np.isfinite(p.grad.numpy()).all()
+    assert float(np.abs(layer.gate.grad.numpy()).max()) > 0
+
+
+def test_expert_parallel_sharding_and_value_parity():
+    """Experts shard over the mesh axis; constrained == unconstrained."""
+    comm.init_hybrid_mesh(mp=8)
+    try:
+        paddle.seed(7)
+        ep = ExpertParallelMoE(8, 16, 8, expert_axis="mp")
+        assert not ep.wi._data.sharding.is_fully_replicated
+        shard_experts = max(
+            s.data.shape[0] for s in ep.wi._data.addressable_shards
+        )
+        assert shard_experts == 1  # 8 experts over 8 devices
+
+        x = np.random.RandomState(3).rand(2, 8, 8).astype(np.float32)
+        out_ep, _ = ep(paddle.to_tensor(x))
+
+        paddle.seed(7)
+        dense = ExpertParallelMoE(8, 16, 8, mesh=None)
+        out_ref, _ = dense(paddle.to_tensor(x))
+        np.testing.assert_allclose(out_ep.numpy(), out_ref.numpy(),
+                                   rtol=2e-4, atol=2e-5)
+    finally:
+        comm._state.hybrid_mesh = None
