@@ -119,12 +119,11 @@ class ExpertParallelMoE(Layer):
         )
         if self.mesh is not None and self.expert_axis in self.mesh.shape:
             if num_experts % self.mesh.shape[self.expert_axis] == 0:
+                from ..distributed.meta_parallel import _shard_param
+
                 spec = P(self.expert_axis, None, None)
                 for p in (self.wi, self.wo):
-                    p._data = jax.device_put(
-                        p._data, NamedSharding(self.mesh, spec)
-                    )
-                    p._tp_spec = spec
+                    _shard_param(p, self.mesh, spec)
 
     def forward(self, x):
         """x [B, S, M] -> (out [B, S, M], aux_loss scalar)."""

@@ -675,8 +675,9 @@ def box_clip(input, im_info, name=None):
         squeeze = boxes.ndim == 2
         if squeeze:
             boxes = boxes[None]
-        h = im[:, 0] / im[:, 2] - 1.0
-        w = im[:, 1] / im[:, 2] - 1.0
+        # bbox_util.h ClipTiledBoxes: bound = round(dim/scale) - 1
+        h = jnp.round(im[:, 0] / im[:, 2]) - 1.0
+        w = jnp.round(im[:, 1] / im[:, 2]) - 1.0
         x1 = jnp.clip(boxes[..., 0], 0.0, w[:, None])
         y1 = jnp.clip(boxes[..., 1], 0.0, h[:, None])
         x2 = jnp.clip(boxes[..., 2], 0.0, w[:, None])
@@ -696,20 +697,24 @@ def anchor_generator(input, anchor_sizes, aspect_ratios,
     variances [H, W, A, 4])."""
     inp = input if isinstance(input, Tensor) else Tensor(input)
     H, W = int(inp._data.shape[2]), int(inp._data.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    # anchor_generator_op.h: base extents from the STRIDE area, rounded,
+    # then scaled by size/stride; ratio loop OUTER, size loop inner
     whs = []
     for r in aspect_ratios:
+        base_w = float(np.round(np.sqrt(sw * sh / float(r))))
+        base_h = float(np.round(base_w * float(r)))
         for s in anchor_sizes:
-            area = float(s) * float(s)
-            w = np.sqrt(area / float(r))
-            whs.append((w, w * float(r)))
+            whs.append((float(s) / sw * base_w, float(s) / sh * base_h))
     wh = jnp.asarray(whs, jnp.float32)            # [A, 2]
     A = wh.shape[0]
-    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * float(stride[0])
-    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * float(stride[1])
+    # center: i*stride + offset*(stride - 1); corners ±0.5*(extent - 1)
+    cx = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1.0)
+    cy = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1.0)
     cxg, cyg = jnp.meshgrid(cx, cy)
     cxg, cyg = cxg[..., None], cyg[..., None]     # [H, W, 1]
-    hw = wh[None, None, :, 0] / 2.0
-    hh = wh[None, None, :, 1] / 2.0
+    hw = 0.5 * (wh[None, None, :, 0] - 1.0)
+    hh = 0.5 * (wh[None, None, :, 1] - 1.0)
     anchors = jnp.stack(
         [cxg - hw, cyg - hh, cxg + hw, cyg + hh], axis=-1
     )

@@ -863,6 +863,14 @@ def test_box_clip():
     np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 109.0, 90.0])
     np.testing.assert_allclose(out[0, 1], [10.0, 10.0, 50.0, 60.0])
     check_grad(lambda b: box_clip(b, P.to_tensor(im_info)), [boxes])
+    # non-unit scale: bounds ROUND before the -1 (bbox_util.h
+    # ClipTiledBoxes: im_h = round(info[0]/scale))
+    im2 = np.array([[800.0, 1000.0, 1.5]], np.float32)
+    big = np.array([[[0.0, 0.0, 999.0, 599.0]]], np.float32)
+    out2 = box_clip(P.to_tensor(big), P.to_tensor(im2)).numpy()
+    np.testing.assert_allclose(
+        out2[0, 0], [0.0, 0.0, round(1000 / 1.5) - 1, round(800 / 1.5) - 1]
+    )
 
 
 def test_anchor_generator_single_cell():
@@ -873,16 +881,19 @@ def test_anchor_generator_single_cell():
         P.to_tensor(feat), anchor_sizes=[64.0], aspect_ratios=[1.0],
         stride=(16.0, 16.0),
     )
-    # cell center (8, 8), 64x64 box
+    # reference kernel math (anchor_generator_op.h): ctr = 0.5*(16-1) =
+    # 7.5; base = round(sqrt(256)) = 16; extent = (64/16)*16 = 64;
+    # corners = 7.5 -+ 0.5*63
     np.testing.assert_allclose(
-        anchors.numpy()[0, 0, 0], [-24.0, -24.0, 40.0, 40.0], rtol=1e-6
+        anchors.numpy()[0, 0, 0], [-24.0, -24.0, 39.0, 39.0], rtol=1e-6
     )
     assert var.numpy().shape == (1, 1, 1, 4)
-    # aspect ratio 2 halves width-ish: w*h = 64^2, h/w = 2
+    # ratio 2: base_w = round(sqrt(128)) = 11, base_h = round(11*2) = 22
+    # (the reference rounds base_w FIRST) -> extents 44 x 88 ->
+    # corners 7.5 -+ 0.5*(ext-1)
     anchors2, _ = anchor_generator(
         P.to_tensor(feat), anchor_sizes=[64.0], aspect_ratios=[2.0],
     )
-    a = anchors2.numpy()[0, 0, 0]
-    w, h = a[2] - a[0], a[3] - a[1]
-    np.testing.assert_allclose(h / w, 2.0, rtol=1e-5)
-    np.testing.assert_allclose(w * h, 64.0 * 64.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        anchors2.numpy()[0, 0, 0], [-14.0, -36.0, 29.0, 51.0], rtol=1e-6
+    )
