@@ -92,3 +92,5 @@ from . import onnx  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402
 from .batch import batch  # noqa: F401,E402
 from . import reader  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
+from . import tensor  # noqa: F401,E402

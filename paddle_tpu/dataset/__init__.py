@@ -1,0 +1,90 @@
+"""paddle.dataset — fluid-era sample-reader dataset APIs.
+
+Reference: python/paddle/dataset/* (uci_housing.py:91 train/test, mnist,
+imdb, imikolov, ...): each module exposes `train()`/`test()` returning a
+READER (zero-arg callable yielding samples) that feeds `paddle.batch`.
+
+The data itself lives in the modern map-style datasets
+(paddle_tpu.vision.datasets / paddle_tpu.text.datasets); these adapters
+re-shape them into the reader protocol so fluid-era scripts run with the
+import changed. Dataset constructor kwargs (data files, paths) pass
+through: ``uci_housing.train(data_file=...)``.
+"""
+from __future__ import annotations
+
+__all__ = ["uci_housing", "mnist", "imdb", "imikolov", "cifar",
+           "movielens", "conll05", "wmt14", "wmt16"]
+
+
+def _reader_from(dataset_cls, mode, **kwargs):
+    def reader():
+        ds = dataset_cls(mode=mode, **kwargs)
+        for i in range(len(ds)):
+            sample = ds[i]
+            yield tuple(sample) if isinstance(sample, (list, tuple)) \
+                else (sample,)
+
+    return reader
+
+
+class _ReaderModule:
+    """One paddle.dataset.<name> module shape: train()/test() factories."""
+
+    def __init__(self, loader, train_mode="train", test_mode="test"):
+        self._loader = loader
+        self._train_mode = train_mode
+        self._test_mode = test_mode
+
+    def train(self, **kwargs):
+        return _reader_from(self._loader(), self._train_mode, **kwargs)
+
+    def test(self, **kwargs):
+        return _reader_from(self._loader(), self._test_mode, **kwargs)
+
+
+uci_housing = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.text.datasets", fromlist=["UCIHousing"]
+    ).UCIHousing
+)
+imdb = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.text.datasets", fromlist=["Imdb"]
+    ).Imdb
+)
+imikolov = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.text.datasets", fromlist=["Imikolov"]
+    ).Imikolov
+)
+movielens = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.text.datasets", fromlist=["Movielens"]
+    ).Movielens
+)
+conll05 = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.text.datasets", fromlist=["Conll05st"]
+    ).Conll05st,
+    test_mode="test",
+)
+wmt14 = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.text.datasets", fromlist=["WMT14"]
+    ).WMT14
+)
+wmt16 = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.text.datasets", fromlist=["WMT16"]
+    ).WMT16
+)
+mnist = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.vision.datasets", fromlist=["MNIST"]
+    ).MNIST
+)
+cifar = _ReaderModule(
+    lambda: __import__(
+        "paddle_tpu.vision.datasets", fromlist=["Cifar10"]
+    ).Cifar10
+)

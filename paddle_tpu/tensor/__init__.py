@@ -1,0 +1,17 @@
+"""paddle.tensor — the tensor-op module namespace.
+
+Reference: python/paddle/tensor/__init__.py re-exporting the op families
+(creation/math/linalg/manipulation/logic/search/...). The implementations
+live in paddle_tpu.ops; this module is the reference-shaped import path
+(`from paddle.tensor import creation`, `paddle.tensor.matmul`, ...).
+"""
+from ..ops import *  # noqa: F401,F403
+from ..ops import (  # noqa: F401
+    creation,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    search,
+    sequence,
+)

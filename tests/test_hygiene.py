@@ -359,3 +359,29 @@ class TestReaderDecorators:
 
         with pytest.raises(TypeError, match="check_aligment"):
             paddle.reader.compose(lambda: iter([1]), check_aligment=False)
+
+
+class TestDatasetTensorNamespaces:
+    def test_tensor_module_paths(self):
+        import paddle_tpu as paddle
+
+        assert paddle.tensor.matmul is paddle.matmul
+        from paddle_tpu.tensor import creation  # reference import shape
+
+        assert creation.to_tensor is paddle.to_tensor
+
+    def test_dataset_reader_protocol(self, tmp_path):
+        import paddle_tpu as paddle
+
+        f = tmp_path / "housing.data"
+        rows = np.random.RandomState(0).rand(30, 14)
+        with open(f, "w") as fh:
+            for r in rows:
+                fh.write(" ".join(f"{v:.6f}" for v in r) + "\n")
+        reader = paddle.dataset.uci_housing.train(data_file=str(f))
+        samples = list(reader())
+        assert len(samples) > 0
+        feat, label = samples[0]
+        assert feat.shape == (13,)
+        batches = list(paddle.batch(reader, 4)())
+        assert len(batches[0]) == 4
