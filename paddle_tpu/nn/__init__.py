@@ -19,3 +19,7 @@ from .layers.norm import *  # noqa: F401,F403
 from .layers.pooling import *  # noqa: F401,F403
 from .layers.rnn import *  # noqa: F401,F403
 from .layers.transformer import *  # noqa: F401,F403
+# module-shaped aliases (reference: paddle.nn.common / .loss / ... are
+# importable module names as well as the flat layer namespace)
+from .layers import common, container, loss, norm, pooling, rnn, vision  # noqa: F401,E402
+from .layers import conv  # noqa: F401,E402

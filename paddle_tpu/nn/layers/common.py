@@ -241,3 +241,30 @@ class Unfold(Layer):
     def forward(self, x):
         return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
                         self.dilations)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (reference:
+    python/paddle/nn/layer/distance.py PairwiseDistance over dist_op)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = float(p)
+        self.epsilon = float(epsilon)
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...core import autograd as AG
+        import jax.numpy as jnp
+
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+
+        def f(a, b):
+            d = a - b + eps
+            return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keep) \
+                ** (1.0 / p)
+
+        return AG.apply(f, (x, y), name="pairwise_distance")
+
+
+__all__.append("PairwiseDistance")

@@ -1,7 +1,10 @@
 """Loss layers (reference: python/paddle/nn/layer/loss.py)."""
 from __future__ import annotations
 
+import jax
+
 from .. import functional as F
+from ..initializer import XavierNormal
 from ..layer import Layer
 
 __all__ = [
@@ -177,3 +180,164 @@ class SigmoidFocalLoss(Layer):
     def forward(self, logit, label):
         return F.sigmoid_focal_loss(logit, label, self.normalizer, self.alpha,
                                     self.gamma, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: python/paddle/nn/functional/loss.py hsigmoid_loss over
+    operators/hierarchical_sigmoid_op.h + math/matrix_bit_code.h
+    SimpleCode: class c encodes as c + num_classes; weight row at path
+    bit j is (code >> (j+1)) - 1, the classification bit is
+    (code >> j) & 1; loss = sum_path softplus(pre) - sum_{bit=1} pre,
+    pre clipped to [-40, 40]).
+
+    Deviation (documented): out-of-path slots contribute EXACTLY zero
+    here; the reference's kernel adds softplus(0)=log 2 per padded slot
+    of the batch-max path length (its own TODO marks that as wrong —
+    gradients agree either way).
+
+    Custom trees (path_table/path_code) follow the same math with the
+    user's tables."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = int(num_classes)
+        self.is_custom = bool(is_custom)
+        rows = self.num_classes - 1 if not is_custom else self.num_classes
+        self.weight = self.create_parameter(
+            shape=[rows, feature_size], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[rows], attr=bias_attr, is_bias=True
+            )
+        else:
+            self.bias = None
+        if not is_custom:
+            # static per-class (index, bit, mask) tables from SimpleCode
+            import numpy as np
+
+            codes = np.arange(num_classes) + num_classes
+            max_len = int(np.floor(np.log2(2 * num_classes - 1)))
+            idx = np.zeros((num_classes, max_len), np.int32)
+            bit = np.zeros((num_classes, max_len), np.float32)
+            msk = np.zeros((num_classes, max_len), np.float32)
+            for c in range(num_classes):
+                code = int(codes[c])
+                length = code.bit_length() - 1
+                for j in range(length):
+                    idx[c, j] = (code >> (j + 1)) - 1
+                    bit[c, j] = (code >> j) & 1
+                    msk[c, j] = 1.0
+            self._idx, self._bit, self._msk = idx, bit, msk
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from ...core import autograd as AG
+        import jax.numpy as jnp
+        import numpy as np
+
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError(
+                "is_custom HSigmoidLoss needs path_table and path_code"
+            )
+
+        def f(x, y, w, *rest):
+            i = 0
+            b = None
+            if self.bias is not None:
+                b = rest[i]
+                i += 1
+            if self.is_custom:
+                tbl, code = rest[i], rest[i + 1]
+                idx = jnp.maximum(tbl[y], 0)
+                bits = code[y].astype(jnp.float32)
+                mask = (tbl[y] >= 0).astype(jnp.float32)
+            else:
+                idx = jnp.asarray(self._idx)[y]          # [B, L]
+                bits = jnp.asarray(self._bit)[y]
+                mask = jnp.asarray(self._msk)[y]
+            wp = w[idx]                                  # [B, L, F]
+            pre = jnp.einsum("blf,bf->bl", wp, x.astype(w.dtype))
+            if b is not None:
+                pre = pre + b[idx]
+            pre = jnp.clip(pre, -40.0, 40.0)
+            loss = (jax.nn.softplus(pre) - bits * pre) * mask
+            return loss.sum(axis=-1, keepdims=True)
+
+        args = [input, label, self.weight]
+        if self.bias is not None:
+            args.append(self.bias)
+        if self.is_custom:
+            args += [path_table, path_code]
+        return AG.apply(f, tuple(args), name="hsigmoid_loss")
+
+
+class NCELoss(Layer):
+    """Noise-contrastive estimation (reference: fluid.layers.nce over
+    operators/nce_op.h): per sample, o = sigmoid(logit), q = sampler
+    probability * num_neg_samples; cost = -log(o/(o+q)) for the true
+    class and -log(q/(o+q)) for each sampled noise class. Uniform
+    sampler (the reference default); noise ids draw from the framework
+    RNG per call."""
+
+    def __init__(self, num_classes, dim, num_neg_samples=10,
+                 weight_attr=None, bias_attr=None, sampler="uniform",
+                 name=None):
+        super().__init__()
+        if sampler != "uniform":
+            raise NotImplementedError(
+                "NCELoss sampler: only 'uniform' is built (the reference's "
+                "log_uniform/custom_dist samplers change only q(s))"
+            )
+        self.num_classes = int(num_classes)
+        self.num_neg = int(num_neg_samples)
+        self.weight = self.create_parameter(
+            shape=[num_classes, dim], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[num_classes], attr=bias_attr, is_bias=True
+            )
+        else:
+            self.bias = None
+
+    def forward(self, input, label):
+        from ...core import autograd as AG
+        from ...core import random as rnd
+        import jax
+        import jax.numpy as jnp
+
+        key = rnd.next_key()
+        E, S = self.num_classes, self.num_neg
+        q = S / E  # uniform sampler: Probability(c) * num_neg
+
+        def f(x, y, w, *rest):
+            b = rest[0] if rest else None
+            B = x.shape[0]
+            noise = jax.random.randint(key, (B, S), 0, E)
+            y2 = y.reshape(B, 1)
+            ids = jnp.concatenate([y2, noise], axis=1)   # [B, 1+S]
+            logits = jnp.einsum(
+                "bsd,bd->bs", w[ids].astype(jnp.float32),
+                x.astype(jnp.float32),
+            )
+            if b is not None:
+                logits = logits + b[ids]
+            o = jax.nn.sigmoid(logits)
+            true_cost = -jnp.log(o[:, :1] / (o[:, :1] + q) + 1e-20)
+            noise_cost = -jnp.log(q / (o[:, 1:] + q) + 1e-20)
+            return (true_cost.sum(-1) + noise_cost.sum(-1))[:, None]
+
+        args = [input, label, self.weight]
+        if self.bias is not None:
+            args.append(self.bias)
+        return AG.apply(f, tuple(args), name="nce_loss")
+
+
+__all__ += ["HSigmoidLoss", "NCELoss"]

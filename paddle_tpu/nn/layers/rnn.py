@@ -18,7 +18,7 @@ from ...core.tensor import Tensor
 from ..initializer import Uniform
 from ..layer import Layer
 
-__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM", "GRU", "BiRNN"]
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM", "GRU", "BiRNN"]
 
 
 class RNNCellBase(Layer):

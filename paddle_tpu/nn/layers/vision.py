@@ -1,0 +1,5 @@
+"""paddle.nn vision layers module alias (reference:
+python/paddle/nn/layer/vision.py — PixelShuffle lives here)."""
+from .common import PixelShuffle  # noqa: F401
+
+__all__ = ["PixelShuffle"]

@@ -1,0 +1,95 @@
+"""HSigmoidLoss / NCELoss / PairwiseDistance (the nn layer-list tail).
+
+HSigmoid parity: direct python transcription of the reference's
+SimpleCode bit-path math (matrix_bit_code.h:106-121 + the
+hierarchical_sigmoid_op.h softplus-minus-bits form)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _hsig_ref(x, y, w, b, num_classes):
+    out = np.zeros((x.shape[0], 1), np.float64)
+    for n in range(x.shape[0]):
+        code = int(y[n]) + num_classes
+        length = code.bit_length() - 1
+        for j in range(length):
+            idx = (code >> (j + 1)) - 1
+            bit = (code >> j) & 1
+            pre = float(np.clip(x[n] @ w[idx] + b[idx], -40, 40))
+            out[n] += np.log1p(np.exp(pre)) - bit * pre
+    return out
+
+
+def test_hsigmoid_matches_bitcode_reference():
+    rng = np.random.RandomState(0)
+    for num_classes in (4, 5, 10):
+        paddle.seed(1)
+        hs = nn.HSigmoidLoss(6, num_classes)
+        x = rng.rand(8, 6).astype(np.float32)
+        y = rng.randint(0, num_classes, 8).astype(np.int64)
+        got = hs(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        want = _hsig_ref(x, y, np.asarray(hs.weight._data),
+                         np.asarray(hs.bias._data), num_classes)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_hsigmoid_trains():
+    paddle.seed(2)
+    hs = nn.HSigmoidLoss(8, 6)
+    fc = nn.Linear(4, 8)
+    opt = paddle.optimizer.Adam(
+        learning_rate=0.1,
+        parameters=list(hs.parameters()) + list(fc.parameters()),
+    )
+    rng = np.random.RandomState(1)
+    x = rng.rand(16, 4).astype(np.float32)
+    y = (rng.randint(0, 6, 16)).astype(np.int64)
+    losses = []
+    for _ in range(15):
+        loss = hs(fc(paddle.to_tensor(x)), paddle.to_tensor(y)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_nce_loss_math_and_training():
+    paddle.seed(3)
+    layer = nn.NCELoss(num_classes=20, dim=8, num_neg_samples=5)
+    x = np.random.RandomState(2).rand(4, 8).astype(np.float32)
+    y = np.array([1, 7, 3, 19], np.int64)
+    out = layer(paddle.to_tensor(x), paddle.to_tensor(y))
+    assert out.shape == [4, 1]
+    assert (out.numpy() > 0).all()  # NCE cost is positive
+
+    # training sanity: separable toy problem, loss decreases
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=layer.parameters())
+    losses = []
+    for _ in range(20):
+        loss = layer(paddle.to_tensor(x), paddle.to_tensor(y)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+    import pytest
+
+    with pytest.raises(NotImplementedError, match="uniform"):
+        nn.NCELoss(10, 4, sampler="log_uniform")
+
+
+def test_pairwise_distance():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    b = np.array([[0.0, 0.0], [3.0, 5.0]], np.float32)
+    d = nn.PairwiseDistance(p=2.0)
+    got = d(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    want = np.linalg.norm(a - b + 1e-6, axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    d1 = nn.PairwiseDistance(p=1.0, keepdim=True)
+    got1 = d1(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    assert got1.shape == (2, 1)
