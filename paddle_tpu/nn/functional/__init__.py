@@ -6,3 +6,99 @@ from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from . import activation, common, conv, loss, norm, pooling  # noqa: F401
+
+# -- fluid-era functional tail (round 5): real ops + aliases ---------------
+from .extras import (  # noqa: F401,E402
+    add_position_encoding,
+    affine_grid,
+    array_length,
+    array_read,
+    array_write,
+    bpr_loss,
+    create_array,
+    dice_loss,
+    fc,
+    grid_sample,
+    image_resize,
+    pad2d,
+    pool2d,
+    pool3d,
+    resize_bilinear,
+    resize_nearest,
+    resize_trilinear,
+    shuffle_channel,
+    smooth_l1,
+    soft_relu,
+    space_to_depth,
+    temporal_shift,
+)
+# detection / sequence families live in vision.ops and ops.sequence; the
+# reference re-exports them through nn.functional too. Resolved LAZILY:
+# vision imports nn (models), so an eager import here would be circular.
+_VISION_ALIASES = {
+    "anchor_generator": "anchor_generator",
+    "box_clip": "box_clip",
+    "box_coder": "box_coder",
+    "deformable_conv": "deform_conv2d",
+    "iou_similarity": "iou_similarity",
+    "multiclass_nms": "multiclass_nms",
+    "prior_box": "prior_box",
+    "roi_align": "roi_align",
+    "yolo_box": "yolo_box",
+    "yolov3_loss": "yolo_loss",
+}
+_SEQUENCE_ALIASES = [
+    "sequence_conv", "sequence_enumerate", "sequence_expand",
+    "sequence_first_step", "sequence_last_step", "sequence_mask",
+    "sequence_pad", "sequence_pool", "sequence_reverse",
+    "sequence_slice", "sequence_softmax", "sequence_unpad",
+    "sequence_concat", "sequence_expand_as", "sequence_reshape",
+    "sequence_scatter",
+]
+_OPS_ALIASES = {"erf": "math", "diag_embed": "manipulation"}
+
+
+def __getattr__(name):
+    if name in _VISION_ALIASES:
+        from ...vision import ops as _vops
+
+        return getattr(_vops, _VISION_ALIASES[name])
+    if name in _SEQUENCE_ALIASES:
+        from ...ops import sequence as _seq
+
+        return getattr(_seq, name)
+    if name in _OPS_ALIASES:
+        import importlib
+
+        mod = importlib.import_module(
+            f"paddle_tpu.ops.{_OPS_ALIASES[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# trailing-underscore "inplace" forms: jax arrays are immutable, so these
+# are the functional ops under the reference's inplace names (semantics
+# match — 2.0's *_ differ only by buffer reuse)
+relu_ = relu  # noqa: E402
+tanh_ = tanh  # noqa: E402
+softmax_ = softmax  # noqa: E402
+elu_ = elu  # noqa: E402
+from .extras import (  # noqa: F401,E402
+    affine_channel,
+    bilinear_tensor_product,
+    birnn,
+    bpr_loss,
+    density_prior_box,
+    fsp_matrix,
+    hsigmoid_loss,
+    image_resize_short,
+    nce,
+    pad_constant_like,
+    random_crop,
+    rnn,
+    roi_pool,
+    spectral_norm,
+    tensor_array_to_tensor,
+    warpctc,
+)

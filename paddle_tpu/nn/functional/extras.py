@@ -1,0 +1,751 @@
+"""Fluid-era functional tail (reference: python/paddle/nn/functional/
+__init__.py re-exports of fluid.layers names — extension.py, common.py,
+vision.py, loss.py).
+
+Three kinds of entries:
+  - aliases onto the modern implementations that already exist elsewhere
+    in this package (detection ops in vision.ops, sequence ops in
+    ops.sequence, resize onto interpolate, fluid pool2d/pool3d onto the
+    typed pools, trailing-underscore "inplace" names onto the functional
+    forms — tensors are immutable jax arrays, matching how 2.0's
+    `relu_` only differs by buffer reuse);
+  - small REAL ops implemented here: grid_sample + affine_grid
+    (bilinear STN pair), space_to_depth, shuffle_channel,
+    temporal_shift, dice_loss, bpr_loss, soft_relu, pad2d,
+    add_position_encoding, fluid tensor-array ops
+    (create_array/array_read/array_write/array_length) as eager list
+    semantics;
+  - absent-on-TPU surfaces raise loudly at the module attribute
+    (warpctc -> use ctc_loss; parameter-server/sparse ops are out of
+    scope).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as AG
+from ...core.tensor import Tensor
+
+__all__ = [
+    "grid_sample", "affine_grid", "space_to_depth", "shuffle_channel",
+    "temporal_shift", "dice_loss", "bpr_loss", "soft_relu", "pad2d",
+    "add_position_encoding", "create_array", "array_write", "array_read",
+    "array_length", "fc", "smooth_l1", "image_resize", "resize_bilinear",
+    "resize_nearest", "resize_trilinear", "pool2d", "pool3d",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# -- spatial transformer pair (operators/grid_sampler_op.*, affine_grid) ----
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] + out_shape (N, C, H, W) -> grid [N, H, W, 2] of
+    normalized (x, y) sample locations (affine_grid_op.cc)."""
+    theta = _t(theta)
+    N, C, H, W = (int(s) for s in out_shape)
+
+    def f(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+        xg, yg = jnp.meshgrid(xs, ys)                 # [H, W]
+        ones = jnp.ones_like(xg)
+        base = jnp.stack([xg, yg, ones], axis=-1)     # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", base, th.astype(jnp.float32))
+
+    return AG.apply(f, (theta,), name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """operators/grid_sampler_op.h: sample x [N, C, H, W] at grid
+    [N, Hg, Wg, 2] normalized locations; bilinear or nearest; zeros /
+    border / reflection padding. Differentiable in x and grid."""
+    x, grid = _t(x), _t(grid)
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"grid_sample padding_mode {padding_mode!r}")
+
+    def f(im, g):
+        N, C, H, W = im.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1.0) / 2.0 * (W - 1)
+            fy = (gy + 1.0) / 2.0 * (H - 1)
+        else:
+            fx = ((gx + 1.0) * W - 1.0) / 2.0
+            fy = ((gy + 1.0) * H - 1.0) / 2.0
+
+        def reflect(v, lo, hi):
+            rng = hi - lo
+            v = jnp.abs((v - lo) % (2 * rng + 1e-9))
+            return jnp.where(v > rng, 2 * rng - v, v) + lo
+
+        if padding_mode == "reflection":
+            if align_corners:
+                fx = reflect(fx, 0.0, W - 1.0)
+                fy = reflect(fy, 0.0, H - 1.0)
+            else:  # reference folds at the half-pixel border
+                fx = jnp.clip(reflect(fx, -0.5, W - 0.5), 0, W - 1)
+                fy = jnp.clip(reflect(fy, -0.5, H - 0.5), 0, H - 1)
+
+        def fetch(ix, iy):
+            okx = (ix >= 0) & (ix <= W - 1)
+            oky = (iy >= 0) & (iy <= H - 1)
+            cx = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+            cy = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+            # [N, Hg, Wg] indices -> gather per batch
+            v = jax.vmap(lambda imn, cyn, cxn: imn[:, cyn, cxn])(
+                im, cy, cx
+            )                                          # [N, C, Hg, Wg]
+            if padding_mode == "zeros":
+                m = (okx & oky)[:, None, :, :]
+                v = jnp.where(m, v, 0.0)
+            return v
+
+        if mode == "nearest":
+            return fetch(jnp.round(fx), jnp.round(fy))
+        x0, y0 = jnp.floor(fx), jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = ((x1 - fx) * (y1 - fy))[:, None]
+        wb = ((x1 - fx) * (fy - y0))[:, None]
+        wc = ((fx - x0) * (y1 - fy))[:, None]
+        wd = ((fx - x0) * (fy - y0))[:, None]
+        return (fetch(x0, y0) * wa + fetch(x0, y1) * wb
+                + fetch(x1, y0) * wc + fetch(x1, y1) * wd)
+
+    return AG.apply(f, (x, grid), name="grid_sample")
+
+
+# -- small vision ops -------------------------------------------------------
+
+
+def space_to_depth(x, blocksize, name=None):
+    """operators/space_to_depth_op.cc: [N, C, H, W] ->
+    [N, C*bs^2, H/bs, W/bs] (the MLPerf ResNet stem trick)."""
+    x = _t(x)
+    bs = int(blocksize)
+
+    def f(a):
+        N, C, H, W = a.shape
+        a = a.reshape(N, C, H // bs, bs, W // bs, bs)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(N, C * bs * bs, H // bs, W // bs)
+
+    return AG.apply(f, (x,), name="space_to_depth")
+
+
+def shuffle_channel(x, group, name=None):
+    """operators/shuffle_channel_op.cc (ShuffleNet channel shuffle)."""
+    x = _t(x)
+    g = int(group)
+
+    def f(a):
+        N, C, H, W = a.shape
+        return a.reshape(N, g, C // g, H, W).transpose(
+            0, 2, 1, 3, 4
+        ).reshape(N, C, H, W)
+
+    return AG.apply(f, (x,), name="shuffle_channel")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """operators/temporal_shift_op.h (TSM): shift a channel slice one
+    step forward/backward along the segment axis."""
+    x = _t(x)
+    T = int(seg_num)
+    r = float(shift_ratio)
+
+    def f(a):
+        NT, C, H, W = a.shape
+        N = NT // T
+        c1 = int(C * r)
+        c2 = int(C * 2 * r)
+        a = a.reshape(N, T, C, H, W)
+        fwd = jnp.concatenate(
+            [a[:, 1:, :c1], jnp.zeros_like(a[:, :1, :c1])], axis=1
+        )
+        back = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, c1:c2]), a[:, :-1, c1:c2]], axis=1
+        )
+        return jnp.concatenate(
+            [fwd, back, a[:, :, c2:]], axis=2
+        ).reshape(NT, C, H, W)
+
+    return AG.apply(f, (x,), name="temporal_shift")
+
+
+# -- small losses / activations --------------------------------------------
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """fluid.layers.dice_loss: 1 - 2|X∩Y| / (|X|+|Y|)."""
+    input, label = _t(input), _t(label)
+
+    def f(p, y):
+        y = jax.nn.one_hot(
+            y[..., 0].astype(jnp.int32), p.shape[-1], dtype=p.dtype
+        ) if y.shape[-1] == 1 and p.shape[-1] > 1 else y.astype(p.dtype)
+        axes = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y, axis=axes)
+        union = jnp.sum(p, axis=axes) + jnp.sum(y, axis=axes)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return AG.apply(f, (input, label), name="dice_loss")
+
+
+def bpr_loss(input, label, name=None):
+    """operators/bpr_loss_op.h: Bayesian personalized ranking —
+    -mean_j log(sigmoid(x_label - x_j)) over j != label."""
+    input, label = _t(input), _t(label)
+
+    def f(x, y):
+        B, C = x.shape
+        pos = jnp.take_along_axis(
+            x, y.reshape(B, 1).astype(jnp.int32), axis=1
+        )
+        diff = pos - x                                  # [B, C]
+        lg = jnp.log(jax.nn.sigmoid(diff) + 1e-12)
+        mask = 1.0 - jax.nn.one_hot(
+            y.reshape(B).astype(jnp.int32), C, dtype=x.dtype
+        )
+        return (-(lg * mask).sum(1) / jnp.maximum(C - 1, 1))[:, None]
+
+    return AG.apply(f, (input, label), name="bpr_loss")
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """fluid.layers.soft_relu: log(1 + exp(clip(x, -t, t)))."""
+    x = _t(x)
+
+    def f(a):
+        return jnp.log1p(jnp.exp(jnp.clip(a, -threshold, threshold)))
+
+    return AG.apply(f, (x,), name="soft_relu")
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """operators/add_position_encoding_op.h: out = alpha*x + beta*PE
+    with the sinusoidal transformer position encoding."""
+    input = _t(input)
+
+    def f(x):
+        B, T, C = x.shape
+        half = C // 2
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        den = jnp.power(
+            10000.0, jnp.arange(half, dtype=jnp.float32) / half
+        )[None, :]
+        pe = jnp.concatenate(
+            [jnp.sin(pos / den), jnp.cos(pos / den)], axis=-1
+        )
+        return alpha * x + beta * pe[None, :, :].astype(x.dtype)
+
+    return AG.apply(f, (input,), name="add_position_encoding")
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """fluid.layers.pad2d -> nn.functional.pad. Fluid's `paddings` order
+    is [top, bottom, left, right]; the 2.0 pad takes
+    [left, right, top, bottom]."""
+    from .common import pad as _pad
+
+    t, b, l, r = (int(v) for v in paddings)
+    return _pad(input, [l, r, t, b], mode=mode, value=pad_value,
+                data_format=data_format)
+
+
+# -- fluid tensor-array (LoDTensorArray) ops --------------------------------
+
+
+def create_array(dtype="float32"):
+    """fluid.layers.create_array: eager list semantics (the TPU static
+    path uses lax.scan/while carries instead of tensor arrays)."""
+    return []
+
+
+def array_write(x, i, array=None):
+    x = _t(x)
+    i = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    if array is None:
+        array = []
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x
+    return array
+
+
+def array_read(array, i):
+    i = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    return array[i]
+
+
+def array_length(array):
+    from ...ops.creation import to_tensor
+
+    return to_tensor(len(array), dtype="int64")
+
+
+# -- fluid aliases over modern implementations ------------------------------
+
+
+def fc(x, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """fluid.layers.fc -> static.nn.fc (fresh parameters per call)."""
+    from ...static.nn import fc as _fc
+
+    return _fc(x, size, num_flatten_dims=num_flatten_dims,
+               weight_attr=param_attr, bias_attr=bias_attr,
+               activation=act)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None,
+              name=None):
+    """fluid.layers.smooth_l1 (operators/smooth_l1_loss_op.h): the diff
+    scales by inside_weight BEFORE the huber form, the per-element loss
+    by outside_weight after; per-sample sum. sigma2 = sigma^2 sets the
+    |d| < 1/sigma2 crossover."""
+    x, y = _t(x), _t(y)
+    sigma2 = 1.0 if sigma is None else float(sigma) ** 2
+
+    def f(a, b, *w):
+        d = a - b
+        i = 0
+        if inside_weight is not None:
+            d = d * w[i]
+            i += 1
+        ad = jnp.abs(d)
+        loss = jnp.where(
+            ad < 1.0 / sigma2,
+            0.5 * sigma2 * d * d,
+            ad - 0.5 / sigma2,
+        )
+        if outside_weight is not None:
+            loss = loss * w[i]
+        return loss.sum(axis=-1, keepdims=True)
+
+    args = (x, y) + tuple(
+        _t(v) for v in (inside_weight, outside_weight) if v is not None
+    )
+    return AG.apply(f, args, name="smooth_l1")
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, align_mode=1, data_format="NCHW",
+                 name=None):
+    from .common import interpolate
+
+    return interpolate(
+        _t(input), size=out_shape, scale_factor=scale,
+        mode=resample.lower(), align_corners=align_corners,
+        data_format=data_format,
+    )
+
+
+def resize_bilinear(input, out_shape=None, scale=None, align_corners=True,
+                    align_mode=1, data_format="NCHW", name=None):
+    return image_resize(input, out_shape, scale, "BILINEAR",
+                        align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, align_corners=True,
+                   data_format="NCHW", name=None):
+    return image_resize(input, out_shape, scale, "NEAREST",
+                        align_corners, 1, data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, align_corners=True,
+                     align_mode=1, data_format="NCDHW", name=None):
+    from .common import interpolate
+
+    return interpolate(
+        _t(input), size=out_shape, scale_factor=scale, mode="trilinear",
+        align_corners=align_corners, data_format=data_format,
+    )
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCHW", name=None):
+    """fluid.layers.pool2d adapter over the typed pools (NCHW kernels;
+    NHWC transposes around them)."""
+    from ...ops.manipulation import transpose
+    from .pooling import avg_pool2d, max_pool2d
+
+    x = _t(input)
+    if data_format == "NHWC":
+        x = transpose(x, [0, 3, 1, 2])
+    if global_pooling:
+        def f(a):
+            red = jnp.max if pool_type == "max" else jnp.mean
+            return red(a, axis=(2, 3), keepdims=True)
+
+        out = AG.apply(f, (x,), name="pool2d_global")
+    elif pool_type == "max":
+        out = max_pool2d(x, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode)
+    else:
+        out = avg_pool2d(x, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive)
+    if data_format == "NHWC":
+        out = transpose(out, [0, 2, 3, 1])
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", name=None):
+    from .pooling import avg_pool3d, max_pool3d
+
+    if global_pooling:
+        x = _t(input)
+
+        def f(a):
+            axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+            red = jnp.max if pool_type == "max" else jnp.mean
+            return red(a, axis=axes, keepdims=True)
+
+        return AG.apply(f, (x,), name="pool3d_global")
+    if pool_type == "max":
+        return max_pool3d(_t(input), pool_size, stride=pool_stride,
+                          padding=pool_padding, ceil_mode=ceil_mode)
+    return avg_pool3d(_t(input), pool_size, stride=pool_stride,
+                      padding=pool_padding, ceil_mode=ceil_mode,
+                      exclusive=exclusive)
+
+
+# -- second tier (round 5): more fluid.layers names -------------------------
+
+__all__ += [
+    "affine_channel", "pad_constant_like", "fsp_matrix", "random_crop",
+    "image_resize_short", "roi_pool", "density_prior_box",
+    "bilinear_tensor_product", "spectral_norm", "warpctc",
+    "hsigmoid_loss", "nce", "rnn", "birnn", "tensor_array_to_tensor",
+]
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   name=None):
+    """operators/affine_channel_op.cc: per-channel x*scale + bias."""
+    x = _t(x)
+    ch = 1 if data_layout == "NCHW" else -1
+
+    def f(a, *sb):
+        shape = [1] * a.ndim
+        shape[ch if ch >= 0 else a.ndim - 1] = a.shape[ch]
+        out = a
+        i = 0
+        if scale is not None:
+            out = out * sb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + sb[i].reshape(shape)
+        return out
+
+    args = (x,) + tuple(_t(v) for v in (scale, bias) if v is not None)
+    return AG.apply(f, args, name="affine_channel")
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """operators/pad_constant_like_op.cc: pad y up to x's shape."""
+    x, y = _t(x), _t(y)
+
+    def f(a, b):
+        pads = [(0, a.shape[i] - b.shape[i]) for i in range(b.ndim)]
+        return jnp.pad(b, pads, constant_values=pad_value)
+
+    return AG.apply(f, (x, y), name="pad_constant_like")
+
+
+def fsp_matrix(x, y, name=None):
+    """operators/fsp_op.h (flow of solution procedure): [N, C1, H, W] x
+    [N, C2, H, W] -> [N, C1, C2] = (1/HW) sum_hw x_c1 y_c2."""
+    x, y = _t(x), _t(y)
+
+    def f(a, b):
+        hw = a.shape[2] * a.shape[3]
+        return jnp.einsum("nchw,ndhw->ncd", a, b) / hw
+
+    return AG.apply(f, (x, y), name="fsp_matrix")
+
+
+def random_crop(x, shape, seed=None, name=None):
+    """fluid.layers.random_crop: per-sample random spatial crop to
+    `shape` (trailing dims)."""
+    from ...core import random as rnd
+
+    x = _t(x)
+    key = rnd.next_key() if seed is None else jax.random.PRNGKey(int(seed))
+    tgt = list(shape)
+
+    def f(a):
+        nd = a.ndim
+        k = len(tgt)
+
+        def crop_one(sample, skey):
+            keys = jax.random.split(skey, k)
+            starts = [0] * (sample.ndim - k)
+            for i in range(k):
+                hi = sample.shape[sample.ndim - k + i] - tgt[i]
+                starts.append(
+                    jax.random.randint(keys[i], (), 0, hi + 1)
+                    if hi > 0 else 0
+                )
+            return jax.lax.dynamic_slice(
+                sample, tuple(starts),
+                tuple(list(sample.shape[: sample.ndim - k]) + tgt),
+            )
+
+        if nd > k:  # leading batch axis: independent crop per sample
+            skeys = jax.random.split(key, a.shape[0])
+            return jax.vmap(crop_one)(a, skeys)
+        return crop_one(a, key)
+
+    return AG.apply(f, (x,), name="random_crop")
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR",
+                       name=None):
+    """fluid.layers.image_resize_short: scale so the SHORT side equals
+    out_short_len (aspect preserved, rounded)."""
+    x = _t(input)
+    H, W = int(x.shape[2]), int(x.shape[3])
+    short = min(H, W)
+    ratio = float(out_short_len) / short
+    out = [int(round(H * ratio)), int(round(W * ratio))]
+    return image_resize(x, out_shape=out, resample=resample)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """operators/roi_pool_op: quantized max pooling per RoI bin
+    (roi_align's hard-bin ancestor)."""
+    from ...vision.ops import roi_align  # noqa: F401  (same arg shape)
+
+    if isinstance(output_size, int):
+        oh = ow = int(output_size)
+    else:
+        oh, ow = int(output_size[0]), int(output_size[1])
+    x = _t(x)
+    boxes = _t(boxes)
+    bn = _t(boxes_num)
+
+    def f(feat, bxs, bnum):
+        N, C, H, W = feat.shape
+        R = bxs.shape[0]
+        img_of_roi = jnp.repeat(
+            jnp.arange(N), bnum, total_repeat_length=R
+        )
+        x1 = jnp.round(bxs[:, 0] * spatial_scale)
+        y1 = jnp.round(bxs[:, 1] * spatial_scale)
+        x2 = jnp.round(bxs[:, 2] * spatial_scale)
+        y2 = jnp.round(bxs[:, 3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+
+        def one(ri):
+            fm = feat[img_of_roi[ri]]                  # [C, H, W]
+            # bin of each pixel relative to this roi (floor quantized)
+            by = jnp.floor((ys - y1[ri]) / rh[ri] * oh)
+            bx = jnp.floor((xs - x1[ri]) / rw[ri] * ow)
+            inside_y = (ys >= y1[ri]) & (ys <= y2[ri])
+            inside_x = (xs >= x1[ri]) & (xs <= x2[ri])
+            oh_ids = jnp.clip(by, 0, oh - 1).astype(jnp.int32)
+            ow_ids = jnp.clip(bx, 0, ow - 1).astype(jnp.int32)
+            masked = jnp.where(
+                (inside_y[:, None] & inside_x[None, :])[None],
+                fm, -jnp.inf,
+            )
+            out = jnp.zeros((C, oh, ow), feat.dtype) - jnp.inf
+            out = out.at[:, oh_ids[:, None], ow_ids[None, :]].max(masked)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(one)(jnp.arange(R))
+
+    return AG.apply(f, (x, boxes, bn), name="roi_pool")
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """operators/detection/density_prior_box_op.h: per cell, for each
+    (density, fixed_size) pair, a density x density grid of shifted
+    boxes per fixed ratio."""
+    import numpy as np
+
+    inp = _t(input)
+    img = _t(image)
+    H, W = int(inp._data.shape[2]), int(inp._data.shape[3])
+    IH, IW = int(img._data.shape[2]), int(img._data.shape[3])
+    step_w = steps[0] or IW / W
+    step_h = steps[1] or IH / H
+
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for density, fs in zip(densities, fixed_sizes):
+                for ar in fixed_ratios:
+                    bw = fs * np.sqrt(ar)
+                    bh = fs / np.sqrt(ar)
+                    shift = int(fs / density)
+                    for di in range(density):
+                        for dj in range(density):
+                            ccx = cx - fs / 2.0 + shift / 2.0 + dj * shift
+                            ccy = cy - fs / 2.0 + shift / 2.0 + di * shift
+                            boxes.append([
+                                (ccx - bw / 2.0) / IW,
+                                (ccy - bh / 2.0) / IH,
+                                (ccx + bw / 2.0) / IW,
+                                (ccy + bh / 2.0) / IH,
+                            ])
+    arr = np.asarray(boxes, np.float32)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    P = arr.shape[0] // (H * W)
+    arr = arr.reshape(H, W, P, 4)
+    var = np.broadcast_to(
+        np.asarray(variance, np.float32), arr.shape
+    ).copy()
+    if flatten_to_2d:
+        arr = arr.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return (Tensor(jnp.asarray(arr)), Tensor(jnp.asarray(var)))
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """fluid.layers.bilinear_tensor_product: fresh-parameter builder over
+    nn.Bilinear."""
+    from ..layers.common import Bilinear
+
+    layer = Bilinear(int(x.shape[-1]), int(y.shape[-1]), int(size),
+                     weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(_t(x), _t(y))
+    if act is not None:
+        from . import activation as _act_mod
+
+        out = getattr(_act_mod, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """operators/spectral_norm_op.h: W / sigma_max(W) with power
+    iteration (fresh u/v per call — the LAYER form keeps them as
+    buffers)."""
+    w = _t(weight)
+    d = int(dim)
+
+    def f(W):
+        Wm = jnp.moveaxis(W, d, 0).reshape(W.shape[d], -1)
+        u = jnp.ones((Wm.shape[0],), W.dtype) / np.sqrt(Wm.shape[0])
+        v = None
+        for _ in range(max(int(power_iters), 1)):
+            v = Wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = Wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ (Wm @ v)
+        return W / (sigma + eps)
+
+    import numpy as np  # noqa: F811 — local for sqrt above
+
+    return AG.apply(f, (w,), name="spectral_norm")
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """fluid.layers.warpctc compatibility: routes to ctc_loss (the CUDA
+    warp-ctc kernel's TPU analog is the XLA-compiled dynamic program in
+    nn.functional.ctc_loss). Requires the padded-dense form (lengths
+    given) — LoD inputs predate the 2.0 API."""
+    if input_length is None or label_length is None:
+        raise NotImplementedError(
+            "warpctc without explicit lengths is the fluid LoD form; "
+            "pass input_length/label_length (padded-dense) or call "
+            "nn.functional.ctc_loss directly"
+        )
+    from .loss import ctc_loss
+
+    return ctc_loss(_t(input), _t(label), _t(input_length),
+                    _t(label_length), blank=blank, reduction="none")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Functional form of HSigmoidLoss (same SimpleCode math) over
+    EXPLICIT weight/bias tensors."""
+    from ..layers.loss import _hsigmoid_apply, _hsigmoid_tables
+
+    tables = None if path_table is not None else _hsigmoid_tables(
+        int(num_classes)
+    )
+    return _hsigmoid_apply(
+        _t(input), _t(label), _t(weight),
+        _t(bias) if bias is not None else None, tables,
+        path_table=path_table, path_code=path_code,
+    )
+
+
+def nce(input, label, num_total_classes, num_neg_samples=10,
+        sampler="uniform", weight=None, bias=None, name=None, **kwargs):
+    """Functional NCE over explicit weight/bias (nce_op.h math)."""
+    from ...core import random as rnd
+    from ..layers.loss import _nce_apply
+
+    if sampler != "uniform":
+        raise NotImplementedError("nce sampler: only 'uniform'")
+    return _nce_apply(
+        _t(input), _t(label), _t(weight),
+        _t(bias) if bias is not None else None,
+        int(num_total_classes), int(num_neg_samples), rnd.next_key(),
+    )
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """paddle.nn.functional-style rnn: run a cell over the sequence via
+    the RNN layer machinery (lax.scan under trace)."""
+    from ..layers.rnn import RNN
+
+    runner = RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return runner(_t(inputs), initial_states=initial_states,
+                  sequence_length=sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    from ..layers.rnn import BiRNN
+
+    runner = BiRNN(cell_fw, cell_bw, time_major=time_major)
+    return runner(_t(inputs), initial_states=initial_states,
+                  sequence_length=sequence_length)
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """fluid.layers.tensor_array_to_tensor over the eager list arrays."""
+    from ...ops.manipulation import concat, stack
+
+    vals = [v for v in input if v is not None]
+    out = stack(vals, axis=axis) if use_stack else concat(vals, axis=axis)
+    lengths = [int(v.shape[axis]) if not use_stack else 1 for v in vals]
+    from ...ops.creation import to_tensor
+
+    return out, to_tensor(lengths, dtype="int64")

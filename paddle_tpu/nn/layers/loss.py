@@ -182,6 +182,100 @@ class SigmoidFocalLoss(Layer):
                                     self.gamma, self.reduction)
 
 
+def _hsigmoid_tables(num_classes):
+    """Static per-class (index, bit, mask) tables from SimpleCode
+    (matrix_bit_code.h:106-121)."""
+    import numpy as np
+
+    codes = np.arange(num_classes) + num_classes
+    max_len = int(np.floor(np.log2(2 * num_classes - 1)))
+    idx = np.zeros((num_classes, max_len), np.int32)
+    bit = np.zeros((num_classes, max_len), np.float32)
+    msk = np.zeros((num_classes, max_len), np.float32)
+    for c in range(num_classes):
+        code = int(codes[c])
+        for j in range(code.bit_length() - 1):
+            idx[c, j] = (code >> (j + 1)) - 1
+            bit[c, j] = (code >> j) & 1
+            msk[c, j] = 1.0
+    return idx, bit, msk
+
+
+def _hsigmoid_apply(input, label, weight, bias, tables, path_table=None,
+                    path_code=None):
+    """softplus(pre) - bit*pre over the class path, pre clipped to
+    [-40, 40] (hierarchical_sigmoid_op.h)."""
+    import jax.numpy as jnp
+
+    from ...core import autograd as AG
+
+    custom = path_table is not None
+
+    def f(x, y, w, *rest):
+        i = 0
+        b = None
+        if bias is not None:
+            b = rest[i]
+            i += 1
+        if custom:
+            tbl, code = rest[i], rest[i + 1]
+            idx = jnp.maximum(tbl[y], 0)
+            bits = code[y].astype(jnp.float32)
+            mask = (tbl[y] >= 0).astype(jnp.float32)
+        else:
+            t_idx, t_bit, t_msk = tables
+            idx = jnp.asarray(t_idx)[y]
+            bits = jnp.asarray(t_bit)[y]
+            mask = jnp.asarray(t_msk)[y]
+        wp = w[idx]
+        pre = jnp.einsum("blf,bf->bl", wp, x.astype(w.dtype))
+        if b is not None:
+            pre = pre + b[idx]
+        pre = jnp.clip(pre, -40.0, 40.0)
+        loss = (jax.nn.softplus(pre) - bits * pre) * mask
+        return loss.sum(axis=-1, keepdims=True)
+
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if custom:
+        args += [path_table, path_code]
+    return AG.apply(f, tuple(args), name="hsigmoid_loss")
+
+
+def _nce_apply(input, label, weight, bias, num_classes, num_neg, key):
+    """nce_op.h: o = sigmoid(logit), q = num_neg/num_classes (uniform);
+    cost = -log(o/(o+q)) [true] - sum log(q/(o+q)) [noise]."""
+    import jax.numpy as jnp
+
+    from ...core import autograd as AG
+
+    q = num_neg / num_classes
+
+    def f(x, y, w, *rest):
+        b = rest[0] if rest else None
+        B = x.shape[0]
+        noise = jax.random.randint(key, (B, num_neg), 0, num_classes)
+        ids = jnp.concatenate(
+            [y.reshape(B, 1), noise], axis=1
+        )
+        logits = jnp.einsum(
+            "bsd,bd->bs", w[ids].astype(jnp.float32),
+            x.astype(jnp.float32),
+        )
+        if b is not None:
+            logits = logits + b[ids]
+        o = jax.nn.sigmoid(logits)
+        true_cost = -jnp.log(o[:, :1] / (o[:, :1] + q) + 1e-20)
+        noise_cost = -jnp.log(q / (o[:, 1:] + q) + 1e-20)
+        return (true_cost.sum(-1) + noise_cost.sum(-1))[:, None]
+
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    return AG.apply(f, tuple(args), name="nce_loss")
+
+
 class HSigmoidLoss(Layer):
     """Hierarchical sigmoid over the default complete binary tree
     (reference: python/paddle/nn/functional/loss.py hsigmoid_loss over
@@ -219,62 +313,18 @@ class HSigmoidLoss(Layer):
         else:
             self.bias = None
         if not is_custom:
-            # static per-class (index, bit, mask) tables from SimpleCode
-            import numpy as np
-
-            codes = np.arange(num_classes) + num_classes
-            max_len = int(np.floor(np.log2(2 * num_classes - 1)))
-            idx = np.zeros((num_classes, max_len), np.int32)
-            bit = np.zeros((num_classes, max_len), np.float32)
-            msk = np.zeros((num_classes, max_len), np.float32)
-            for c in range(num_classes):
-                code = int(codes[c])
-                length = code.bit_length() - 1
-                for j in range(length):
-                    idx[c, j] = (code >> (j + 1)) - 1
-                    bit[c, j] = (code >> j) & 1
-                    msk[c, j] = 1.0
-            self._idx, self._bit, self._msk = idx, bit, msk
+            self._tables = _hsigmoid_tables(num_classes)
 
     def forward(self, input, label, path_table=None, path_code=None):
-        from ...core import autograd as AG
-        import jax.numpy as jnp
-        import numpy as np
-
         if self.is_custom and (path_table is None or path_code is None):
             raise ValueError(
                 "is_custom HSigmoidLoss needs path_table and path_code"
             )
-
-        def f(x, y, w, *rest):
-            i = 0
-            b = None
-            if self.bias is not None:
-                b = rest[i]
-                i += 1
-            if self.is_custom:
-                tbl, code = rest[i], rest[i + 1]
-                idx = jnp.maximum(tbl[y], 0)
-                bits = code[y].astype(jnp.float32)
-                mask = (tbl[y] >= 0).astype(jnp.float32)
-            else:
-                idx = jnp.asarray(self._idx)[y]          # [B, L]
-                bits = jnp.asarray(self._bit)[y]
-                mask = jnp.asarray(self._msk)[y]
-            wp = w[idx]                                  # [B, L, F]
-            pre = jnp.einsum("blf,bf->bl", wp, x.astype(w.dtype))
-            if b is not None:
-                pre = pre + b[idx]
-            pre = jnp.clip(pre, -40.0, 40.0)
-            loss = (jax.nn.softplus(pre) - bits * pre) * mask
-            return loss.sum(axis=-1, keepdims=True)
-
-        args = [input, label, self.weight]
-        if self.bias is not None:
-            args.append(self.bias)
-        if self.is_custom:
-            args += [path_table, path_code]
-        return AG.apply(f, tuple(args), name="hsigmoid_loss")
+        return _hsigmoid_apply(
+            input, label, self.weight, self.bias,
+            None if self.is_custom else self._tables,
+            path_table=path_table, path_code=path_code,
+        )
 
 
 class NCELoss(Layer):
@@ -308,36 +358,10 @@ class NCELoss(Layer):
             self.bias = None
 
     def forward(self, input, label):
-        from ...core import autograd as AG
         from ...core import random as rnd
-        import jax
-        import jax.numpy as jnp
 
-        key = rnd.next_key()
-        E, S = self.num_classes, self.num_neg
-        q = S / E  # uniform sampler: Probability(c) * num_neg
-
-        def f(x, y, w, *rest):
-            b = rest[0] if rest else None
-            B = x.shape[0]
-            noise = jax.random.randint(key, (B, S), 0, E)
-            y2 = y.reshape(B, 1)
-            ids = jnp.concatenate([y2, noise], axis=1)   # [B, 1+S]
-            logits = jnp.einsum(
-                "bsd,bd->bs", w[ids].astype(jnp.float32),
-                x.astype(jnp.float32),
-            )
-            if b is not None:
-                logits = logits + b[ids]
-            o = jax.nn.sigmoid(logits)
-            true_cost = -jnp.log(o[:, :1] / (o[:, :1] + q) + 1e-20)
-            noise_cost = -jnp.log(q / (o[:, 1:] + q) + 1e-20)
-            return (true_cost.sum(-1) + noise_cost.sum(-1))[:, None]
-
-        args = [input, label, self.weight]
-        if self.bias is not None:
-            args.append(self.bias)
-        return AG.apply(f, tuple(args), name="nce_loss")
+        return _nce_apply(input, label, self.weight, self.bias,
+                          self.num_classes, self.num_neg, rnd.next_key())
 
 
 __all__ += ["HSigmoidLoss", "NCELoss"]

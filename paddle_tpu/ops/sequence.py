@@ -332,3 +332,105 @@ def sequence_enumerate(x, win_size, pad_value=0, lengths=None, name=None):
         return jnp.stack(wins, axis=-1)
 
     return AG.apply_nondiff(f, args)
+
+
+__all__ += ["sequence_concat", "sequence_expand_as", "sequence_reshape",
+            "sequence_scatter"]
+
+
+def sequence_concat(x, name=None):
+    """sequence_concat_op: concatenate the VALID prefixes of several
+    padded batches row-wise. Input: list of (values [B, T_i, ...],
+    lengths [B]); returns (concat [B, sum T_i, ...], lengths [B])."""
+    vals = [as_tensor(v) for v, _ in x]
+    lens = [as_tensor(l) for _, l in x]
+
+    def f(*args):
+        k = len(args) // 2
+        vs, ls = args[:k], args[k:]
+        B = vs[0].shape[0]
+        T_out = sum(v.shape[1] for v in vs)
+        total = sum(ls)
+        out = jnp.zeros((B, T_out) + vs[0].shape[2:], vs[0].dtype)
+        pos = jnp.arange(T_out)
+        # place part i's valid prefix after the previous parts' lengths
+        offset = jnp.zeros((B,), ls[0].dtype)
+        for v, l in zip(vs, ls):
+            T = v.shape[1]
+            src_idx = jnp.clip(pos[None, :] - offset[:, None], 0, T - 1)
+            valid = (pos[None, :] >= offset[:, None]) & (
+                pos[None, :] < offset[:, None] + l[:, None]
+            )
+            gathered = jnp.take_along_axis(
+                v, src_idx.reshape(src_idx.shape + (1,) * (v.ndim - 2)),
+                axis=1,
+            )
+            m = valid.reshape(valid.shape + (1,) * (v.ndim - 2))
+            out = jnp.where(m, gathered, out)
+            offset = offset + l
+        return out, total
+
+    out = AG.apply(f, tuple(vals + lens), name="sequence_concat")
+    return out[0], out[1]
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    """sequence_expand_as_op: repeat row i of x y_lengths[i] times
+    (host-concrete lengths; the dense sibling of sequence_expand)."""
+    return sequence_expand(x, y_lengths)
+
+
+def sequence_reshape(x, lengths, new_dim, name=None):
+    """sequence_reshape_op in padded form: refold each row's valid
+    payload to width new_dim; returns (out [B, T2, new_dim], new
+    lengths). Row payloads must divide new_dim."""
+    import numpy as np
+
+    x, lengths = as_tensor(x), as_tensor(lengths)
+    D = int(x._data.shape[-1])
+    nd = int(new_dim)
+    lens = np.asarray(jax.device_get(lengths._data))
+    if ((lens * D) % nd).any():
+        raise ValueError(
+            "sequence_reshape: every row payload (length * dim) must be "
+            f"divisible by new_dim={nd}"
+        )
+    T2 = int((lens * D).max() // nd)
+
+    def f(vals, ls):
+        B, T = vals.shape[0], vals.shape[1]
+        flat = vals.reshape(B, T * D)
+        out = flat[:, : T2 * nd].reshape(B, T2, nd)
+        pos = jnp.arange(T2)
+        new_l = (ls * D) // nd
+        m = (pos[None, :] < new_l[:, None])[..., None]
+        return jnp.where(m, out, 0), new_l
+
+    out = AG.apply(f, (x, lengths), name="sequence_reshape")
+    return out[0], out[1]
+
+
+def sequence_scatter(x, index, updates, index_lengths=None, name=None):
+    """sequence_scatter_op in dense form: x [B, D] += scatter of
+    updates [B, T] at per-row positions index [B, T] (padded positions
+    masked by index_lengths)."""
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+    args = (x, index, updates) + (
+        (as_tensor(index_lengths),) if index_lengths is not None else ()
+    )
+
+    def f(a, idx, upd, *ln):
+        T = idx.shape[1]
+        if ln:
+            mask = (jnp.arange(T)[None, :] < ln[0][:, None]).astype(
+                upd.dtype
+            )
+        else:
+            mask = jnp.ones_like(upd)
+
+        def one(row, ridx, rupd):
+            return row.at[ridx].add(rupd)
+
+        return jax.vmap(one)(a, idx.astype(jnp.int32), upd * mask)
+
+    return AG.apply(f, args, name="sequence_scatter")

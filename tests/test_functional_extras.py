@@ -1,0 +1,230 @@
+"""Fluid-era functional tail (nn/functional/extras.py + the sequence
+tail): numpy-reference checks for every new REAL op."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import check_grad
+
+
+def test_affine_grid_identity_and_grid_sample_roundtrip():
+    # identity theta -> grid_sample reproduces the image
+    theta = np.tile(
+        np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], np.float32),
+        (2, 1, 1),
+    )
+    x = np.random.RandomState(0).rand(2, 3, 5, 7).astype(np.float32)
+    grid = F.affine_grid(paddle.to_tensor(theta), (2, 3, 5, 7))
+    out = F.grid_sample(paddle.to_tensor(x), grid)
+    np.testing.assert_allclose(out.numpy(), x, rtol=1e-4, atol=1e-5)
+    # grad check OFF the integer lattice: at exact integer sample
+    # coordinates floor() is discontinuous and the central-difference
+    # numeric gradient is ill-posed (the analytic grad is one-sided)
+    off_grid = np.asarray(grid.numpy()) * 0.83 + 0.011
+    check_grad(lambda a, g: F.grid_sample(a, g), [x, off_grid])
+
+
+def test_grid_sample_nearest_and_padding():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    # sample far outside with zeros padding -> 0
+    g = np.full((1, 1, 1, 2), 5.0, np.float32)
+    out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                        mode="nearest").numpy()
+    assert out[0, 0, 0, 0] == 0.0
+    out_b = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                          mode="nearest", padding_mode="border").numpy()
+    assert out_b[0, 0, 0, 0] == 3.0  # clamped to the corner
+
+
+def test_space_to_depth_and_shuffle_channel():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = F.space_to_depth(paddle.to_tensor(x), 2).numpy()
+    assert out.shape == (1, 4, 2, 2)
+    np.testing.assert_array_equal(out[0, 0], [[0, 2], [8, 10]])
+    c = np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1)
+    sh = F.shuffle_channel(paddle.to_tensor(c), 2).numpy().ravel()
+    np.testing.assert_array_equal(sh, [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+def test_temporal_shift():
+    x = np.arange(2 * 4 * 1 * 1, dtype=np.float32).reshape(2, 4, 1, 1)
+    out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                           shift_ratio=0.25).numpy()
+    # c0 shifts forward (next seg), c1 backward, c2+ unchanged
+    assert out[0, 0, 0, 0] == x[1, 0, 0, 0]   # from t+1
+    assert out[1, 0, 0, 0] == 0.0             # padded end
+    assert out[0, 1, 0, 0] == 0.0             # padded start
+    assert out[1, 1, 0, 0] == x[0, 1, 0, 0]   # from t-1
+    np.testing.assert_array_equal(out[:, 2:], x[:, 2:])
+
+
+def test_dice_bpr_soft_relu():
+    p = np.array([[0.8, 0.2], [0.3, 0.7]], np.float32)
+    y = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    d = F.dice_loss(paddle.to_tensor(p), paddle.to_tensor(y)).numpy()
+    inter = (p * y).sum(1)
+    want = np.mean(1 - (2 * inter + 1e-5) / (p.sum(1) + y.sum(1) + 1e-5))
+    np.testing.assert_allclose(d, want, rtol=1e-5)
+
+    x = np.array([[2.0, 0.5, -1.0]], np.float32)
+    lbl = np.array([0], np.int64)
+    bpr = F.bpr_loss(paddle.to_tensor(x), paddle.to_tensor(lbl)).numpy()
+    ref = -np.mean([np.log(1 / (1 + np.exp(-(2.0 - 0.5)))),
+                    np.log(1 / (1 + np.exp(-(2.0 + 1.0))))])
+    np.testing.assert_allclose(bpr[0, 0], ref, rtol=1e-5)
+
+    sr = F.soft_relu(paddle.to_tensor(np.array([0.0], np.float32)))
+    np.testing.assert_allclose(sr.numpy(), np.log(2.0), rtol=1e-6)
+
+
+def test_roi_pool_constant_and_max():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 3] = 9.0
+    boxes = np.array([[0.0, 0.0, 7.0, 7.0]], np.float32)
+    out = F.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                     paddle.to_tensor(np.array([1], np.int32)),
+                     output_size=2).numpy()
+    assert out.shape == (1, 1, 2, 2)
+    assert out.max() == 9.0
+
+
+def test_spectral_norm_unit_sigma():
+    w = np.random.RandomState(1).rand(6, 4).astype(np.float32) * 3
+    out = F.spectral_norm(paddle.to_tensor(w), power_iters=50).numpy()
+    assert abs(np.linalg.svd(out, compute_uv=False)[0] - 1.0) < 1e-3
+
+
+def test_affine_channel_pad_like_fsp():
+    x = np.ones((1, 2, 2, 2), np.float32)
+    s = np.array([2.0, 3.0], np.float32)
+    b = np.array([1.0, -1.0], np.float32)
+    out = F.affine_channel(paddle.to_tensor(x), paddle.to_tensor(s),
+                           paddle.to_tensor(b)).numpy()
+    np.testing.assert_array_equal(out[0, 0], 3.0)
+    np.testing.assert_array_equal(out[0, 1], 2.0)
+
+    big = np.zeros((2, 5), np.float32)
+    small = np.ones((2, 3), np.float32)
+    pl = F.pad_constant_like(paddle.to_tensor(big),
+                             paddle.to_tensor(small)).numpy()
+    assert pl.shape == (2, 5) and pl[:, 3:].sum() == 0
+
+    a = np.random.RandomState(2).rand(1, 2, 3, 3).astype(np.float32)
+    c = np.random.RandomState(3).rand(1, 4, 3, 3).astype(np.float32)
+    fsp = F.fsp_matrix(paddle.to_tensor(a), paddle.to_tensor(c)).numpy()
+    want = np.einsum("nchw,ndhw->ncd", a, c) / 9
+    np.testing.assert_allclose(fsp, want, rtol=1e-5)
+
+
+def test_random_crop_and_resize_short():
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    out = F.random_crop(paddle.to_tensor(x), [4, 4]).numpy()
+    assert out.shape == (1, 1, 4, 4)
+    # crop contents are a contiguous window of the source
+    assert np.isin(out, x).all()
+
+    r = F.image_resize_short(paddle.to_tensor(
+        np.zeros((1, 3, 40, 80), np.float32)), 20)
+    assert tuple(r.shape) == (1, 3, 20, 40)
+
+
+def test_hsigmoid_nce_functional_forms():
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    hs = nn.HSigmoidLoss(6, 5)
+    x = np.random.RandomState(4).rand(3, 6).astype(np.float32)
+    y = np.array([0, 2, 4], np.int64)
+    lay = hs(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    fun = F.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(y), 5,
+        hs.weight, hs.bias,
+    ).numpy()
+    np.testing.assert_allclose(fun, lay, rtol=1e-6)
+
+    w = np.random.RandomState(5).rand(10, 6).astype(np.float32)
+    b = np.zeros((10,), np.float32)
+    out = F.nce(paddle.to_tensor(x), paddle.to_tensor(y), 10,
+                num_neg_samples=3, weight=paddle.to_tensor(w),
+                bias=paddle.to_tensor(b))
+    assert out.shape == [3, 1] and (out.numpy() > 0).all()
+
+
+def test_sequence_tail_ops():
+    v1 = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+    l1 = np.array([2, 3], np.int64)
+    v2 = np.arange(8, dtype=np.float32).reshape(2, 2, 2) + 100
+    l2 = np.array([1, 2], np.int64)
+    out, lens = F.sequence_concat([(paddle.to_tensor(v1),
+                                    paddle.to_tensor(l1)),
+                                   (paddle.to_tensor(v2),
+                                    paddle.to_tensor(l2))])
+    np.testing.assert_array_equal(lens.numpy(), [3, 5])
+    np.testing.assert_array_equal(out.numpy()[0, :2], v1[0, :2])
+    np.testing.assert_array_equal(out.numpy()[0, 2], v2[0, 0])
+    np.testing.assert_array_equal(out.numpy()[1, 3:5], v2[1, :2])
+
+    x = np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+    ln = np.array([2, 1], np.int64)
+    rs, nl = F.sequence_reshape(paddle.to_tensor(x),
+                                paddle.to_tensor(ln), 1)
+    np.testing.assert_array_equal(nl.numpy(), [4, 2])
+    np.testing.assert_array_equal(rs.numpy()[0].ravel(), [0, 1, 2, 3])
+    assert rs.numpy()[1, 2:].sum() == 0
+
+    base = np.zeros((2, 5), np.float32)
+    idx = np.array([[0, 1], [2, 2]], np.int64)
+    upd = np.ones((2, 2), np.float32)
+    sc = F.sequence_scatter(paddle.to_tensor(base), paddle.to_tensor(idx),
+                            paddle.to_tensor(upd)).numpy()
+    np.testing.assert_array_equal(sc[0], [1, 1, 0, 0, 0])
+    np.testing.assert_array_equal(sc[1], [0, 0, 2, 0, 0])
+
+
+def test_fluid_array_and_pool_aliases():
+    arr = F.create_array()
+    F.array_write(paddle.to_tensor(np.ones((2, 2), np.float32)), 0, arr)
+    F.array_write(paddle.to_tensor(np.zeros((2, 2), np.float32)), 1, arr)
+    assert int(F.array_length(arr).numpy()) == 2
+    t, lens = F.tensor_array_to_tensor(arr, axis=0)
+    assert tuple(t.shape) == (4, 2)
+
+    x = np.random.RandomState(6).rand(1, 2, 4, 4).astype(np.float32)
+    mp = F.pool2d(paddle.to_tensor(x), 2, "max", 2).numpy()
+    assert mp.shape == (1, 2, 2, 2)
+    gp = F.pool2d(paddle.to_tensor(x), global_pooling=True,
+                  pool_type="avg").numpy()
+    np.testing.assert_allclose(gp[0, 0, 0, 0], x[0, 0].mean(), rtol=1e-5)
+
+
+def test_review_regressions():
+    """Code-review findings on the compat shim: fluid pad2d order, NHWC
+    pool2d, smooth_l1 weights, per-sample random_crop."""
+    x = np.zeros((1, 1, 2, 3), np.float32)
+    out = F.pad2d(paddle.to_tensor(x), (1, 0, 0, 0))   # top only
+    assert out.shape == [1, 1, 3, 3]
+
+    nhwc = np.random.RandomState(0).rand(1, 4, 4, 2).astype(np.float32)
+    gp = F.pool2d(paddle.to_tensor(nhwc), global_pooling=True,
+                  pool_type="max", data_format="NHWC")
+    assert tuple(gp.shape) == (1, 1, 1, 2)
+    np.testing.assert_allclose(
+        gp.numpy().ravel(), nhwc.max(axis=(1, 2)).ravel(), rtol=1e-6
+    )
+
+    sx = np.array([[1.0, 2.0]], np.float32)
+    sy = np.zeros((1, 2), np.float32)
+    iw = np.zeros((1, 2), np.float32)
+    out = F.smooth_l1(paddle.to_tensor(sx), paddle.to_tensor(sy),
+                      inside_weight=paddle.to_tensor(iw))
+    np.testing.assert_allclose(out.numpy(), 0.0)
+
+    paddle.seed(123)
+    batch = np.arange(4 * 64, dtype=np.float32).reshape(4, 1, 8, 8)
+    crops = F.random_crop(paddle.to_tensor(batch), [4, 4]).numpy()
+    assert crops.shape == (4, 1, 4, 4)
+    # per-sample independence: offsets must differ somewhere in a batch
+    offs = {int(c.ravel()[0]) % 64 for c in crops}
+    assert len(offs) > 1
