@@ -723,3 +723,100 @@ def anchor_generator(input, anchor_sizes, aspect_ratios,
     )
     return (Tensor._wrap(anchors, stop_gradient=True),
             Tensor._wrap(var, stop_gradient=True))
+
+
+__all__ += ["bipartite_match", "target_assign"]
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=None, name=None):
+    """operators/detection/bipartite_match_op.cc: greedy global matching
+    on a [N, num_gt, num_prior] (or [num_gt, num_prior]) distance/IoU
+    matrix. Repeatedly take the globally largest entry among unmatched
+    rows x columns (> 1e-6), assign column->row, retire the row; with
+    match_type='per_prediction', leftover columns whose best row exceeds
+    dist_threshold (default 0.5) take their argmax row.
+
+    Returns (match_indices int32 [N, P] with -1 for unmatched,
+    match_dist [N, P]). TPU-shaped: the greedy loop is a fixed
+    num_gt-iteration lax.fori_loop with masked argmax (no data-dependent
+    shapes)."""
+    d = dist_matrix if isinstance(dist_matrix, Tensor) else Tensor(
+        dist_matrix
+    )
+    if match_type not in ("bipartite", "per_prediction"):
+        raise ValueError(f"unknown match_type {match_type!r}")
+    thresh = 0.5 if dist_threshold is None else float(dist_threshold)
+    eps = 1e-6
+
+    def f(dist):
+        squeeze = dist.ndim == 2
+        if squeeze:
+            dist = dist[None]
+        N, R, C = dist.shape
+
+        def one(dm):
+            def body(_, carry):
+                match, mdist, row_used = carry
+                # mask out matched columns and used rows
+                avail = (match[None, :] == -1) & (~row_used[:, None]) \
+                    & (dm > eps)
+                masked = jnp.where(avail, dm, -1.0)
+                flat = jnp.argmax(masked)
+                r, c = flat // C, flat % C
+                best = masked.reshape(-1)[flat]
+                ok = best > eps
+                match = jnp.where(
+                    ok, match.at[c].set(r.astype(jnp.int32)), match
+                )
+                mdist = jnp.where(ok, mdist.at[c].set(best), mdist)
+                row_used = jnp.where(ok, row_used.at[r].set(True),
+                                     row_used)
+                return match, mdist, row_used
+
+            match = jnp.full((C,), -1, jnp.int32)
+            mdist = jnp.zeros((C,), dm.dtype)
+            row_used = jnp.zeros((R,), bool)
+            match, mdist, _ = jax.lax.fori_loop(
+                0, R, body, (match, mdist, row_used)
+            )
+            if match_type == "per_prediction":
+                best_r = jnp.argmax(dm, axis=0).astype(jnp.int32)
+                best_d = dm.max(axis=0)
+                take = (match == -1) & (best_d > thresh)
+                match = jnp.where(take, best_r, match)
+                mdist = jnp.where(take, best_d, mdist)
+            return match, mdist
+
+        match, mdist = jax.vmap(one)(dist)
+        if squeeze:
+            return match[0], mdist[0]
+        return match, mdist
+
+    out = AG.apply_nondiff(f, (d,))
+    return out[0], out[1]
+
+
+def target_assign(input, matched_indices, mismatch_value=0.0, name=None):
+    """operators/detection/target_assign_op in dense form: input
+    [N, B, K] per-gt targets, matched_indices [N, P] from
+    bipartite_match -> (out [N, P, K] gathered targets with
+    mismatch_value where unmatched, out_weight [N, P, 1] 1/0)."""
+    x = input if isinstance(input, Tensor) else Tensor(input)
+    m = matched_indices if isinstance(matched_indices, Tensor) else Tensor(
+        matched_indices
+    )
+
+    def f(t, idx):
+        matched = idx >= 0
+        safe = jnp.maximum(idx, 0)
+        gathered = jnp.take_along_axis(
+            t, safe[..., None].astype(jnp.int32), axis=1
+        )
+        out = jnp.where(matched[..., None], gathered,
+                        jnp.asarray(mismatch_value, t.dtype))
+        w = matched[..., None].astype(t.dtype)
+        return out, w
+
+    out = AG.apply_nondiff(f, (x, m))
+    return out[0], out[1]

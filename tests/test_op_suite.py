@@ -897,3 +897,71 @@ def test_anchor_generator_single_cell():
     np.testing.assert_allclose(
         anchors2.numpy()[0, 0, 0], [-14.0, -36.0, 29.0, 51.0], rtol=1e-6
     )
+
+
+def test_bipartite_match_greedy_reference():
+    from paddle_tpu.vision.ops import bipartite_match
+
+    # hand case: greedy global max first (0.9 at gt1->p0), then gt0's
+    # best REMAINING column
+    dist = np.array([[0.5, 0.6, 0.1],
+                     [0.9, 0.4, 0.2]], np.float32)
+    match, mdist = bipartite_match(P.to_tensor(dist))
+    np.testing.assert_array_equal(match.numpy(), [1, 0, -1])
+    np.testing.assert_allclose(mdist.numpy(), [0.9, 0.6, 0.0], rtol=1e-6)
+
+    # per_prediction: leftover col 2 takes argmax row when > threshold
+    match2, _ = bipartite_match(P.to_tensor(dist), "per_prediction",
+                                dist_threshold=0.15)
+    np.testing.assert_array_equal(match2.numpy(), [1, 0, 1])
+
+    # batched + zero-distance columns never match
+    dist3 = np.stack([dist, np.zeros_like(dist)])
+    m3, _ = bipartite_match(P.to_tensor(dist3))
+    np.testing.assert_array_equal(m3.numpy()[0], [1, 0, -1])
+    np.testing.assert_array_equal(m3.numpy()[1], [-1, -1, -1])
+
+
+def test_target_assign_gather_and_weights():
+    from paddle_tpu.vision.ops import target_assign
+
+    t = np.arange(12, dtype=np.float32).reshape(1, 3, 4)  # 3 gt, K=4
+    idx = np.array([[2, -1, 0, 1]], np.int64)             # 4 priors
+    out, w = target_assign(P.to_tensor(t), P.to_tensor(idx),
+                           mismatch_value=-5.0)
+    np.testing.assert_array_equal(out.numpy()[0, 0], t[0, 2])
+    np.testing.assert_array_equal(out.numpy()[0, 1], [-5.0] * 4)
+    np.testing.assert_array_equal(out.numpy()[0, 2], t[0, 0])
+    np.testing.assert_array_equal(w.numpy()[0, :, 0], [1, 0, 1, 1])
+
+
+def test_ssd_matching_pipeline_composes():
+    """prior_box -> iou_similarity -> bipartite_match -> box_coder ->
+    target_assign: the SSD target-construction path end to end."""
+    from paddle_tpu.vision.ops import (
+        bipartite_match, box_coder, iou_similarity, prior_box,
+        target_assign,
+    )
+
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    priors, pvar = prior_box(P.to_tensor(feat), P.to_tensor(img),
+                             min_sizes=[20.0], aspect_ratios=[1.0],
+                             clip=True)
+    pri = priors.numpy().reshape(-1, 4)                    # [P, 4]
+    gt = np.array([[0.1, 0.1, 0.45, 0.45],
+                   [0.6, 0.6, 0.95, 0.95]], np.float32)
+    iou = iou_similarity(P.to_tensor(gt), P.to_tensor(pri))
+    match, mdist = bipartite_match(iou)
+    mn = match.numpy()
+    assert (mn >= 0).sum() == 2                            # both gts match
+    enc = box_coder(P.to_tensor(pri), P.to_tensor(pvar.numpy().reshape(-1, 4)),
+                    P.to_tensor(gt), "encode_center_size")  # [2, P, 4]
+    # targets per prior: transpose to [1, num_gt, ...] dense form
+    # target for prior p is enc[gt_of_p, p]
+    tgt = np.transpose(enc.numpy(), (1, 0, 2))             # [P, 2, 4]
+    out, w = target_assign(
+        P.to_tensor(tgt[None].reshape(1, -1, 2 * 4)[:, :2, :]),
+        P.to_tensor(mn[None, :2]),
+    )
+    assert out.shape == [1, 2, 8] and w.shape == [1, 2, 1]
