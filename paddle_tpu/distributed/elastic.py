@@ -1,0 +1,336 @@
+"""Elastic runtime: heartbeat watchdog, restart budget, log capture.
+
+Reference: launch_utils.py:996-1118 — `TrainerProc` bookkeeping, the
+`watch_local_trainers` poll loop, `workerlog.N` per-rank log files,
+`terminate_local_procs` SIGTERM→grace→SIGKILL teardown — plus
+`distributed/fleet/elastic/manager.py`'s ElasticManager (hung-worker
+watchdog + bounded relaunch).
+
+TPU-native additions over the reference watch loop:
+
+- **heartbeats**: each rank gets `PADDLE_HEARTBEAT_FILE`; the trainer
+  (TrainEpochRange per epoch, hapi `TerminateOnPreempt` per batch, or
+  anything calling :func:`heartbeat`) touches it. A rank whose file goes
+  stale for `PADDLE_WATCHDOG_TIMEOUT` seconds is *hung* (deadlocked
+  collective, wedged host) — the reference only notices exits, so a hung
+  rank stalls the pod forever.
+- **escalation**: hung/failed ranks get SIGTERM, a
+  `PADDLE_WATCHDOG_GRACE`-second window to snapshot, then SIGKILL.
+- **restart budget**: at most `max_restarts` relaunches per
+  `PADDLE_ELASTIC_WINDOW`-second rolling window, with exponential
+  backoff (base `PADDLE_ELASTIC_BACKOFF`, cap 30s, ±50% jitter) so a
+  crash-looping job backs off the coordinator instead of hammering it.
+- **preemption notice**: SIGTERM/SIGINT to the manager is forwarded to
+  every child (the cloud's 30s warning), children snapshot and exit, no
+  relaunch is attempted, and the manager exits 143.
+"""
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticManager", "RankProc", "heartbeat",
+           "install_preempt_notice", "restore_preempt_notice", "HUNG_RC"]
+
+_HEARTBEAT_ENV = "PADDLE_HEARTBEAT_FILE"
+_WATCHDOG_ENV = "PADDLE_WATCHDOG_TIMEOUT"
+_GRACE_ENV = "PADDLE_WATCHDOG_GRACE"
+_BACKOFF_ENV = "PADDLE_ELASTIC_BACKOFF"
+_WINDOW_ENV = "PADDLE_ELASTIC_WINDOW"
+_LOGDIR_ENV = "PADDLE_LOG_DIR"
+
+#: exit code the manager reports when the watchdog had to put a rank down
+HUNG_RC = 98
+#: exit code after a propagated preemption notice (128 + SIGTERM)
+PREEMPT_RC = 143
+
+
+def heartbeat() -> None:
+    """Touch this rank's heartbeat file (no-op outside the runner).
+
+    Cheap enough to call per batch; the watchdog only compares mtimes.
+    """
+    path = os.environ.get(_HEARTBEAT_ENV)
+    if not path:
+        return
+    try:
+        with open(path, "a"):
+            pass
+        os.utime(path, None)
+    except OSError:
+        pass  # a lost heartbeat must never kill the trainer itself
+
+
+def install_preempt_notice(on_notice: Callable[[], None]):
+    """Install a SIGTERM handler that invokes `on_notice()` — the shared
+    trainer-side half of the preemption protocol (TrainEpochRange and
+    hapi.TerminateOnPreempt both use it). Returns the previous handler
+    for :func:`restore_preempt_notice`, or None when not installable
+    (non-main thread / restricted runtime)."""
+    if threading.current_thread() is not threading.main_thread():
+        return None
+
+    def _handler(signum, frame):
+        on_notice()
+
+    try:
+        return signal.signal(signal.SIGTERM, _handler)
+    except (ValueError, OSError):
+        return None
+
+
+def restore_preempt_notice(old) -> None:
+    if old is not None:
+        signal.signal(signal.SIGTERM, old)
+
+
+class RankProc:
+    """One spawned rank (launch_utils.py TrainerProc analog)."""
+
+    __slots__ = ("proc", "rank", "hb_path", "log_path", "log_file")
+
+    def __init__(self, proc, rank, hb_path, log_path=None, log_file=None):
+        self.proc = proc
+        self.rank = rank
+        self.hb_path = hb_path
+        self.log_path = log_path
+        self.log_file = log_file
+
+
+class ElasticManager:
+    """Spawn this node's ranks and keep the job alive across failures.
+
+    `envs` is one fully-populated environment dict per local rank (see
+    launch.build_cluster_env); the manager adds `PADDLE_LAUNCH_ATTEMPT`
+    and `PADDLE_HEARTBEAT_FILE` on top.
+    """
+
+    def __init__(self, script: str, script_args: List[str],
+                 envs: List[Dict[str, str]], backend: Optional[str] = None,
+                 max_restarts: int = 0,
+                 watchdog_timeout: Optional[float] = None,
+                 grace: Optional[float] = None,
+                 backoff_base: Optional[float] = None,
+                 backoff_cap: float = 30.0,
+                 restart_window: Optional[float] = None,
+                 log_dir: Optional[str] = None,
+                 poll_interval: float = 0.1):
+        def _envf(name, default):
+            raw = os.environ.get(name, "")
+            return float(raw) if raw.strip() else default
+
+        self.script = script
+        self.script_args = list(script_args)
+        self.envs = envs
+        self.backend = backend
+        self.max_restarts = int(max_restarts)
+        self.watchdog_timeout = (
+            watchdog_timeout if watchdog_timeout is not None
+            else _envf(_WATCHDOG_ENV, 0.0))
+        self.grace = grace if grace is not None else _envf(_GRACE_ENV, 10.0)
+        self.backoff_base = (backoff_base if backoff_base is not None
+                             else _envf(_BACKOFF_ENV, 0.5))
+        self.backoff_cap = backoff_cap
+        self.restart_window = (restart_window if restart_window is not None
+                               else _envf(_WINDOW_ENV, 3600.0))
+        self.log_dir = log_dir or os.environ.get(_LOGDIR_ENV) or None
+        self.poll_interval = poll_interval
+        self._run_dir = None          # heartbeat-file home, made lazily
+        self._procs: List[RankProc] = []
+        self._restarts = deque()      # monotonic stamps of past relaunches
+        self._preempted = False
+
+    # -- spawning ---------------------------------------------------------
+    def _spawn(self, attempt: int) -> None:
+        if self._run_dir is None:
+            self._run_dir = tempfile.mkdtemp(prefix="pdtpu_elastic_")
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+        self._procs = []
+        for env in self.envs:
+            env = dict(env)
+            if self.backend:
+                env["JAX_PLATFORM_NAME"] = self.backend
+            env["PADDLE_LAUNCH_ATTEMPT"] = str(attempt)
+            rank = int(env.get("PADDLE_TRAINER_ID", "0"))
+            hb = os.path.join(self._run_dir, f"hb.{rank}")
+            env[_HEARTBEAT_ENV] = hb
+            # pre-touch so the stale clock starts at spawn, not epoch 1
+            with open(hb, "a"):
+                pass
+            os.utime(hb, None)
+            log_path = log_file = None
+            if self.log_dir:
+                log_path = os.path.join(self.log_dir, f"workerlog.{rank}")
+                log_file = open(log_path, "ab", buffering=0)
+                log_file.write(
+                    f"==== attempt {attempt} rank {rank} ====\n".encode())
+            p = subprocess.Popen(
+                [sys.executable, self.script] + self.script_args,
+                env=env, stdout=log_file, stderr=log_file)
+            self._procs.append(RankProc(p, rank, hb, log_path, log_file))
+
+    # -- teardown ---------------------------------------------------------
+    def _kill_rank(self, rp: RankProc, why: str) -> None:
+        """SIGTERM → grace → SIGKILL one rank."""
+        if rp.proc.poll() is not None:
+            return
+        print(f"paddle_tpu.elastic: {why}; terminating rank {rp.rank} "
+              f"(pid {rp.proc.pid}, grace {self.grace}s)",
+              file=sys.stderr, flush=True)
+        rp.proc.send_signal(signal.SIGTERM)
+        try:
+            rp.proc.wait(timeout=self.grace)
+        except subprocess.TimeoutExpired:
+            rp.proc.kill()
+            rp.proc.wait()
+
+    def _teardown(self, why: str) -> None:
+        # signal everyone FIRST, then share one grace deadline — serial
+        # per-rank waits would stretch teardown to N*grace and eat the
+        # cloud's eviction window before later ranks could snapshot
+        live = [rp for rp in self._procs if rp.proc.poll() is None]
+        if live:
+            print(f"paddle_tpu.elastic: {why}; terminating "
+                  f"{len(live)} rank(s) (grace {self.grace}s)",
+                  file=sys.stderr, flush=True)
+            for rp in live:
+                try:
+                    rp.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+            deadline = time.monotonic() + self.grace
+            for rp in live:
+                try:
+                    rp.proc.wait(max(deadline - time.monotonic(), 0))
+                except subprocess.TimeoutExpired:
+                    rp.proc.kill()
+                    rp.proc.wait()
+        for rp in self._procs:
+            if rp.log_file is not None:
+                try:
+                    rp.log_file.close()
+                except OSError:
+                    pass
+
+    # -- the watch loop (launch_utils.py:996-1118) ------------------------
+    def _watch(self) -> int:
+        rc = 0
+        while True:
+            alive = []
+            for rp in self._procs:
+                code = rp.proc.poll()
+                if code is None:
+                    alive.append(rp)
+                elif code != 0 and rc == 0:
+                    rc = code  # first failure wins; tear the job down
+            if rc != 0 or not alive:
+                break
+            if self._preempted:
+                # notice already forwarded by the signal handler; give
+                # the children their grace window to snapshot + exit
+                self._teardown("preemption notice")
+                return PREEMPT_RC
+            if self.watchdog_timeout > 0:
+                now = time.time()
+                for rp in alive:
+                    try:
+                        age = now - os.path.getmtime(rp.hb_path)
+                    except OSError:
+                        continue  # heartbeat file raced away; skip a beat
+                    if age > self.watchdog_timeout:
+                        self._kill_rank(
+                            rp, f"rank {rp.rank} heartbeat stale "
+                                f"{age:.1f}s > {self.watchdog_timeout}s")
+                        rc = HUNG_RC
+                        break
+                if rc != 0:
+                    break
+            time.sleep(self.poll_interval)
+        self._teardown("peer failure" if rc else "job done")
+        return rc  # 0 here means every rank exited clean (even post-notice)
+
+    # -- restart policy ---------------------------------------------------
+    def _backoff_delay(self, n_recent: int) -> float:
+        """Exponential in the number of recent restarts, capped, with
+        ±50% jitter so restarting hosts don't stampede the coordinator."""
+        base = min(self.backoff_cap,
+                   self.backoff_base * (2.0 ** max(n_recent - 1, 0)))
+        return base * (0.5 + random.random())
+
+    def _budget_left(self) -> bool:
+        now = time.monotonic()
+        while self._restarts and now - self._restarts[0] > self.restart_window:
+            self._restarts.popleft()
+        return len(self._restarts) < self.max_restarts
+
+    # -- signals ----------------------------------------------------------
+    def _on_notice(self, signum, frame):
+        self._preempted = True
+        for rp in self._procs:
+            if rp.proc.poll() is None:
+                try:
+                    rp.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+
+    def _install_handlers(self):
+        old = {}
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                old[sig] = signal.signal(sig, self._on_notice)
+        except ValueError:
+            pass  # not the main thread; the caller owns signal routing
+        return old
+
+    # -- the job ----------------------------------------------------------
+    def run(self) -> int:
+        old_handlers = self._install_handlers()
+        attempt = 0
+        try:
+            while True:
+                self._spawn(attempt)
+                rc = self._watch()
+                if self._preempted:
+                    # the notice wins even over a clean rank exit: the
+                    # host is going away, so report "interrupted" (143)
+                    # and let the next incarnation's restore() decide
+                    # whether anything is actually left to do
+                    return rc or PREEMPT_RC
+                if rc == 0:
+                    return 0
+                if not self._budget_left():
+                    print(
+                        f"paddle_tpu.elastic: restart budget exhausted "
+                        f"({self.max_restarts} per "
+                        f"{self.restart_window:.0f}s); giving up rc={rc}",
+                        file=sys.stderr, flush=True)
+                    return rc
+                self._restarts.append(time.monotonic())
+                delay = self._backoff_delay(len(self._restarts))
+                print(
+                    f"paddle_tpu.elastic: attempt {attempt} failed rc={rc}; "
+                    f"relaunching in {delay:.2f}s "
+                    f"({self.max_restarts - len(self._restarts)} restarts "
+                    f"left in window)", file=sys.stderr, flush=True)
+                time.sleep(delay)
+                if self._preempted:
+                    # notice arrived during the backoff nap: don't burn
+                    # the eviction window on a doomed respawn
+                    return PREEMPT_RC
+                attempt += 1
+        finally:
+            self._teardown("manager exit")
+            for sig, h in old_handlers.items():
+                signal.signal(sig, h)
+            if self._run_dir is not None:
+                shutil.rmtree(self._run_dir, ignore_errors=True)

@@ -21,11 +21,10 @@ from __future__ import annotations
 
 import argparse
 import os
-import signal
-import subprocess
 import sys
-import time
 from typing import Dict, List
+
+from .elastic import ElasticManager
 
 __all__ = ["launch", "build_cluster_env", "main"]
 
@@ -60,50 +59,11 @@ def build_cluster_env(nproc: int, ips: str = "127.0.0.1",
     return envs
 
 
-def _run_once(script, script_args, envs, backend, attempt) -> int:
-    """Spawn the ranks once and babysit them (TrainerProc watch loop,
-    launch_utils.py:996-1118). Returns the first non-zero exit code."""
-    procs = []
-    for env in envs:
-        env = dict(env)
-        if backend:
-            env["JAX_PLATFORM_NAME"] = backend
-        env["PADDLE_LAUNCH_ATTEMPT"] = str(attempt)
-        p = subprocess.Popen(
-            [sys.executable, script] + list(script_args), env=env
-        )
-        procs.append(p)
-    rc = 0
-    try:
-        while procs:
-            alive = []
-            for p in procs:
-                code = p.poll()
-                if code is None:
-                    alive.append(p)
-                elif code != 0 and rc == 0:
-                    rc = code  # first failure wins; tear the job down
-            if rc != 0:
-                break
-            procs = alive
-            if procs:
-                time.sleep(0.2)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-    return rc
-
-
 def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
            ips: str = "127.0.0.1", start_port: int = 6170,
            backend: str = None, node_rank: int = None,
-           elastic_retries: int = 0) -> int:
+           elastic_retries: int = 0, watchdog_timeout: float = None,
+           log_dir: str = None) -> int:
     """Spawn THIS node's ranks and babysit them (launch_collective :208).
 
     `node_rank` selects which host of `ips` this invocation is (default
@@ -112,12 +72,21 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
     the first non-zero exit code (0 on full success); on any failure the
     remaining ranks are terminated (the watch-loop teardown).
 
-    `elastic_retries` > 0 is the fault-tolerance policy (the elastic
-    restart of launch_utils.py watch_local_trainers + ElasticManager):
-    after a failed attempt the WHOLE job relaunches — scripts resume
-    from their auto-checkpoint (incubate.checkpoint.TrainEpochRange) so a
-    preempted/crashed rank costs at most the epochs since the last
-    snapshot. Children see the attempt index in PADDLE_LAUNCH_ATTEMPT.
+    Fault tolerance is delegated to :class:`~.elastic.ElasticManager`:
+
+    - `elastic_retries` > 0 relaunches the WHOLE job after a failure
+      (budgeted per PADDLE_ELASTIC_WINDOW, exponential backoff with
+      jitter) — scripts resume from their auto-checkpoint
+      (incubate.checkpoint.TrainEpochRange) so a preempted/crashed rank
+      costs at most the epochs since the last snapshot. Children see
+      the attempt index in PADDLE_LAUNCH_ATTEMPT.
+    - `watchdog_timeout` (or PADDLE_WATCHDOG_TIMEOUT) > 0 kills ranks
+      whose PADDLE_HEARTBEAT_FILE goes stale that many seconds — a hung
+      rank counts as a failure and consumes a restart.
+    - `log_dir` (or PADDLE_LOG_DIR) captures each rank's output to
+      `workerlog.N` (launch_utils.py behavior).
+    - SIGTERM to the launcher is forwarded to every rank (the
+      preemption notice); no relaunch follows.
     """
     if node_rank is None:
         node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
@@ -129,19 +98,12 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
     envs = build_cluster_env(nproc_per_node, ips=ips, start_port=start_port)
     lo = node_rank * nproc_per_node
     envs = envs[lo:lo + nproc_per_node]
-    rc = 0
-    for attempt in range(int(elastic_retries) + 1):
-        rc = _run_once(script, script_args, envs, backend, attempt)
-        if rc == 0:
-            return 0
-        if attempt < elastic_retries:
-            print(
-                f"paddle_tpu.launch: attempt {attempt} failed rc={rc}; "
-                f"relaunching ({elastic_retries - attempt} retries left)",
-                file=sys.stderr,
-            )
-            time.sleep(0.5)
-    return rc
+    mgr = ElasticManager(
+        script, list(script_args), envs, backend=backend,
+        max_restarts=int(elastic_retries),
+        watchdog_timeout=watchdog_timeout, log_dir=log_dir,
+    )
+    return mgr.run()
 
 
 def main(argv=None):
@@ -159,8 +121,17 @@ def main(argv=None):
     parser.add_argument("--backend", type=str, default=None,
                         help="force a jax backend in children (e.g. cpu)")
     parser.add_argument("--elastic_retries", type=int, default=0,
-                        help="relaunch the whole job up to N times after "
-                             "a failure (auto-checkpoint resumes)")
+                        help="relaunch the whole job up to N times per "
+                             "rolling PADDLE_ELASTIC_WINDOW after a "
+                             "failure (auto-checkpoint resumes)")
+    parser.add_argument("--watchdog_timeout", type=float, default=None,
+                        help="seconds without a rank heartbeat before the "
+                             "watchdog recycles it (default: "
+                             "$PADDLE_WATCHDOG_TIMEOUT, 0 = off)")
+    parser.add_argument("--log_dir", type=str, default=None,
+                        help="capture each rank's output to "
+                             "<log_dir>/workerlog.N (default: "
+                             "$PADDLE_LOG_DIR, unset = inherit stdio)")
     parser.add_argument("script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -168,6 +139,7 @@ def main(argv=None):
         args.script, args.script_args, nproc_per_node=args.nproc_per_node,
         ips=args.ips, start_port=args.start_port, backend=args.backend,
         node_rank=args.node_rank, elastic_retries=args.elastic_retries,
+        watchdog_timeout=args.watchdog_timeout, log_dir=args.log_dir,
     )
     sys.exit(rc)
 

@@ -145,7 +145,11 @@ class Categorical(Distribution):
     """Categorical (distribution.py:640). Reference semantics: `logits`
     are non-negative RELATIVE WEIGHTS — probs = logits / sum(logits)
     (its probs() normalizes by the sum and sample() feeds them to the
-    multinomial op), NOT log-probabilities."""
+    multinomial op), NOT log-probabilities. EXCEPT entropy() and
+    kl_divergence() (:812-860), which exp-normalize: softmax(logits)
+    after max-subtraction — the two normalizations deliberately coexist
+    in the reference, so the same weights yield different entropy than
+    -(probs * log probs).sum would."""
 
     def __init__(self, logits, name=None):
         self.logits = _as_raw(logits)
@@ -157,6 +161,11 @@ class Categorical(Distribution):
             jnp.maximum(w, 1e-30)
         ) - jnp.log(jnp.maximum(w.sum(-1, keepdims=True), 1e-30))
 
+    def _softmax_log_probs(self):
+        """exp-normalized log-probs (the entropy/kl path)."""
+        z = self.logits - jnp.max(self.logits, axis=-1, keepdims=True)
+        return z - jnp.log(jnp.sum(jnp.exp(z), axis=-1, keepdims=True))
+
     def sample(self, shape):
         key = rnd.next_key()
         idx = jax.random.categorical(
@@ -166,13 +175,13 @@ class Categorical(Distribution):
         return Tensor._wrap(idx.astype(jnp.int64), stop_gradient=True)
 
     def entropy(self):
-        lp = self._log_probs()
+        lp = self._softmax_log_probs()
         ent = -jnp.sum(jnp.exp(lp) * lp, axis=-1)
         return Tensor._wrap(ent, stop_gradient=True)
 
     def kl_divergence(self, other: "Categorical"):
-        lp = self._log_probs()
-        lq = other._log_probs()
+        lp = self._softmax_log_probs()
+        lq = other._softmax_log_probs()
         kl = jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1)
         return Tensor._wrap(kl, stop_gradient=True)
 

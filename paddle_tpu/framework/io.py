@@ -9,12 +9,29 @@ from __future__ import annotations
 
 import os
 import pickle
+import zlib
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..utils.fault_injection import fault_point
+
+__all__ = ["save", "load", "crc32_file"]
 
 _MAGIC = b"PDTPU1\n"
+
+
+def crc32_file(path, chunk_size=1 << 20):
+    """CRC32 of a file's bytes — the checkpoint-integrity checksum that
+    auto_checkpoint records per file in its meta.json."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk_size)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
 
 
 def _to_numpy_tree(obj):
@@ -47,17 +64,33 @@ class _TensorLeaf:
 
 
 def save(obj, path, protocol=4, **configs):
-    """paddle.save(state_dict, 'model.pdparams')."""
+    """paddle.save(state_dict, 'model.pdparams').
+
+    Atomic: the tree is pickled to a same-directory temp file, fsync'd,
+    then os.replace'd over `path`, so a preemption mid-write leaves
+    either the old complete file or the new complete file — never a
+    torn checkpoint."""
+    fault_point("io.save")
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC)
+            pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    fault_point("io.save.post", path=path)
 
 
 def load(path, return_numpy=False, **configs):
     """paddle.load('model.pdparams')."""
+    fault_point("io.load", path=path)
     with open(path, "rb") as f:
         head = f.read(len(_MAGIC))
         if head != _MAGIC:

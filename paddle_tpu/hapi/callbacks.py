@@ -12,7 +12,7 @@ import numpy as np
 
 __all__ = [
     "Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-    "EarlyStopping", "VisualDL",
+    "EarlyStopping", "VisualDL", "TerminateOnPreempt",
 ]
 
 
@@ -244,6 +244,58 @@ class EarlyStopping(Callback):
                         f"Early stopping: {self.monitor} did not improve "
                         f"for {self.wait} evals (best {self.best:.5f})"
                     )
+
+
+class TerminateOnPreempt(Callback):
+    """Preemption-notice handler — the hapi face of the elastic runtime.
+
+    On SIGTERM (the cloud's eviction warning, forwarded to every rank by
+    the elastic launcher): finish the in-flight batch/epoch, save a
+    `save_dir/preempt` checkpoint, and stop training cleanly. Also emits
+    a rank heartbeat (distributed.elastic.heartbeat) per batch so the
+    launcher's hung-rank watchdog sees a live trainer between epochs.
+    """
+
+    def __init__(self, save_dir=None, verbose=1):
+        super().__init__()
+        self.save_dir = save_dir
+        self.verbose = verbose
+        self.preempted = False
+        self._old_handler = None
+
+    def _on_notice(self):
+        self.preempted = True
+
+    def on_train_begin(self, logs=None):
+        from ..distributed.elastic import install_preempt_notice
+
+        self.preempted = False
+        self._old_handler = install_preempt_notice(self._on_notice)
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..distributed.elastic import heartbeat
+
+        heartbeat()
+        if self.preempted:
+            self.model.stop_training = True
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.preempted:
+            return
+        self.model.stop_training = True
+        save_dir = self.save_dir or getattr(self.model, "_save_dir", None)
+        if save_dir:
+            path = os.path.join(save_dir, "preempt")
+            self.model.save(path)
+            if self.verbose:
+                print(f"TerminateOnPreempt: SIGTERM received — saved "
+                      f"{path}, stopping after epoch {epoch}")
+
+    def on_train_end(self, logs=None):
+        from ..distributed.elastic import restore_preempt_notice
+
+        restore_preempt_notice(self._old_handler)
+        self._old_handler = None
 
 
 class VisualDL(Callback):

@@ -234,30 +234,41 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         done_iters = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                ins, labs = self._split_batch(batch)
-                vals = _to_list(self.train_batch(ins, labs))
-                logs = self._logs(vals)
-                cbks.on_train_batch_end(step, logs)
-                done_iters += 1
-                if num_iters is not None and done_iters >= num_iters:
-                    self.stop_training = True
+        logs = {}
+        try:
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    ins, labs = self._split_batch(batch)
+                    vals = _to_list(self.train_batch(ins, labs))
+                    logs = self._logs(vals)
+                    cbks.on_train_batch_end(step, logs)
+                    done_iters += 1
+                    if num_iters is not None and done_iters >= num_iters:
+                        self.stop_training = True
+                        break
+                cbks.on_epoch_end(epoch, logs)
+                # a stopping run (early stop via num_iters, or a
+                # preemption notice with its ticking eviction clock)
+                # skips the final eval pass and exits promptly
+                if eval_loader is not None \
+                        and (epoch + 1) % eval_freq == 0 \
+                        and not self.stop_training:
+                    self.evaluate(
+                        eval_loader, batch_size=batch_size,
+                        log_freq=log_freq, verbose=verbose, callbacks=cbks,
+                    )
+                if self.stop_training:
                     break
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(
-                    eval_loader, batch_size=batch_size, log_freq=log_freq,
-                    verbose=verbose, callbacks=cbks,
-                )
-            if self.stop_training:
-                break
-        cbks.on_train_end(logs)
+        finally:
+            # guaranteed even when training raises, so callbacks that own
+            # process state (TerminateOnPreempt's SIGTERM handler) always
+            # get to clean up
+            cbks.on_train_end(logs)
 
     def _split_batch(self, batch):
         batch = _to_list(batch)

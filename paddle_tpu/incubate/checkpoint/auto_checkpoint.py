@@ -11,6 +11,21 @@ TPU-native: state = the registered Layers' state_dicts + optimizers'
 state_dicts saved through framework.io (orbax-style numpy-tree pickles);
 the snapshot key is PADDLE_JOB_ID (the preemptible-cluster job identity).
 Multi-host: only trainer 0 writes; every trainer restores.
+
+Integrity layer (the elastic-runtime contract):
+
+- snapshots are epoch-numbered generations (``snap_00000002/``) built in
+  a temp dir and committed by one directory rename; the newest
+  ``PADDLE_CHECKPOINT_KEEP`` generations are retained;
+- each generation's ``meta.json`` records a CRC32 per state file
+  (framework.io writes files atomically with fsync); ``restore()``
+  verifies them and FALLS BACK to the previous generation when a file
+  is torn/corrupted, retrying transient OSErrors with backoff first;
+- a SIGTERM (the preemption notice, forwarded by the elastic launcher)
+  snapshots at the end of the in-flight epoch and exits 143, so a
+  preempted job resumes with zero lost epochs;
+- every epoch entry emits a rank heartbeat (distributed.elastic) and
+  crosses the ``epoch`` fault-injection point.
 """
 from __future__ import annotations
 
@@ -18,13 +33,23 @@ import contextlib
 import json
 import os
 import shutil
+import sys
 import tempfile
+import time
 from typing import List, Optional
 
-__all__ = ["TrainEpochRange", "train_epoch_range"]
+__all__ = ["TrainEpochRange", "train_epoch_range", "CheckpointCorruptError"]
 
 _CHECKPOINT_ENV = "PADDLE_CHECKPOINT_DIR"
 _JOB_ENV = "PADDLE_JOB_ID"
+_KEEP_ENV = "PADDLE_CHECKPOINT_KEEP"
+_SNAP_PREFIX = "snap_"
+_PREEMPT_RC = 143
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A snapshot file failed its CRC32 / parse check (not transient —
+    restore() falls back to the previous generation instead of retrying)."""
 
 
 class TrainEpochRange:
@@ -40,7 +65,9 @@ class TrainEpochRange:
 
     def __init__(self, max_epoch_num: int, name: str = "acp",
                  checkpoint_path: Optional[str] = None,
-                 save_checkpoint_inter: int = 1):
+                 save_checkpoint_inter: int = 1,
+                 keep_checkpoints: Optional[int] = None,
+                 io_retries: int = 3):
         self.max_epoch_num = int(max_epoch_num)
         self.name = name
         root = checkpoint_path or os.environ.get(
@@ -50,9 +77,14 @@ class TrainEpochRange:
         job = os.environ.get(_JOB_ENV, "default_job")
         self._dir = os.path.join(root, job, name)
         self._inter = max(int(save_checkpoint_inter), 1)
+        self._keep = max(int(keep_checkpoints
+                             if keep_checkpoints is not None
+                             else os.environ.get(_KEEP_ENV, "2")), 1)
+        self._io_retries = max(int(io_retries), 1)
         self._models: List = []
         self._opts: List = []
         self._restored_epoch = -1
+        self._preempted = False
 
     # -- state registry (the exe/program auto-registration analog) ---------
     def register(self, model=None, optimizer=None):
@@ -63,82 +95,192 @@ class TrainEpochRange:
         return self
 
     # -- persistence ---------------------------------------------------------
-    def _meta_path(self):
-        return os.path.join(self._dir, "meta.json")
+    def _state_files(self):
+        names = [f"model_{i}.pdparams" for i in range(len(self._models))]
+        names += [f"opt_{i}.pdopt" for i in range(len(self._opts))]
+        return names
+
+    def _snap_path(self, epoch: int) -> str:
+        return os.path.join(self._dir, f"{_SNAP_PREFIX}{epoch:08d}")
+
+    def _snapshots(self):
+        """(epoch, path) of committed generations, newest first."""
+        try:
+            entries = os.listdir(self._dir)
+        except OSError:
+            return []
+        out = []
+        for e in entries:
+            if e.startswith(_SNAP_PREFIX):
+                try:
+                    out.append((int(e[len(_SNAP_PREFIX):]),
+                                os.path.join(self._dir, e)))
+                except ValueError:
+                    continue
+        return sorted(out, reverse=True)
 
     def _save(self, epoch: int):
         from ...distributed import comm
         from ...framework import io as fio
+        from ...utils.fault_injection import fault_point
 
         if comm.ParallelEnv().rank != 0:
             return  # one writer per job
+        fault_point("acp.save")
         os.makedirs(self._dir, exist_ok=True)
-        tmp = self._dir + ".tmp"
+        tmp = os.path.join(self._dir, f".tmp_{_SNAP_PREFIX}{epoch:08d}")
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp)
-        for i, m in enumerate(self._models):
-            fio.save(m.state_dict(), os.path.join(tmp, f"model_{i}.pdparams"))
-        for i, o in enumerate(self._opts):
-            inner = getattr(o, "_inner", o)
-            fio.save(inner.state_dict(), os.path.join(tmp, f"opt_{i}.pdopt"))
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump({"epoch": epoch, "name": self.name,
-                       "max_epoch_num": self.max_epoch_num}, f)
-        # atomic swap so a preemption mid-save never corrupts the snapshot
-        old = self._dir + ".old"
-        shutil.rmtree(old, ignore_errors=True)
-        if os.path.exists(os.path.join(self._dir, "meta.json")):
-            os.rename(self._dir, old)
-        else:
-            shutil.rmtree(self._dir, ignore_errors=True)
-        os.rename(tmp, self._dir)
-        shutil.rmtree(old, ignore_errors=True)
+        states = [m.state_dict() for m in self._models]
+        states += [getattr(o, "_inner", o).state_dict() for o in self._opts]
+        crcs = {}
+        for fname, state in zip(self._state_files(), states):
+            fpath = os.path.join(tmp, fname)
+            fio.save(state, fpath)
+            crcs[fname] = fio.crc32_file(fpath)
+        meta = {"epoch": epoch, "name": self.name,
+                "max_epoch_num": self.max_epoch_num, "files": crcs}
+        mpath = os.path.join(tmp, "meta.json")
+        with open(mpath, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        final = self._snap_path(epoch)
+        shutil.rmtree(final, ignore_errors=True)
+        # the rename is the commit point: readers only ever see complete
+        # snap_* generations, never the in-progress temp dir
+        os.rename(tmp, final)
+        self._prune()
 
-    def _snapshot_dir(self):
-        """Newest COMPLETE snapshot, surviving a preemption between the
-        two renames of _save: the live dir, then the fully-written .tmp,
-        then the displaced .old."""
-        for d in (self._dir, self._dir + ".tmp", self._dir + ".old"):
-            if os.path.exists(os.path.join(d, "meta.json")):
-                return d
-        return None
+    def _prune(self):
+        snaps = self._snapshots()
+        for _, path in snaps[self._keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+        try:
+            for e in os.listdir(self._dir):
+                if e.startswith(f".tmp_{_SNAP_PREFIX}"):
+                    shutil.rmtree(os.path.join(self._dir, e),
+                                  ignore_errors=True)
+        except OSError:
+            pass
 
-    def restore(self) -> int:
-        """Load the last snapshot; returns the NEXT epoch to run (0 when
-        no snapshot exists)."""
+    # -- restore with integrity checking ----------------------------------
+    def _read_snapshot(self, snap_dir: str):
+        """Verify CRCs then load every state tree (into memory only —
+        the caller applies them, so a half-read snapshot never leaves a
+        model partially mutated). Raises CheckpointCorruptError on
+        checksum/parse failures, OSError on (possibly transient) I/O."""
         from ...framework import io as fio
 
-        snap = self._snapshot_dir()
-        if snap is None:
-            return 0
-        if snap != self._dir:
-            # finish the interrupted swap before reading
-            shutil.rmtree(self._dir, ignore_errors=True)
-            os.rename(snap, self._dir)
-            for leftover in (self._dir + ".tmp", self._dir + ".old"):
-                shutil.rmtree(leftover, ignore_errors=True)
-        with open(self._meta_path()) as f:
-            meta = json.load(f)
-        for i, m in enumerate(self._models):
-            m.set_state_dict(
-                fio.load(os.path.join(self._dir, f"model_{i}.pdparams"))
-            )
-        for i, o in enumerate(self._opts):
-            inner = getattr(o, "_inner", o)
-            inner.set_state_dict(
-                fio.load(os.path.join(self._dir, f"opt_{i}.pdopt"))
-            )
-        self._restored_epoch = int(meta["epoch"])
-        return self._restored_epoch + 1
+        with open(os.path.join(snap_dir, "meta.json")) as f:
+            try:
+                meta = json.load(f)
+            except ValueError as e:
+                raise CheckpointCorruptError(
+                    f"unparseable meta.json in {snap_dir}: {e}") from e
+        # existence over the REGISTERED state set, not just meta's — a
+        # registry/snapshot shape mismatch is deterministic, so it must
+        # fall back immediately rather than be retried as transient I/O
+        for fname in self._state_files():
+            if not os.path.exists(os.path.join(snap_dir, fname)):
+                raise CheckpointCorruptError(
+                    f"snapshot file missing: {os.path.join(snap_dir, fname)}")
+        for fname, want in meta.get("files", {}).items():
+            fpath = os.path.join(snap_dir, fname)
+            if not os.path.exists(fpath):
+                raise CheckpointCorruptError(
+                    f"snapshot file missing: {fpath}")
+            got = fio.crc32_file(fpath)
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"CRC mismatch for {fpath}: "
+                    f"recorded {want:#010x}, found {got:#010x}")
+        states = []
+        for fname in self._state_files():
+            try:
+                states.append(fio.load(os.path.join(snap_dir, fname)))
+            except (OSError, IOError):
+                raise
+            except Exception as e:  # torn pickle that passed no CRC
+                raise CheckpointCorruptError(
+                    f"unreadable snapshot file {fname} in {snap_dir}: {e}"
+                ) from e
+        return meta, states
+
+    def _read_with_retry(self, snap_dir: str):
+        delay = 0.05
+        last = None
+        for attempt in range(self._io_retries):
+            try:
+                return self._read_snapshot(snap_dir)
+            except CheckpointCorruptError:
+                raise  # deterministic — fall back, don't retry
+            except OSError as e:
+                last = e
+                if attempt + 1 < self._io_retries:
+                    time.sleep(delay)
+                    delay *= 2
+        raise last
+
+    def restore(self) -> int:
+        """Load the newest verifiable snapshot; returns the NEXT epoch to
+        run (0 when no usable snapshot exists). Corrupted generations are
+        skipped with a warning — the previous generation serves. A
+        pre-generation flat-layout checkpoint (meta.json directly in the
+        job dir, no CRCs recorded) is honored as the last resort so an
+        in-flight job upgraded across the format change still resumes."""
+        candidates = list(self._snapshots())
+        if os.path.exists(os.path.join(self._dir, "meta.json")):
+            candidates.append((-1, self._dir))  # legacy flat layout
+        for epoch, snap in candidates:
+            try:
+                meta, states = self._read_with_retry(snap)
+            except (CheckpointCorruptError, OSError) as e:
+                print(f"paddle_tpu.auto_checkpoint: snapshot {snap} "
+                      f"unusable ({e}); falling back to previous",
+                      file=sys.stderr, flush=True)
+                continue
+            n_models = len(self._models)
+            for m, state in zip(self._models, states[:n_models]):
+                m.set_state_dict(state)
+            for o, state in zip(self._opts, states[n_models:]):
+                getattr(o, "_inner", o).set_state_dict(state)
+            self._restored_epoch = int(meta["epoch"])
+            return self._restored_epoch + 1
+        return 0
 
     # -- the epoch range -------------------------------------------------
+    def _on_notice(self):
+        self._preempted = True
+
     def get(self):
+        from ...distributed.elastic import (
+            heartbeat, install_preempt_notice, restore_preempt_notice,
+        )
+        from ...utils.fault_injection import fault_point
+
         start = self.restore()
-        for epoch in range(start, self.max_epoch_num):
-            yield epoch
-            if (epoch + 1) % self._inter == 0 \
-                    or epoch + 1 == self.max_epoch_num:
-                self._save(epoch)
+        old_term = install_preempt_notice(self._on_notice)
+        try:
+            for epoch in range(start, self.max_epoch_num):
+                fault_point("epoch")
+                heartbeat()
+                yield epoch
+                last = epoch + 1 == self.max_epoch_num
+                if self._preempted:
+                    # the notice costs zero epochs: snapshot the one we
+                    # just finished, then exit with the SIGTERM code so
+                    # the launcher knows not to relaunch — unless this
+                    # WAS the final epoch, in which case the run simply
+                    # completed
+                    self._save(epoch)
+                    if last:
+                        break
+                    raise SystemExit(_PREEMPT_RC)
+                if (epoch + 1) % self._inter == 0 or last:
+                    self._save(epoch)
+        finally:
+            restore_preempt_notice(old_term)
 
 
 @contextlib.contextmanager

@@ -241,7 +241,9 @@ def sequence_conv(x, weight, lengths, context_length, context_start=None,
     (operators/sequence_ops/sequence_conv_op.h ContextProjection)."""
     x, weight, lengths = as_tensor(x), as_tensor(weight), as_tensor(lengths)
     if context_start is None:
-        context_start = -((context_length - 1) // 2)
+        # reference default: -int(context_length / 2) — for even windows
+        # the extra context position sits BEFORE the center row
+        context_start = -(context_length // 2)
     cs = int(context_start)
     cl = int(context_length)
 

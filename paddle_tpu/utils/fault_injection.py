@@ -1,0 +1,144 @@
+"""Deterministic env-spec fault injection for the elastic runtime tests.
+
+`PADDLE_FAULT_SPEC` is a comma-separated list of rules::
+
+    site:action:nth[:arg]
+
+- ``site``   dotted fault-point name. Instrumented sites today:
+  ``io.save`` (before a framework.io.save write), ``io.save.post``
+  (after the atomic replace — where ``corrupt`` bites), ``io.load``,
+  ``acp.save`` (before an auto-checkpoint snapshot), ``epoch`` (on
+  entering each TrainEpochRange epoch).
+- ``action`` one of ``fail`` (raise InjectedFault, an IOError),
+  ``hang`` (sleep ``arg`` seconds, default 3600 — the watchdog's prey),
+  ``kill`` (``os._exit(arg)``, default 17 — a hard preemption), or
+  ``corrupt`` (truncate the file the site passed via ``path=`` to half
+  its bytes — a torn write).
+- ``nth``    1-based per-process call count at which the rule fires
+  (each call to a site increments that site's counter), so a relaunched
+  attempt that resumes later in training naturally skips the fault.
+- ``arg``    optional action parameter (kill exit code / hang seconds).
+
+Example: ``PADDLE_FAULT_SPEC="io.save:fail:1,epoch:hang:3"`` fails the
+first save and hangs the process on entering its 3rd epoch.
+
+A ``corrupt`` rule written against ``io.save`` is normalized to
+``io.save.post`` so the short spelling corrupts a *complete* file.
+Pure stdlib — safe to import from anywhere in the tree.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["InjectedFault", "FaultInjector", "fault_point", "reset"]
+
+_SPEC_ENV = "PADDLE_FAULT_SPEC"
+_ACTIONS = ("fail", "hang", "kill", "corrupt")
+# sites that pass a file path to fault_point (the only places a corrupt
+# rule can bite) — a corrupt rule elsewhere would be a silent no-op, so
+# the parser rejects it loudly instead
+_CORRUPT_SITES = ("io.save.post",)
+
+
+class InjectedFault(IOError):
+    """Raised by a ``fail`` rule (an IOError so I/O retry paths see it)."""
+
+
+class _Rule:
+    __slots__ = ("site", "action", "nth", "arg")
+
+    def __init__(self, site: str, action: str, nth: int,
+                 arg: Optional[str]):
+        self.site = site
+        self.action = action
+        self.nth = nth
+        self.arg = arg
+
+
+class FaultInjector:
+    """Parsed spec + per-site hit counters (one injector per process)."""
+
+    def __init__(self, spec: str = ""):
+        self.spec = spec
+        self._rules: List[_Rule] = []
+        self._counts: Dict[str, int] = {}
+        for item in filter(None, (s.strip() for s in spec.split(","))):
+            parts = item.split(":")
+            if len(parts) < 3:
+                raise ValueError(
+                    f"bad {_SPEC_ENV} rule {item!r}: want site:action:nth"
+                )
+            site, action, nth = parts[0], parts[1], int(parts[2])
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"bad {_SPEC_ENV} action {action!r} (one of {_ACTIONS})"
+                )
+            if action == "corrupt":
+                if not site.endswith(".post"):
+                    site += ".post"
+                if site not in _CORRUPT_SITES:
+                    raise ValueError(
+                        f"corrupt rule targets un-instrumented site "
+                        f"{site!r} (path-carrying sites: {_CORRUPT_SITES})"
+                    )
+            arg = parts[3] if len(parts) > 3 else None
+            self._rules.append(_Rule(site, action, nth, arg))
+
+    def fire(self, site: str, path: Optional[str] = None) -> None:
+        count = self._counts[site] = self._counts.get(site, 0) + 1
+        for r in self._rules:
+            if r.site != site or r.nth != count:
+                continue
+            self._act(r, site, count, path)
+
+    def _act(self, r: _Rule, site, count, path):
+        tag = f"{site} (hit {count})"
+        if r.action == "fail":
+            raise InjectedFault(f"injected failure at {tag}")
+        if r.action == "kill":
+            code = int(r.arg) if r.arg else 17
+            print(f"fault_injection: killing process at {tag} "
+                  f"exit={code}", file=sys.stderr, flush=True)
+            os._exit(code)
+        if r.action == "hang":
+            secs = float(r.arg) if r.arg else 3600.0
+            print(f"fault_injection: hanging {secs}s at {tag}",
+                  file=sys.stderr, flush=True)
+            deadline = time.monotonic() + secs
+            while time.monotonic() < deadline:
+                time.sleep(min(1.0, deadline - time.monotonic() + 0.01))
+            return
+        if r.action == "corrupt":
+            if path is None:
+                return  # site carries no file — nothing to corrupt
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(size // 2)
+            print(f"fault_injection: truncated {path} "
+                  f"{size}->{size // 2}B at {tag}",
+                  file=sys.stderr, flush=True)
+
+
+_active: Optional[FaultInjector] = None
+
+
+def _injector() -> FaultInjector:
+    global _active
+    spec = os.environ.get(_SPEC_ENV, "")
+    if _active is None or _active.spec != spec:
+        _active = FaultInjector(spec)
+    return _active
+
+
+def fault_point(site: str, path: Optional[str] = None) -> None:
+    """Instrumentation hook: no-op unless a spec rule matches this hit."""
+    _injector().fire(site, path)
+
+
+def reset() -> None:
+    """Drop counters/rules (tests re-arm between cases)."""
+    global _active
+    _active = None

@@ -597,6 +597,7 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
     bb = bboxes if isinstance(bboxes, Tensor) else Tensor(bboxes)
     sc = scores if isinstance(scores, Tensor) else Tensor(scores)
     off = 0.0 if normalized else 1.0
+    eta = float(nms_eta)
 
     def nms_one_class(boxes, s):
         # boxes [M, 4], s [M] -> (scores_kept [K], idx [K]) with
@@ -616,16 +617,23 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
         inter = iw * ih
         iou = inter / jnp.maximum(
             area[:, None] + area[None, :] - inter, 1e-10)
-        # greedy in score order == sequential scan over the sorted list
-        def body(kept, i):
+        # greedy in score order == sequential scan over the sorted list;
+        # the carry also holds the ADAPTIVE threshold (NMSFast): when
+        # nms_eta < 1, each kept box decays it (thresh *= eta) while it
+        # stays above 0.5, so later boxes are suppressed more eagerly
+        def body(carry, i):
+            kept, thresh = carry
             # suppressed if any higher-scoring kept box overlaps > thresh
-            over = (iou[i] > nms_threshold) & kept & (
-                jnp.arange(K) < i)
+            over = (iou[i] > thresh) & kept & (jnp.arange(K) < i)
             keep_i = ~jnp.any(over) & (top_s[i] > 0)
-            return kept.at[i].set(keep_i), None
+            if eta < 1.0:
+                thresh = jnp.where(keep_i & (thresh > 0.5),
+                                   thresh * eta, thresh)
+            return (kept.at[i].set(keep_i), thresh), None
 
-        kept, _ = jax.lax.scan(
-            body, jnp.zeros((K,), bool), jnp.arange(K))
+        init = (jnp.zeros((K,), bool),
+                jnp.asarray(nms_threshold, jnp.float32))
+        (kept, _), _ = jax.lax.scan(body, init, jnp.arange(K))
         return jnp.where(kept, top_s, -1.0), idx
 
     def f(bxs, scs):

@@ -1,15 +1,17 @@
 """Fault-tolerance E2E helper: deterministic training under
-TrainEpochRange that crashes at a chosen epoch on the first launch
-attempt. Run via paddle_tpu.distributed.launch with --elastic_retries.
+TrainEpochRange. Faults (kill/hang/corrupt) come from the env-spec
+harness — e.g. PADDLE_FAULT_SPEC="epoch:kill:4:17" hard-exits(17) on
+entering the 4th epoch of the process (epoch 3 on a fresh attempt; a
+relaunched attempt resumes later in the range, so the same rule never
+re-fires). Run via paddle_tpu.distributed.launch with --elastic_retries.
 
 Env:
-  ACP_LOG         path to append one JSON line per epoch
-  ACP_CRASH_EPOCH epoch at which attempt 0 exits(17) BEFORE finishing
+  ACP_LOG                path to append one JSON line per epoch
+  PADDLE_FAULT_SPEC      fault rules (paddle_tpu.utils.fault_injection)
   PADDLE_LAUNCH_ATTEMPT  set by the launcher
 """
 import json
 import os
-import sys
 
 from paddle_tpu.core.device import force_cpu_devices
 
@@ -25,7 +27,6 @@ from paddle_tpu.incubate.checkpoint.auto_checkpoint import (  # noqa: E402
 
 EPOCHS = 6
 attempt = int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0"))
-crash_epoch = int(os.environ.get("ACP_CRASH_EPOCH", "-1"))
 log_path = os.environ["ACP_LOG"]
 
 paddle.seed(0)
@@ -37,8 +38,6 @@ data = [rng.rand(8, 4).astype(np.float32) for _ in range(EPOCHS)]
 r = TrainEpochRange(EPOCHS, name="acp_e2e")
 r.register(model=model, optimizer=opt)
 for epoch in r.get():
-    if attempt == 0 and epoch == crash_epoch:
-        sys.exit(17)  # simulated preemption BEFORE this epoch trains
     x = paddle.to_tensor(data[epoch])
     loss = ((model(x) - 1.0) ** 2).mean()
     loss.backward()

@@ -1,0 +1,62 @@
+"""Minimal rank process for elastic-runtime tests — deliberately does
+NOT import jax/paddle_tpu, so watchdog/budget/propagation tests measure
+the launcher, not interpreter startup.
+
+Modes (env TINY_MODE):
+  ok      heartbeat once, exit 0
+  hang    attempt 0: heartbeat once then sleep forever (a hung rank —
+          watchdog prey); attempt >= 1: exit 0
+  exit    exit TINY_EXIT_CODE (default 3) immediately; appends a line to
+          TINY_COUNT_FILE first so the test can count spawns
+  notice  heartbeat in a loop; on SIGTERM write TINY_NOTICE_FILE and
+          exit 143 (the preemption-notice acknowledgement)
+"""
+import os
+import signal
+import sys
+import time
+
+mode = os.environ.get("TINY_MODE", "ok")
+attempt = int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0"))
+hb = os.environ.get("PADDLE_HEARTBEAT_FILE")
+
+
+def beat():
+    if hb:
+        with open(hb, "a"):
+            pass
+        os.utime(hb, None)
+
+
+if mode == "hang":
+    if attempt == 0:
+        beat()
+        time.sleep(3600)  # never heartbeats again — the watchdog's job
+    beat()
+    sys.exit(0)
+elif mode == "exit":
+    count_file = os.environ.get("TINY_COUNT_FILE")
+    if count_file:
+        with open(count_file, "a") as f:
+            f.write(f"attempt={attempt}\n")
+    sys.exit(int(os.environ.get("TINY_EXIT_CODE", "3")))
+elif mode == "notice":
+    flag = os.environ["TINY_NOTICE_FILE"]
+
+    def on_term(signum, frame):
+        with open(flag, "w") as f:
+            f.write("preempted\n")
+        sys.exit(143)
+
+    signal.signal(signal.SIGTERM, on_term)
+    ready = os.environ.get("TINY_READY_FILE")
+    if ready:
+        with open(ready, "w") as f:
+            f.write("up\n")
+    for _ in range(600):
+        beat()
+        time.sleep(0.1)
+    sys.exit(9)  # the test should always preempt us first
+else:
+    beat()
+    sys.exit(0)

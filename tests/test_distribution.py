@@ -64,18 +64,26 @@ def test_categorical_weight_semantics():
         c.log_prob(paddle.to_tensor(np.array([1]))).numpy(),
         [math.log(0.5)], rtol=1e-6,
     )
-    p = np.array([0.25, 0.5, 0.25])
+    # entropy/kl_divergence exp-normalize (reference :812-860 softmax),
+    # unlike probs/log_prob/sample which sum-normalize
+    def smax(v):
+        e = np.exp(v - v.max())
+        return e / e.sum()
+
+    ps = smax(w)
     np.testing.assert_allclose(
-        c.entropy().numpy(), -(p * np.log(p)).sum(), rtol=1e-6
+        c.entropy().numpy(), -(ps * np.log(ps)).sum(), rtol=1e-6
     )
-    c2 = Categorical(paddle.to_tensor(np.array([1.0, 1.0, 2.0],
-                                               np.float32)))
-    q = np.array([0.25, 0.25, 0.5])
+    w2 = np.array([1.0, 1.0, 2.0], np.float32)
+    c2 = Categorical(paddle.to_tensor(w2))
+    qs = smax(w2)
     np.testing.assert_allclose(
-        c.kl_divergence(c2).numpy(), (p * np.log(p / q)).sum(), rtol=1e-5
+        c.kl_divergence(c2).numpy(), (ps * np.log(ps / qs)).sum(),
+        rtol=1e-5
     )
     paddle.seed(11)
     s = c.sample([2000]).numpy()
     assert s.shape == (2000,)
     freq = np.bincount(s, minlength=3) / 2000.0
-    np.testing.assert_allclose(freq, p, atol=0.05)
+    # sample() stays sum-normalized: weights [1, 2, 1] -> [.25, .5, .25]
+    np.testing.assert_allclose(freq, [0.25, 0.5, 0.25], atol=0.05)

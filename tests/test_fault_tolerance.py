@@ -1,17 +1,26 @@
-"""Fault tolerance as a tested path (VERDICT r4 missing #5 + #6).
+"""Fault tolerance as a tested path (VERDICT r4 missing #5 + #6, plus
+the elastic-runtime matrix: kill / hang / corrupt-checkpoint / preempt).
 
 Reference: launch_utils.py:996-1118 (watch loop + teardown),
 auto_checkpoint.py:265 (TrainEpochRange resume), and the multi-process
 rendezvous tests (test_fleet_launch.sh, unittests/multi_process.py).
-Here: kill a rank mid-training -> elastic relaunch -> auto-checkpoint
-resume with loss continuity; and a REAL 2-process jax.distributed CPU
-rendezvous through the launch runner with a cross-process psum.
+
+Layers:
+- fast in-process tests: fault-spec parsing, atomic save, CRC verify +
+  previous-snapshot fallback, snapshot-on-SIGTERM;
+- fast subprocess tests against a no-jax child (tests/helpers/
+  tiny_rank.py): hung-rank watchdog, restart budget, workerlog capture;
+- `slow`-marked E2E: jax children under the elastic launcher with loss
+  continuity against an uninterrupted run, 2-process rendezvous, and
+  SIGTERM propagation through a launcher subprocess.
 """
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -35,61 +44,488 @@ def _free_port():
     return port
 
 
-def test_crash_relaunch_resumes_with_continuity(tmp_path):
-    """Attempt 0 dies (exit 17) entering epoch 3; the elastic relaunch
-    must resume AT epoch 3 from the epoch-2 snapshot and produce the
-    same per-epoch losses as an uninterrupted run."""
-    from paddle_tpu.distributed.launch import launch
+@pytest.fixture
+def scoped_env(monkeypatch):
+    """Blank out fault/elastic knobs that could leak between tests and
+    re-arm the in-process injector on exit."""
+    from paddle_tpu.utils import fault_injection
 
-    log = tmp_path / "log.jsonl"
+    for k in ("PADDLE_FAULT_SPEC", "PADDLE_WATCHDOG_TIMEOUT",
+              "PADDLE_WATCHDOG_GRACE", "PADDLE_ELASTIC_BACKOFF",
+              "PADDLE_ELASTIC_WINDOW", "PADDLE_LOG_DIR",
+              "PADDLE_HEARTBEAT_FILE", "PADDLE_TRAINER_ID",
+              "PADDLE_CHECKPOINT_KEEP"):
+        monkeypatch.delenv(k, raising=False)
+    fault_injection.reset()
+    yield monkeypatch
+    fault_injection.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness
+# ---------------------------------------------------------------------------
+
+class TestFaultSpec:
+    def test_parse_rejects_garbage(self):
+        from paddle_tpu.utils.fault_injection import FaultInjector
+
+        with pytest.raises(ValueError, match="site:action:nth"):
+            FaultInjector("io.save:fail")
+        with pytest.raises(ValueError, match="action"):
+            FaultInjector("io.save:explode:1")
+
+    def test_fail_fires_at_nth_hit_only(self):
+        from paddle_tpu.utils.fault_injection import (
+            FaultInjector, InjectedFault,
+        )
+
+        inj = FaultInjector("io.save:fail:2")
+        inj.fire("io.save")  # hit 1: silent
+        with pytest.raises(InjectedFault, match="hit 2"):
+            inj.fire("io.save")
+        inj.fire("io.save")  # hit 3: silent again (one-shot rule)
+
+    def test_corrupt_rule_on_pathless_site_rejected(self):
+        from paddle_tpu.utils.fault_injection import FaultInjector
+
+        with pytest.raises(ValueError, match="un-instrumented"):
+            FaultInjector("io.load:corrupt:1")  # io.load has no .post
+
+    def test_corrupt_normalizes_to_post_site_and_truncates(self, tmp_path):
+        from paddle_tpu.utils.fault_injection import FaultInjector
+
+        p = tmp_path / "blob.bin"
+        p.write_bytes(b"x" * 100)
+        inj = FaultInjector("io.save:corrupt:1")
+        inj.fire("io.save", path=str(p))       # pre-site: not the target
+        assert p.stat().st_size == 100
+        inj.fire("io.save.post", path=str(p))  # post-site: truncates
+        assert p.stat().st_size == 50
+
+
+class TestAtomicIO:
+    def test_injected_save_failure_preserves_old_file(
+            self, tmp_path, scoped_env):
+        import paddle_tpu as paddle
+        from paddle_tpu.utils.fault_injection import InjectedFault, reset
+
+        path = str(tmp_path / "m.pdparams")
+        paddle.save({"w": paddle.to_tensor(np.ones(3, np.float32))}, path)
+        scoped_env.setenv("PADDLE_FAULT_SPEC", "io.save:fail:1")
+        reset()
+        with pytest.raises(InjectedFault):
+            paddle.save(
+                {"w": paddle.to_tensor(np.zeros(3, np.float32))}, path)
+        scoped_env.delenv("PADDLE_FAULT_SPEC")
+        reset()
+        # the failed save neither tore nor replaced the original
+        out = paddle.load(path)
+        np.testing.assert_array_equal(out["w"].numpy(), np.ones(3))
+        assert [f for f in os.listdir(tmp_path)
+                if ".tmp." in f] == []  # no temp litter
+
+    def test_corrupt_injection_makes_load_fail(self, tmp_path, scoped_env):
+        import paddle_tpu as paddle
+        from paddle_tpu.utils.fault_injection import reset
+
+        path = str(tmp_path / "m.pdparams")
+        scoped_env.setenv("PADDLE_FAULT_SPEC", "io.save:corrupt:1")
+        reset()
+        paddle.save(
+            {"w": paddle.to_tensor(np.arange(4096, dtype=np.float32))},
+            path)
+        scoped_env.delenv("PADDLE_FAULT_SPEC")
+        reset()
+        with pytest.raises(Exception):
+            paddle.load(path)
+
+    def test_crc32_file_detects_modification(self, tmp_path):
+        from paddle_tpu.framework.io import crc32_file
+
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"checkpoint-bytes" * 64)
+        a = crc32_file(str(p))
+        assert a == crc32_file(str(p))
+        with open(p, "r+b") as f:
+            f.seek(10)
+            f.write(b"\x00")
+        assert crc32_file(str(p)) != a
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: CRC in meta.json, fallback, retention
+# ---------------------------------------------------------------------------
+
+def _mk_range(tmp_path, job, epochs=4, **kw):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+        TrainEpochRange,
+    )
+
+    paddle.seed(7)
+    model = nn.Linear(3, 3)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    r = TrainEpochRange(epochs, name="integ",
+                        checkpoint_path=str(tmp_path / job), **kw)
+    r.register(model=model, optimizer=opt)
+    return r, model, opt
+
+
+def _train_all(r, model, opt):
+    """Run the range; returns {epoch: weight-after-epoch}."""
+    import paddle_tpu as paddle
+
+    weights = {}
+    rng = np.random.RandomState(0)
+    for epoch in r.get():
+        x = paddle.to_tensor(rng.rand(4, 3).astype(np.float32))
+        loss = ((model(x) - 1.0) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        weights[epoch] = model.weight.numpy().copy()
+    return weights
+
+
+class TestCheckpointIntegrity:
+    def test_meta_records_matching_crcs(self, tmp_path, scoped_env):
+        from paddle_tpu.framework.io import crc32_file
+
+        r, model, opt = _mk_range(tmp_path, "job_crc", keep_checkpoints=3)
+        _train_all(r, model, opt)
+        snaps = r._snapshots()
+        assert snaps, "no snapshot written"
+        _, newest = snaps[0]
+        meta = json.load(open(os.path.join(newest, "meta.json")))
+        assert set(meta["files"]) == {"model_0.pdparams", "opt_0.pdopt"}
+        for fname, want in meta["files"].items():
+            assert crc32_file(os.path.join(newest, fname)) == want
+
+    def test_retention_keeps_last_k(self, tmp_path, scoped_env):
+        r, model, opt = _mk_range(tmp_path, "job_keep", epochs=5,
+                                  keep_checkpoints=2)
+        _train_all(r, model, opt)
+        epochs = [e for e, _ in r._snapshots()]
+        assert epochs == [4, 3]  # newest two of five generations
+
+    def test_truncated_file_falls_back_to_previous_snapshot(
+            self, tmp_path, scoped_env):
+        r, model, opt = _mk_range(tmp_path, "job_fb", keep_checkpoints=3)
+        weights = _train_all(r, model, opt)
+
+        # tear the newest generation's model file (epoch 3)
+        _, newest = r._snapshots()[0]
+        victim = os.path.join(newest, "model_0.pdparams")
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(size // 2)
+
+        r2, model2, opt2 = _mk_range(tmp_path, "job_fb",
+                                     keep_checkpoints=3)
+        start = r2.restore()
+        # fell back: snapshot 3 is corrupt, snapshot 2 serves
+        assert r2._restored_epoch == 2
+        assert start == 3
+        # continuity: restored weights are exactly the epoch-2 weights
+        np.testing.assert_array_equal(model2.weight.numpy(), weights[2])
+
+    def test_all_snapshots_corrupt_restarts_from_zero(
+            self, tmp_path, scoped_env):
+        r, model, opt = _mk_range(tmp_path, "job_dead", keep_checkpoints=2)
+        _train_all(r, model, opt)
+        for _, snap in r._snapshots():
+            for fname in ("model_0.pdparams", "opt_0.pdopt"):
+                with open(os.path.join(snap, fname), "r+b") as f:
+                    f.truncate(4)
+        r2, model2, opt2 = _mk_range(tmp_path, "job_dead",
+                                     keep_checkpoints=2)
+        assert r2.restore() == 0
+        assert r2._restored_epoch == -1
+
+    def test_registry_mismatch_falls_back_without_retry(
+            self, tmp_path, scoped_env):
+        """Snapshots written with fewer state entries than the restoring
+        registry are deterministic corruption, not transient I/O."""
+        r, model, opt = _mk_range(tmp_path, "job_shape")
+        _train_all(r, model, opt)
+        r2, model2, opt2 = _mk_range(tmp_path, "job_shape")
+        r2._models.append(model2)  # registry now expects model_1 too
+        assert r2.restore() == 0   # every generation rejected, no crash
+
+    def test_legacy_flat_layout_still_restores(self, tmp_path, scoped_env):
+        """Pre-generation checkpoints (meta.json directly in the job dir,
+        no CRC map) remain a valid last-resort resume point."""
+        r, model, opt = _mk_range(tmp_path, "job_legacy")
+        os.makedirs(r._dir, exist_ok=True)
+        from paddle_tpu.framework import io as fio
+
+        fio.save(model.state_dict(),
+                 os.path.join(r._dir, "model_0.pdparams"))
+        fio.save(opt.state_dict(), os.path.join(r._dir, "opt_0.pdopt"))
+        with open(os.path.join(r._dir, "meta.json"), "w") as f:
+            json.dump({"epoch": 1, "name": "integ",
+                       "max_epoch_num": 4}, f)
+        assert r.restore() == 2
+        assert r._restored_epoch == 1
+
+    def test_transient_io_error_is_retried(self, tmp_path, scoped_env):
+        from paddle_tpu.utils.fault_injection import reset
+
+        r, model, opt = _mk_range(tmp_path, "job_retry",
+                                  keep_checkpoints=2)
+        _train_all(r, model, opt)
+        # one transient load failure: the 1st io.load of the restore
+        # fails, the retry succeeds against the SAME (newest) snapshot
+        scoped_env.setenv("PADDLE_FAULT_SPEC", "io.load:fail:1")
+        reset()
+        r2, model2, opt2 = _mk_range(tmp_path, "job_retry",
+                                     keep_checkpoints=2)
+        assert r2.restore() == 4
+        assert r2._restored_epoch == 3
+
+
+class TestSigtermSnapshot:
+    def test_preemption_notice_snapshots_current_epoch(
+            self, tmp_path, scoped_env):
+        """SIGTERM mid-epoch → the just-finished epoch is snapshotted and
+        the process exits 143; a restart resumes with zero lost epochs."""
+        # inter=5: the regular path would not save until epoch 4, so a
+        # snapshot at epoch 1 can only come from the preemption notice
+        r, model, opt = _mk_range(tmp_path, "job_term", epochs=6,
+                                  keep_checkpoints=2,
+                                  save_checkpoint_inter=5)
+        import paddle_tpu as paddle
+
+        seen = []
+        rng = np.random.RandomState(0)
+        with pytest.raises(SystemExit) as ei:
+            for epoch in r.get():
+                x = paddle.to_tensor(rng.rand(4, 3).astype(np.float32))
+                loss = ((model(x) - 1.0) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                seen.append(epoch)
+                if epoch == 1:
+                    os.kill(os.getpid(), signal.SIGTERM)
+        assert ei.value.code == 143
+        assert seen == [0, 1]
+        # epoch 1 made it to disk even though save_checkpoint_inter
+        # would not have saved until later
+        assert r._snapshots()[0][0] == 1
+        r2, model2, opt2 = _mk_range(tmp_path, "job_term", epochs=6,
+                                     keep_checkpoints=2,
+                                     save_checkpoint_inter=5)
+        assert r2.restore() == 2
+
+    def test_notice_on_final_epoch_is_normal_completion(
+            self, tmp_path, scoped_env):
+        """A SIGTERM that lands during the LAST epoch must not turn a
+        completed run into exit 143."""
+        r, model, opt = _mk_range(tmp_path, "job_last", epochs=2)
+        seen = []
+        for epoch in r.get():       # no SystemExit expected
+            seen.append(epoch)
+            if epoch == 1:
+                os.kill(os.getpid(), signal.SIGTERM)
+        assert seen == [0, 1]
+        assert r._snapshots()[0][0] == 1  # final epoch still snapshotted
+
+
+# ---------------------------------------------------------------------------
+# watchdog / restart budget / log capture (no-jax child: fast)
+# ---------------------------------------------------------------------------
+
+TINY = os.path.join(HELPERS, "tiny_rank.py")
+
+
+class TestElasticRuntime:
+    def test_hung_rank_is_detected_and_relaunched(self, scoped_env):
+        from paddle_tpu.distributed.launch import launch
+
+        scoped_env.setenv("TINY_MODE", "hang")
+        scoped_env.setenv("PADDLE_WATCHDOG_GRACE", "1")
+        scoped_env.setenv("PADDLE_ELASTIC_BACKOFF", "0.05")
+        t0 = time.monotonic()
+        rc = launch(TINY, [], nproc_per_node=1, start_port=_free_port(),
+                    watchdog_timeout=1.0, elastic_retries=1)
+        elapsed = time.monotonic() - t0
+        assert rc == 0  # attempt 1 exits clean after the watchdog kill
+        assert elapsed < 20, f"watchdog too slow: {elapsed:.1f}s"
+
+    def test_restart_budget_exhausts_with_clean_nonzero_exit(
+            self, tmp_path, scoped_env):
+        from paddle_tpu.distributed.launch import launch
+
+        count_file = tmp_path / "spawns"
+        scoped_env.setenv("TINY_MODE", "exit")
+        scoped_env.setenv("TINY_EXIT_CODE", "7")
+        scoped_env.setenv("TINY_COUNT_FILE", str(count_file))
+        scoped_env.setenv("PADDLE_ELASTIC_BACKOFF", "0.05")
+        rc = launch(TINY, [], nproc_per_node=1, start_port=_free_port(),
+                    elastic_retries=2)
+        assert rc == 7
+        # initial attempt + exactly 2 budgeted restarts
+        assert len(count_file.read_text().splitlines()) == 3
+
+    def test_zero_retries_never_relaunches(self, tmp_path, scoped_env):
+        from paddle_tpu.distributed.launch import launch
+
+        count_file = tmp_path / "spawns"
+        scoped_env.setenv("TINY_MODE", "exit")
+        scoped_env.setenv("TINY_EXIT_CODE", "5")
+        scoped_env.setenv("TINY_COUNT_FILE", str(count_file))
+        rc = launch(TINY, [], nproc_per_node=1, start_port=_free_port())
+        assert rc == 5
+        assert len(count_file.read_text().splitlines()) == 1
+
+    def test_workerlog_capture(self, tmp_path, scoped_env):
+        from paddle_tpu.distributed.launch import launch
+
+        scoped_env.setenv("TINY_MODE", "ok")
+        rc = launch(TINY, [], nproc_per_node=2, start_port=_free_port(),
+                    log_dir=str(tmp_path / "logs"))
+        assert rc == 0
+        for rank in (0, 1):
+            log = tmp_path / "logs" / f"workerlog.{rank}"
+            assert log.exists()
+            assert f"attempt 0 rank {rank}" in log.read_text()
+
+    def test_backoff_grows_and_caps_with_jitter(self):
+        from paddle_tpu.distributed.elastic import ElasticManager
+
+        mgr = ElasticManager("x.py", [], [], backoff_base=1.0,
+                             backoff_cap=8.0)
+        for n, nominal in [(1, 1.0), (2, 2.0), (3, 4.0), (4, 8.0),
+                           (10, 8.0)]:  # capped past 2^3
+            for _ in range(20):
+                d = mgr._backoff_delay(n)
+                assert 0.5 * nominal <= d <= 1.5 * nominal
+
+
+# ---------------------------------------------------------------------------
+# E2E matrix with jax children (slow: multi-process, interpreter-heavy)
+# ---------------------------------------------------------------------------
+
+def _reference_run(tmp_path):
+    """Uninterrupted 6-epoch run; returns [(epoch, loss)] rows."""
     ref_log = tmp_path / "ref.jsonl"
-    ckpt = tmp_path / "ckpt"
-
     base = _clean_env()
-    base["PADDLE_CHECKPOINT_DIR"] = str(ckpt)
+    base["PADDLE_CHECKPOINT_DIR"] = str(tmp_path / "ref_ckpt")
     base["ACP_LOG"] = str(ref_log)
-    base["ACP_CRASH_EPOCH"] = "-1"
     base["PADDLE_JOB_ID"] = "ref_job"
-    # uninterrupted reference run
     rc = subprocess.call(
         [sys.executable, os.path.join(HELPERS, "acp_train.py")], env=base
     )
     assert rc == 0
-    ref = [json.loads(l) for l in ref_log.read_text().splitlines()]
-    assert [r["epoch"] for r in ref] == list(range(6))
+    rows = [json.loads(l) for l in ref_log.read_text().splitlines()]
+    assert [r["epoch"] for r in rows] == list(range(6))
+    return rows
 
-    # crashing run under the elastic launcher
-    env2 = dict(base)
-    env2["ACP_LOG"] = str(log)
-    env2["ACP_CRASH_EPOCH"] = "3"
-    env2["PADDLE_JOB_ID"] = "crash_job"
+
+def _launch_with_env(env2, **launch_kw):
+    from paddle_tpu.distributed.launch import launch
+
     old = dict(os.environ)
     os.environ.clear()
     os.environ.update(env2)
     try:
-        rc = launch(
-            os.path.join(HELPERS, "acp_train.py"), [],
-            nproc_per_node=1, start_port=_free_port(),
-            elastic_retries=1,
-        )
+        return launch(os.path.join(HELPERS, "acp_train.py"), [],
+                      nproc_per_node=1, start_port=_free_port(),
+                      **launch_kw)
     finally:
         os.environ.clear()
         os.environ.update(old)
-    assert rc == 0
 
+
+def _assert_continuity(log, ref, expect_a0, expect_a1, restored_from):
     rows = [json.loads(l) for l in log.read_text().splitlines()]
     a0 = [r for r in rows if r["attempt"] == 0]
     a1 = [r for r in rows if r["attempt"] == 1]
-    assert [r["epoch"] for r in a0] == [0, 1, 2]       # died entering 3
-    assert [r["epoch"] for r in a1] == [3, 4, 5]       # resumed, no redo
-    assert a1[0]["restored_from"] == 2                  # from the snapshot
-    # loss continuity: the stitched run == the uninterrupted run
+    assert [r["epoch"] for r in a0] == expect_a0
+    assert [r["epoch"] for r in a1] == expect_a1
+    assert a1[0]["restored_from"] == restored_from
     stitched = {r["epoch"]: r["loss"] for r in a0 + a1}
     for r in ref:
         np.testing.assert_allclose(stitched[r["epoch"]], r["loss"],
                                    rtol=1e-6, err_msg=f"epoch {r['epoch']}")
 
 
+@pytest.mark.slow
+def test_crash_relaunch_resumes_with_continuity(tmp_path):
+    """kill: attempt 0 hard-exits(17) entering epoch 3 (injected); the
+    elastic relaunch resumes AT epoch 3 from the epoch-2 snapshot and
+    produces the same per-epoch losses as an uninterrupted run."""
+    ref = _reference_run(tmp_path)
+    log = tmp_path / "log.jsonl"
+    env2 = _clean_env()
+    env2["PADDLE_CHECKPOINT_DIR"] = str(tmp_path / "ckpt")
+    env2["ACP_LOG"] = str(log)
+    env2["PADDLE_JOB_ID"] = "crash_job"
+    env2["PADDLE_FAULT_SPEC"] = "epoch:kill:4:17"
+    env2["PADDLE_ELASTIC_BACKOFF"] = "0.05"
+    rc = _launch_with_env(env2, elastic_retries=1)
+    assert rc == 0
+    _assert_continuity(log, ref, [0, 1, 2], [3, 4, 5], restored_from=2)
+
+
+@pytest.mark.slow
+def test_hung_rank_watchdog_relaunch_continuity(tmp_path):
+    """hang: attempt 0 stops heartbeating on entering epoch 3; the
+    watchdog recycles the rank within its timeout and the relaunch
+    resumes with loss continuity."""
+    ref = _reference_run(tmp_path)
+    log = tmp_path / "log.jsonl"
+    env2 = _clean_env()
+    env2["PADDLE_CHECKPOINT_DIR"] = str(tmp_path / "ckpt")
+    env2["ACP_LOG"] = str(log)
+    env2["PADDLE_JOB_ID"] = "hang_job"
+    env2["PADDLE_FAULT_SPEC"] = "epoch:hang:4:3600"
+    env2["PADDLE_ELASTIC_BACKOFF"] = "0.05"
+    env2["PADDLE_WATCHDOG_GRACE"] = "2"
+    t0 = time.monotonic()
+    # the timeout must outlast child startup (jax import) but the hang
+    # must be detected within it — generous for CI, tiny vs. 3600s
+    rc = _launch_with_env(env2, elastic_retries=1, watchdog_timeout=20.0)
+    elapsed = time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 120, f"hung rank not recycled in time: {elapsed:.0f}s"
+    _assert_continuity(log, ref, [0, 1, 2], [3, 4, 5], restored_from=2)
+
+
+@pytest.mark.slow
+def test_sigterm_propagates_to_ranks(tmp_path):
+    """SIGTERM to the launcher is forwarded to every rank (the
+    preemption notice) and no relaunch follows."""
+    notice = tmp_path / "notice"
+    ready = tmp_path / "ready"
+    env = _clean_env()
+    env["TINY_MODE"] = "notice"
+    env["TINY_NOTICE_FILE"] = str(notice)
+    env["TINY_READY_FILE"] = str(ready)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=1", f"--start_port={_free_port()}",
+         "--elastic_retries=3", TINY],
+        env=env,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while not ready.exists():
+            assert p.poll() is None, "launcher died before ready"
+            assert time.monotonic() < deadline, "child never came up"
+            time.sleep(0.1)
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert notice.read_text().strip() == "preempted"
+    assert rc == 143  # preemption is not a retryable failure
+
+
+@pytest.mark.slow
 def test_two_process_rendezvous_psum(tmp_path):
     """2 OS processes rendezvous over jax.distributed (coordinator =
     endpoint 0) through the launch runner and all-reduce across the

@@ -50,6 +50,41 @@ def test_fit_reaches_e2e_accuracy(capsys):
     assert preds[0].shape == (256, 10)
 
 
+def test_terminate_on_preempt_saves_and_stops(tmp_path, monkeypatch):
+    """SIGTERM (the preemption notice) mid-epoch: the epoch finishes, a
+    `preempt` checkpoint is written, training stops, and per-batch
+    heartbeats reached the launcher's heartbeat file."""
+    import signal
+
+    from paddle_tpu.hapi.callbacks import TerminateOnPreempt
+
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("PADDLE_HEARTBEAT_FILE", str(hb))
+    train = FakeData(sample_shape=(1, 28, 28), num_samples=64,
+                     num_classes=10)
+    model = _model()
+
+    class Killer(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            if step == 1:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    epochs_run = []
+
+    class EpochCounter(Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            epochs_run.append(epoch)
+
+    top = TerminateOnPreempt(save_dir=str(tmp_path / "pre"), verbose=0)
+    model.fit(train, batch_size=32, epochs=4, verbose=0,
+              callbacks=[Killer(), top, EpochCounter()])
+    assert top.preempted
+    assert model.stop_training
+    assert epochs_run == [0]         # stopped after the notice's epoch
+    assert os.path.exists(str(tmp_path / "pre" / "preempt.pdparams"))
+    assert hb.exists()               # heartbeats flowed per batch
+
+
 def test_fit_with_validation_and_early_stopping(capsys):
     train = FakeData(sample_shape=(1, 28, 28), num_samples=128,
                      num_classes=10)

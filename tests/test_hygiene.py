@@ -361,6 +361,31 @@ class TestReaderDecorators:
             paddle.reader.compose(lambda: iter([1]), check_aligment=False)
 
 
+class TestEnvKnobDocs:
+    """Every PADDLE_* env knob the tree mentions must be documented in
+    the README's fault-tolerance/knob tables — undocumented knobs rot
+    into magic the next operator can't discover."""
+
+    def test_all_env_knobs_documented_in_readme(self):
+        import pathlib
+        import re
+
+        import paddle_tpu
+
+        pkg = pathlib.Path(paddle_tpu.__file__).parent
+        readme = (pkg.parent / "README.md").read_text()
+        knobs = set()
+        for py in pkg.rglob("*.py"):
+            knobs |= set(re.findall(r"PADDLE_[A-Z0-9_]+",
+                                    py.read_text()))
+        assert "PADDLE_WATCHDOG_TIMEOUT" in knobs  # scanner sanity
+        missing = sorted(k for k in knobs if k not in readme)
+        assert not missing, (
+            f"PADDLE_* env knobs referenced in paddle_tpu/ but absent "
+            f"from README.md: {missing}"
+        )
+
+
 class TestDatasetTensorNamespaces:
     def test_tensor_module_paths(self):
         import paddle_tpu as paddle

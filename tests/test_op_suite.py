@@ -729,6 +729,34 @@ def test_sequence_conv():
     )
 
 
+def test_sequence_conv_even_context_default():
+    """Reference default context_start = -int(context_length / 2): for an
+    EVEN window the extra position sits BEFORE the center (CL=4 → -2),
+    not after (ADVICE r5)."""
+    rng = np.random.RandomState(3)
+    B, T, D, M, CL = 2, 6, 3, 4, 4
+    x = rng.rand(B, T, D).astype(np.float32)
+    w = rng.rand(CL * D, M).astype(np.float32)
+    lens = np.array([6, 4], np.int64)
+
+    ref = np.zeros((B, T, M), np.float32)
+    for b in range(B):
+        for t in range(T):
+            if t >= lens[b]:
+                continue
+            ctx = []
+            for k in range(CL):
+                p = t - 2 + k          # context_start = -(4 // 2) = -2
+                if 0 <= p < lens[b]:
+                    ctx.append(x[b, p])
+                else:
+                    ctx.append(np.zeros(D, np.float32))
+            ref[b, t] = np.concatenate(ctx) @ w
+    got = P.sequence_conv(P.to_tensor(x), P.to_tensor(w),
+                          P.to_tensor(lens), context_length=CL).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_sequence_expand_slice_enumerate():
     x = np.arange(6, dtype=np.float32).reshape(3, 2)
     lens = np.array([2, 0, 3], np.int64)
@@ -851,6 +879,31 @@ def test_multiclass_nms_suppression():
                                [0.9, 0.7], rtol=1e-6)
     # the suppressed overlapping box is absent
     assert not any(abs(row[2] - 0.5) < 1e-6 for row in kept)
+
+
+def test_multiclass_nms_eta_adaptive_threshold():
+    """nms_eta < 1 decays the IoU threshold after each kept box (the
+    reference's adaptive NMS) — previously silently ignored (ADVICE r5)."""
+    from paddle_tpu.vision.ops import multiclass_nms
+
+    # two boxes with IoU exactly 0.6: inter 10*7.5=75, union 125
+    boxes = np.array([[
+        [0.0, 0.0, 10.0, 10.0], [0.0, 2.5, 10.0, 12.5],
+    ]], np.float32)
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 1] = [0.9, 0.8]        # class 1 (class 0 = background)
+    kw = dict(score_threshold=0.05, nms_top_k=2, keep_top_k=2,
+              nms_threshold=0.8, background_label=0)
+
+    _, counts = multiclass_nms(
+        P.to_tensor(boxes), P.to_tensor(scores), **kw)
+    assert int(counts.numpy()[0]) == 2   # 0.6 <= 0.8: both survive
+
+    _, counts = multiclass_nms(
+        P.to_tensor(boxes), P.to_tensor(scores), nms_eta=0.5, **kw)
+    # keeping the 0.9 box decays the threshold 0.8 -> 0.4 (> 0.5 gate),
+    # so the 0.6-overlap box is now suppressed
+    assert int(counts.numpy()[0]) == 1
 
 
 def test_box_clip():
