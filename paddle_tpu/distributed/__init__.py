@@ -27,10 +27,12 @@ from .collective import (  # noqa: F401
     alltoall,
     barrier,
     broadcast,
+    monitored_barrier,
     reduce,
     reduce_scatter,
     scatter,
 )
+from . import comm_monitor  # noqa: F401  (flight recorder, CommMonitor)
 from .parallel import DataParallel  # noqa: F401
 from .pipeline import PipelineLayer, PipelineParallel  # noqa: F401
 from .spawn import spawn  # noqa: F401

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.tensor import Tensor
 from . import comm
+from . import comm_monitor as _cm
 from .comm import Group
 
 
@@ -153,6 +154,35 @@ def _reduce_scatter_prog(gid: int, op: int):
 
 
 # ---------------------------------------------------------------------------
+# Monitoring seam: every collective call reports (op, group, shape, dtype)
+# to the flight recorder; eager calls additionally run under the
+# PADDLE_COLL_TIMEOUT watchdog (comm_monitor.py).
+# ---------------------------------------------------------------------------
+
+
+def _meta(x):
+    raw = getattr(x, "_data", x)  # Tensor, jax/numpy array, or None
+    if raw is None or isinstance(raw, (list, tuple)):
+        return (), ""
+    return tuple(getattr(raw, "shape", ())), str(getattr(raw, "dtype", ""))
+
+
+def _watched(op_name: str, g: Group, x):
+    shape, dtype = _meta(x)
+    return _cm.monitor().watch(op_name, g.id, g.axis_name, g.nranks,
+                               shape=shape, dtype=dtype)
+
+
+def _record_spmd(op_name: str, g: Group, x):
+    # inside a shard_map trace there is no execution to deadline — the
+    # collective runs when XLA schedules it — but the call still takes a
+    # sequence number so desync checks see the full op stream
+    shape, dtype = _meta(x)
+    _cm.monitor().record(op_name, g.id, g.axis_name, g.nranks,
+                         shape=shape, dtype=dtype, status="spmd")
+
+
+# ---------------------------------------------------------------------------
 # Public API (paddle.distributed.*)
 # ---------------------------------------------------------------------------
 
@@ -164,13 +194,15 @@ def all_reduce(tensor, op: int = ReduceOp.SUM, group=None,
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
 
+        _record_spmd("all_reduce", g, tensor)
         out = AG.apply(
             lambda x: _psum_like(x, g.axis_name, op), (_as_t(tensor),),
             name="c_allreduce",
         )
         return _write_back(tensor, out)
     t = _as_t(tensor)
-    t._data = _allreduce_prog(g.id, op)(_ranked(t, g))
+    with _watched("all_reduce", g, t):
+        t._data = _allreduce_prog(g.id, op)(_ranked(t, g))
     t._node = None
     return t
 
@@ -182,6 +214,8 @@ def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM, group=None,
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
 
+        _record_spmd("reduce", g, tensor)
+
         def f(x):
             r = _psum_like(x, g.axis_name, op)
             i = jax.lax.axis_index(g.axis_name)
@@ -190,7 +224,8 @@ def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM, group=None,
         return _write_back(tensor, AG.apply(f, (_as_t(tensor),),
                                             name="c_reduce"))
     t = _as_t(tensor)
-    t._data = _reduce_prog(g.id, op, dst)(_ranked(t, g))
+    with _watched("reduce", g, t):
+        t._data = _reduce_prog(g.id, op, dst)(_ranked(t, g))
     t._node = None
     return t
 
@@ -205,6 +240,7 @@ def all_gather(tensor_list: Optional[List], tensor=None, group=None,
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
 
+        _record_spmd("all_gather", g, tensor)
         out = AG.apply(
             lambda x: jax.lax.all_gather(x, g.axis_name, axis=0, tiled=False),
             (_as_t(tensor),), name="c_allgather",
@@ -213,7 +249,8 @@ def all_gather(tensor_list: Optional[List], tensor=None, group=None,
             tensor_list.extend(out[i] for i in range(g.nranks))
         return out
     t = _as_t(tensor)
-    full = _allgather_prog(g.id)(_ranked(t, g))
+    with _watched("all_gather", g, t):
+        full = _allgather_prog(g.id)(_ranked(t, g))
     parts = [
         Tensor._wrap(jax.lax.index_in_dim(full, i, 0, keepdims=False))
         for i in range(g.nranks)
@@ -230,6 +267,8 @@ def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True,
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
 
+        _record_spmd("broadcast", g, tensor)
+
         def f(x):
             # O(size) select+psum, not an O(nranks*size) all_gather;
             # psum promotes bool, so restore the caller's dtype
@@ -240,7 +279,8 @@ def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True,
         return _write_back(tensor, AG.apply(f, (_as_t(tensor),),
                                             name="c_broadcast"))
     t = _as_t(tensor)
-    t._data = _broadcast_prog(g.id, src)(_ranked(t, g))
+    with _watched("broadcast", g, t):
+        t._data = _broadcast_prog(g.id, src)(_ranked(t, g))
     t._node = None
     return t
 
@@ -253,6 +293,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op: int = ReduceOp.SUM,
     src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
+
+        _record_spmd("reduce_scatter", g, src)
 
         def f(x):
             if op == ReduceOp.SUM:
@@ -267,7 +309,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op: int = ReduceOp.SUM,
         return _write_back(src, AG.apply(f, (_as_t(src),),
                                          name="c_reducescatter"))
     t = _as_t(src)
-    out_raw = _reduce_scatter_prog(g.id, op)(_ranked(t, g))
+    with _watched("reduce_scatter", g, t):
+        out_raw = _reduce_scatter_prog(g.id, op)(_ranked(t, g))
     out = Tensor._wrap(out_raw)
     if isinstance(tensor, Tensor) and tensor is not src:
         tensor._data = out_raw
@@ -292,6 +335,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None,
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
 
+        _record_spmd("scatter", g, tensor)
         stacked_in = tensor_list if tensor_list is not None else tensor
         if isinstance(stacked_in, (list, tuple)):
             raws = tuple(_as_t(t) for t in stacked_in)
@@ -320,7 +364,8 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None,
     else:
         stacked = _raw(tensor)
     t = _as_t(tensor)
-    t._data = comm.shard_rank_axis(stacked, g)
+    with _watched("scatter", g, t):
+        t._data = comm.shard_rank_axis(stacked, g)
     t._node = None
     return t
 
@@ -332,6 +377,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
     if comm.in_spmd_region(g.axis_name):
         from ..core import autograd as AG
 
+        _record_spmd("alltoall", g, in_tensor_list)
         return AG.apply(
             lambda x: jax.lax.all_to_all(x, g.axis_name, split_axis=0,
                                          concat_axis=0, tiled=True),
@@ -346,7 +392,9 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
         A = jnp.stack([_raw(t) for t in in_tensor_list], axis=0)
     else:
         A = _raw(in_tensor_list)
-    B = _alltoall_prog(g.id)(comm.shard_rank_axis(A, g))
+    with _cm.monitor().watch("alltoall", g.id, g.axis_name, g.nranks,
+                             shape=tuple(A.shape), dtype=str(A.dtype)):
+        B = _alltoall_prog(g.id)(comm.shard_rank_axis(A, g))
     parts = [Tensor._wrap(B[s]) for s in range(g.nranks)]
     if out_tensor_list is not None:
         out_tensor_list.extend(parts)
@@ -356,8 +404,50 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
 def barrier(group=None):
     """collective ops barrier (operators/collective/barrier_op)."""
     g = _group(group)
+    if comm.in_spmd_region(g.axis_name):
+        _record_spmd("barrier", g, None)
+        return
     x = comm.shard_rank_axis(jnp.zeros((g.nranks, 1), jnp.int32), g)
-    jax.block_until_ready(_allreduce_prog(g.id, ReduceOp.SUM)(x))
+    with _cm.monitor().watch("barrier", g.id, g.axis_name, g.nranks,
+                             shape=(g.nranks, 1), dtype="int32"):
+        jax.block_until_ready(_allreduce_prog(g.id, ReduceOp.SUM)(x))
+
+
+def monitored_barrier(group=None, timeout: Optional[float] = None):
+    """Barrier that NAMES the missing ranks instead of deadlocking
+    (torch.distributed.monitored_barrier analog, built on the file-based
+    rendezvous the elastic launcher shares between its local ranks).
+
+    Phase 1 — cross-process: every trainer process checks in through
+    PADDLE_COLL_SYNC_DIR; ranks absent at the deadline are named in the
+    raised :class:`~.comm_monitor.CollectiveTimeoutError`, and the
+    (seq, op-fingerprint) exchange raises
+    :class:`~.comm_monitor.CollectiveDesyncError` naming both mismatched
+    call sites when the op streams diverged. Trainer-process ranks are
+    orthogonal to device subgroups in the single-controller model, so
+    phase 1 runs only for the job-wide default group — a subgroup
+    barrier must not wait for processes that never joined it. Phase 2 —
+    on-device barrier over the group's mesh axis, under the
+    PADDLE_COLL_TIMEOUT watchdog.
+
+    `timeout` defaults to PADDLE_COLL_TIMEOUT, else 300s for the
+    cross-process wait."""
+    mon = _cm.monitor()
+    t = timeout
+    if t is None:
+        t = mon.timeout if mon.timeout > 0 else 300.0
+    g = _group(group)
+    if comm.in_spmd_region(g.axis_name):
+        # inside a shard_map trace: no execution to monitor (and blocking
+        # file I/O at trace time would be wrong) — record like barrier()
+        _record_spmd("monitored_barrier", g, None)
+        return
+    if g.id == 0:
+        mon.barrier_rendezvous(t)
+    x = comm.shard_rank_axis(jnp.zeros((g.nranks, 1), jnp.int32), g)
+    with mon.watch("monitored_barrier", g.id, g.axis_name, g.nranks,
+                   shape=(g.nranks, 1), dtype="int32", timeout=t):
+        jax.block_until_ready(_allreduce_prog(g.id, ReduceOp.SUM)(x))
 
 
 def _as_t(x) -> Tensor:

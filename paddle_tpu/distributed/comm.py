@@ -101,13 +101,114 @@ def _ensure_init() -> Group:
     return _state.default_group
 
 
+def _probe_endpoint(endpoint: str, timeout: float = 1.0) -> bool:
+    """Cheap TCP reachability check of a host:port (the coordinator)."""
+    import socket
+
+    host, _, port = endpoint.rpartition(":")
+    try:
+        with socket.create_connection((host, int(port)), timeout):
+            return True
+    except (OSError, ValueError):
+        return False
+
+
+def _rdv_diagnose(coordinator: str, num: int, pid: int) -> str:
+    """Attribution for a failed rendezvous: coordinator reachability plus
+    which ranks never checked in through the launcher's shared sync dir."""
+    import os
+
+    parts = [
+        f"rendezvous failed: rank {pid}/{num}, coordinator {coordinator} "
+        f"tcp-{'reachable' if _probe_endpoint(coordinator) else 'UNREACHABLE'}"
+    ]
+    sync_dir = os.environ.get("PADDLE_COLL_SYNC_DIR")
+    if sync_dir:
+        d = os.path.join(sync_dir, "rdv")
+        missing = [r for r in range(num)
+                   if not os.path.exists(os.path.join(d, f"rank{r}"))]
+        if missing:
+            parts.append(f"ranks that never reached rendezvous: {missing}")
+        else:
+            parts.append(
+                "all ranks checked in — suspect coordinator service or "
+                "network between hosts, not a missing rank")
+    return "; ".join(parts)
+
+
+def _rendezvous_with_retry(init_fn, coordinator: str, num: int, pid: int,
+                           deadline: Optional[float] = None,
+                           backoff_base: Optional[float] = None,
+                           backoff_cap: float = 15.0,
+                           sleep=None) -> None:
+    """Run `init_fn(remaining_seconds)` (jax.distributed.initialize) with
+    exponential backoff + jitter under an overall PADDLE_RDV_DEADLINE.
+
+    Mirrors the reference's TCP comm-id exchange retry loop
+    (gen_comm_id_helper.cc retries connect with a bounded budget) — a
+    slow-to-start peer must not fail the job, but a truly absent one must
+    fail it LOUDLY with attribution instead of hanging forever."""
+    import os
+    import random
+    import sys
+    import time
+
+    def _envf(name, default):
+        raw = os.environ.get(name, "")
+        return float(raw) if raw.strip() else default
+
+    deadline = deadline if deadline is not None else _envf(
+        "PADDLE_RDV_DEADLINE", 300.0)
+    base = backoff_base if backoff_base is not None else _envf(
+        "PADDLE_RDV_BACKOFF", 1.0)
+    sleep = sleep or time.sleep
+    sync_dir = os.environ.get("PADDLE_COLL_SYNC_DIR")
+    if sync_dir:
+        # check in BEFORE attempting: peers diagnosing a failure see who
+        # ever made it this far (unreachable-rank attribution)
+        try:
+            d = os.path.join(sync_dir, "rdv")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, f"rank{pid}"), "w") as f:
+                f.write(str(time.time()))
+        except OSError:
+            pass
+    t_end = time.monotonic() + deadline
+    attempt = 0
+    while True:
+        remaining = t_end - time.monotonic()
+        try:
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"rendezvous deadline {deadline:g}s exhausted")
+            init_fn(remaining)
+            return
+        except Exception as e:
+            attempt += 1
+            delay = min(base * (2.0 ** (attempt - 1)), backoff_cap)
+            delay *= 0.5 + random.random()  # ±50% jitter: no stampedes
+            if remaining <= 0 or time.monotonic() + delay >= t_end:
+                raise RuntimeError(
+                    _rdv_diagnose(coordinator, num, pid)
+                    + f" (after {attempt} attempt(s), {deadline:g}s "
+                      f"deadline; last error: {e})"
+                ) from e
+            print(
+                f"paddle_tpu.rendezvous: attempt {attempt} failed ({e}); "
+                f"retrying in {delay:.1f}s", file=sys.stderr, flush=True)
+            sleep(delay)
+
+
 def init_parallel_env(backend: Optional[str] = None) -> "ParallelEnv":
     """Bootstrap distributed state (reference: parallel.py:57
     init_parallel_env → NCCLParallelContext::Init + TCP comm-id exchange).
 
     TPU-native: multi-host rendezvous is jax.distributed (coordinator env:
     COORDINATOR_ADDRESS / PADDLE_TRAINER_ENDPOINTS honored); the default
-    group spans every device in the job over axis 'dp'.
+    group spans every device in the job over axis 'dp'. The coordinator
+    connection retries with exponential backoff + jitter under an overall
+    PADDLE_RDV_DEADLINE and fails with unreachable-rank attribution
+    (:func:`_rendezvous_with_retry`).
     """
     import os
 
@@ -138,11 +239,30 @@ def init_parallel_env(backend: Optional[str] = None) -> "ParallelEnv":
                 "PADDLE_TRAINER_ENDPOINTS entries must be host:port, got "
                 f"{coordinator!r}"
             )
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
-        )
+        num = int(os.environ["PADDLE_TRAINERS_NUM"])
+        pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+        def _init(remaining):
+            try:
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=coordinator,
+                        num_processes=num, process_id=pid,
+                        initialization_timeout=max(int(remaining), 1),
+                    )
+                except TypeError:  # older jax: no initialization_timeout
+                    jax.distributed.initialize(
+                        coordinator_address=coordinator,
+                        num_processes=num, process_id=pid,
+                    )
+            except Exception:
+                try:  # leave no half-initialized client behind a retry
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                raise
+
+        _rendezvous_with_retry(_init, coordinator, num, pid)
         _jax_dist_initialized = True
     if _state.default_group is None:
         devs = jax.devices()

@@ -38,6 +38,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from . import comm_monitor  # stdlib-pure: safe for the launcher process
+
 __all__ = ["ElasticManager", "RankProc", "heartbeat",
            "install_preempt_notice", "restore_preempt_notice", "HUNG_RC"]
 
@@ -80,6 +82,12 @@ def install_preempt_notice(on_notice: Callable[[], None]):
         return None
 
     def _handler(signum, frame):
+        try:
+            # the preemption notice is one of the flight recorder's dump
+            # triggers: capture the collective stream before snapshotting
+            comm_monitor.dump_flight_recorder("sigterm")
+        except Exception:
+            pass
         on_notice()
 
     try:
@@ -96,14 +104,17 @@ def restore_preempt_notice(old) -> None:
 class RankProc:
     """One spawned rank (launch_utils.py TrainerProc analog)."""
 
-    __slots__ = ("proc", "rank", "hb_path", "log_path", "log_file")
+    __slots__ = ("proc", "rank", "hb_path", "log_path", "log_file",
+                 "ev_path")
 
-    def __init__(self, proc, rank, hb_path, log_path=None, log_file=None):
+    def __init__(self, proc, rank, hb_path, log_path=None, log_file=None,
+                 ev_path=None):
         self.proc = proc
         self.rank = rank
         self.hb_path = hb_path
         self.log_path = log_path
         self.log_file = log_file
+        self.ev_path = ev_path
 
 
 class ElasticManager:
@@ -123,7 +134,8 @@ class ElasticManager:
                  backoff_cap: float = 30.0,
                  restart_window: Optional[float] = None,
                  log_dir: Optional[str] = None,
-                 poll_interval: float = 0.1):
+                 poll_interval: float = 0.1,
+                 coll_timeout: Optional[float] = None):
         def _envf(name, default):
             raw = os.environ.get(name, "")
             return float(raw) if raw.strip() else default
@@ -144,6 +156,7 @@ class ElasticManager:
                                else _envf(_WINDOW_ENV, 3600.0))
         self.log_dir = log_dir or os.environ.get(_LOGDIR_ENV) or None
         self.poll_interval = poll_interval
+        self.coll_timeout = coll_timeout
         self._run_dir = None          # heartbeat-file home, made lazily
         self._procs: List[RankProc] = []
         self._restarts = deque()      # monotonic stamps of past relaunches
@@ -155,10 +168,22 @@ class ElasticManager:
             self._run_dir = tempfile.mkdtemp(prefix="pdtpu_elastic_")
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
+        # comm-monitor plumbing: a per-ATTEMPT sync dir (stale round files
+        # from a previous incarnation must not satisfy fresh barriers), a
+        # per-rank event file the kill attribution reads, and the dump
+        # destination next to the workerlogs
+        sync_dir = os.path.join(self._run_dir, f"collsync.{attempt}")
+        os.makedirs(sync_dir, exist_ok=True)
+        debug_dir = self.log_dir or self._run_dir
         self._procs = []
         for env in self.envs:
             env = dict(env)
             if self.backend:
+                # both spellings: JAX_PLATFORMS is the live knob, the
+                # legacy JAX_PLATFORM_NAME covers older jax — without the
+                # former, a grafted jax still probes the TPU plugin (30s+
+                # of metadata fetches) despite the cpu request
+                env["JAX_PLATFORMS"] = self.backend
                 env["JAX_PLATFORM_NAME"] = self.backend
             env["PADDLE_LAUNCH_ATTEMPT"] = str(attempt)
             rank = int(env.get("PADDLE_TRAINER_ID", "0"))
@@ -168,6 +193,14 @@ class ElasticManager:
             with open(hb, "a"):
                 pass
             os.utime(hb, None)
+            ev = os.path.join(self._run_dir, f"collev.{rank}")
+            with open(ev, "w"):
+                pass  # fresh per attempt: attribution reflects THIS run
+            env["PADDLE_COLL_EVENT_FILE"] = ev
+            env["PADDLE_COLL_SYNC_DIR"] = sync_dir
+            env.setdefault("PADDLE_COLL_DEBUG_DIR", debug_dir)
+            if self.coll_timeout is not None:
+                env["PADDLE_COLL_TIMEOUT"] = str(self.coll_timeout)
             log_path = log_file = None
             if self.log_dir:
                 log_path = os.path.join(self.log_dir, f"workerlog.{rank}")
@@ -177,7 +210,8 @@ class ElasticManager:
             p = subprocess.Popen(
                 [sys.executable, self.script] + self.script_args,
                 env=env, stdout=log_file, stderr=log_file)
-            self._procs.append(RankProc(p, rank, hb, log_path, log_file))
+            self._procs.append(RankProc(p, rank, hb, log_path, log_file,
+                                        ev_path=ev))
 
     # -- teardown ---------------------------------------------------------
     def _kill_rank(self, rp: RankProc, why: str) -> None:
@@ -222,6 +256,24 @@ class ElasticManager:
                 except OSError:
                     pass
 
+    # -- kill attribution (comm_monitor event reader) ---------------------
+    def _attribute(self, rp: RankProc, why: str) -> None:
+        """Name the collective behind a rank's death, when its monitor
+        managed to write an event line before the end — turns a generic
+        'hung rank' into 'stalled in all_reduce(seq 5, group 0, ...)'."""
+        if not rp.ev_path:
+            return
+        events = comm_monitor.read_events(rp.ev_path)
+        if not events:
+            return
+        ev = events[-1]
+        what = (ev.get("detail") or ev.get("describe")
+                or ev.get("event", "?"))
+        print(
+            f"paddle_tpu.elastic: rank {rp.rank} {why} attributed to "
+            f"{ev.get('event', '?')}: {what}",
+            file=sys.stderr, flush=True)
+
     # -- the watch loop (launch_utils.py:996-1118) ------------------------
     def _watch(self) -> int:
         rc = 0
@@ -233,6 +285,7 @@ class ElasticManager:
                     alive.append(rp)
                 elif code != 0 and rc == 0:
                     rc = code  # first failure wins; tear the job down
+                    self._attribute(rp, f"failure (rc={code})")
             if rc != 0 or not alive:
                 break
             if self._preempted:
@@ -251,6 +304,9 @@ class ElasticManager:
                         self._kill_rank(
                             rp, f"rank {rp.rank} heartbeat stale "
                                 f"{age:.1f}s > {self.watchdog_timeout}s")
+                        # a rank wedged in a collective stops heartbeating
+                        # too: its monitor's event line says WHERE
+                        self._attribute(rp, "watchdog kill")
                         rc = HUNG_RC
                         break
                 if rc != 0:

@@ -63,7 +63,7 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
            ips: str = "127.0.0.1", start_port: int = 6170,
            backend: str = None, node_rank: int = None,
            elastic_retries: int = 0, watchdog_timeout: float = None,
-           log_dir: str = None) -> int:
+           log_dir: str = None, coll_timeout: float = None) -> int:
     """Spawn THIS node's ranks and babysit them (launch_collective :208).
 
     `node_rank` selects which host of `ips` this invocation is (default
@@ -87,6 +87,14 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
       `workerlog.N` (launch_utils.py behavior).
     - SIGTERM to the launcher is forwarded to every rank (the
       preemption notice); no relaunch follows.
+    - `coll_timeout` (or PADDLE_COLL_TIMEOUT in the ranks' env) arms the
+      per-collective watchdog (distributed/comm_monitor.py): a rank
+      wedged in a collective dumps its flight recorder, writes a
+      machine-readable event, and exits; the manager's relaunch log
+      attributes the kill to the named collective instead of a generic
+      hang. The manager always exports PADDLE_COLL_EVENT_FILE,
+      PADDLE_COLL_SYNC_DIR (monitored_barrier / desync exchange), and
+      PADDLE_COLL_DEBUG_DIR (dumps land next to the workerlogs).
     """
     if node_rank is None:
         node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
@@ -102,6 +110,7 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
         script, list(script_args), envs, backend=backend,
         max_restarts=int(elastic_retries),
         watchdog_timeout=watchdog_timeout, log_dir=log_dir,
+        coll_timeout=coll_timeout,
     )
     return mgr.run()
 
@@ -132,6 +141,12 @@ def main(argv=None):
                         help="capture each rank's output to "
                              "<log_dir>/workerlog.N (default: "
                              "$PADDLE_LOG_DIR, unset = inherit stdio)")
+    parser.add_argument("--coll_timeout", type=float, default=None,
+                        help="per-collective deadline in seconds for the "
+                             "ranks' comm monitor (default: children's "
+                             "$PADDLE_COLL_TIMEOUT, 0 = off); a stalled "
+                             "collective dumps the flight recorder and "
+                             "recycles the rank with attribution")
     parser.add_argument("script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -140,6 +155,7 @@ def main(argv=None):
         ips=args.ips, start_port=args.start_port, backend=args.backend,
         node_rank=args.node_rank, elastic_retries=args.elastic_retries,
         watchdog_timeout=args.watchdog_timeout, log_dir=args.log_dir,
+        coll_timeout=args.coll_timeout,
     )
     sys.exit(rc)
 

@@ -290,6 +290,16 @@ class TerminateOnPreempt(Callback):
             if self.verbose:
                 print(f"TerminateOnPreempt: SIGTERM received — saved "
                       f"{path}, stopping after epoch {epoch}")
+        if self.verbose:
+            # surface the comm-monitor flight recorder (already dumped by
+            # the chained notice handler) so the operator reading the
+            # hapi log finds the collective stream next to the workerlog
+            from ..distributed import comm_monitor
+
+            dump = comm_monitor.dump_flight_recorder("preempt")
+            if dump:
+                print(f"TerminateOnPreempt: collective flight recorder "
+                      f"at {dump}")
 
     def on_train_end(self, logs=None):
         from ..distributed.elastic import restore_preempt_notice

@@ -585,7 +585,10 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
                       name=None):
     """operators/detection/density_prior_box_op.h: per cell, for each
     (density, fixed_size) pair, a density x density grid of shifted
-    boxes per fixed ratio."""
+    boxes per fixed ratio. The grid is spaced/centered by
+    `step_average = int((step_w + step_h) * 0.5)` (the CELL extent, ref
+    :69,91-101), not by the fixed_size — they differ whenever the prior
+    size is not the cell size, which is the common case."""
     import numpy as np
 
     inp = _t(input)
@@ -594,6 +597,7 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
     IH, IW = int(img._data.shape[2]), int(img._data.shape[3])
     step_w = steps[0] or IW / W
     step_h = steps[1] or IH / H
+    step_average = int((step_w + step_h) * 0.5)
 
     boxes = []
     for h in range(H):
@@ -604,11 +608,13 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
                 for ar in fixed_ratios:
                     bw = fs * np.sqrt(ar)
                     bh = fs / np.sqrt(ar)
-                    shift = int(fs / density)
+                    shift = step_average // density
                     for di in range(density):
                         for dj in range(density):
-                            ccx = cx - fs / 2.0 + shift / 2.0 + dj * shift
-                            ccy = cy - fs / 2.0 + shift / 2.0 + di * shift
+                            ccx = (cx - step_average / 2.0
+                                   + shift / 2.0 + dj * shift)
+                            ccy = (cy - step_average / 2.0
+                                   + shift / 2.0 + di * shift)
                             boxes.append([
                                 (ccx - bw / 2.0) / IW,
                                 (ccy - bh / 2.0) / IH,

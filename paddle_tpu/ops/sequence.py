@@ -28,10 +28,20 @@ __all__ = [
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     """[N] lengths -> [N, maxlen] 0/1 mask (sequence_mask_op.cc parity).
-    `maxlen` must be static (None -> needs concrete lengths; prefer
-    passing maxlen under jit)."""
+    `maxlen` must be static: None derives it from concrete lengths with a
+    host sync (`device_get(lengths).max()`), which is both a hidden
+    round-trip on a hot path and impossible under a trace — so under
+    jit/vmap/grad it raises loudly instead of silently syncing (the
+    dense+lengths LoD policy: ragged extents are explicit)."""
     x = as_tensor(x)
     if maxlen is None:
+        if isinstance(x._data, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) needs concrete lengths to "
+                "derive the mask width, but `x` is a tracer (inside "
+                "jit/vmap/grad). Pass maxlen explicitly — the output "
+                "shape must be static under XLA."
+            )
         import numpy as np
 
         maxlen = int(np.asarray(jax.device_get(x._data)).max())

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["get_include", "get_lib"]
+__all__ = ["get_include", "get_lib", "native_available"]
 
 _ROOT = os.path.dirname(os.path.abspath(__file__))
 
@@ -19,3 +19,13 @@ def get_lib() -> str:
     """Directory holding the compiled native library (built lazily by
     paddle_tpu.native on first use)."""
     return os.path.join(_ROOT, "native")
+
+
+def native_available() -> bool:
+    """Whether the C++ host-staging library is loadable (builds it on
+    first call when a toolchain exists). False means every staging
+    consumer is on the numpy fallback path — CI surfaces this instead of
+    silently skipping the native tests (VERDICT r5 next #10)."""
+    from . import native
+
+    return native.available()

@@ -8,12 +8,17 @@
   ``io.save`` (before a framework.io.save write), ``io.save.post``
   (after the atomic replace — where ``corrupt`` bites), ``io.load``,
   ``acp.save`` (before an auto-checkpoint snapshot), ``epoch`` (on
-  entering each TrainEpochRange epoch).
+  entering each TrainEpochRange epoch), ``coll`` (inside each eager
+  collective's monitored region, distributed/comm_monitor.py — the
+  collective timeout watchdog's prey).
 - ``action`` one of ``fail`` (raise InjectedFault, an IOError),
   ``hang`` (sleep ``arg`` seconds, default 3600 — the watchdog's prey),
-  ``kill`` (``os._exit(arg)``, default 17 — a hard preemption), or
+  ``kill`` (``os._exit(arg)``, default 17 — a hard preemption),
   ``corrupt`` (truncate the file the site passed via ``path=`` to half
-  its bytes — a torn write).
+  its bytes — a torn write), or ``desync`` (``coll`` only: arm a flag
+  the comm monitor consumes to mutate this rank's op fingerprint, as if
+  it had issued a DIFFERENT collective; ``arg`` selects the rank the
+  rule fires on, default 0, so one job-wide spec desyncs one rank).
 - ``nth``    1-based per-process call count at which the rule fires
   (each call to a site increments that site's counter), so a relaunched
   attempt that resumes later in training naturally skips the fault.
@@ -33,10 +38,13 @@ import sys
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["InjectedFault", "FaultInjector", "fault_point", "reset"]
+__all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
+           "reset"]
 
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
-_ACTIONS = ("fail", "hang", "kill", "corrupt")
+_ACTIONS = ("fail", "hang", "kill", "corrupt", "desync")
+# desync only makes sense where a fingerprint is being recorded
+_DESYNC_SITES = ("coll",)
 # sites that pass a file path to fault_point (the only places a corrupt
 # rule can bite) — a corrupt rule elsewhere would be a silent no-op, so
 # the parser rejects it loudly instead
@@ -65,6 +73,7 @@ class FaultInjector:
         self.spec = spec
         self._rules: List[_Rule] = []
         self._counts: Dict[str, int] = {}
+        self.flags: set = set()  # armed markers (e.g. "desync")
         for item in filter(None, (s.strip() for s in spec.split(","))):
             parts = item.split(":")
             if len(parts) < 3:
@@ -84,6 +93,11 @@ class FaultInjector:
                         f"corrupt rule targets un-instrumented site "
                         f"{site!r} (path-carrying sites: {_CORRUPT_SITES})"
                     )
+            if action == "desync" and site not in _DESYNC_SITES:
+                raise ValueError(
+                    f"desync rule targets un-instrumented site {site!r} "
+                    f"(fingerprint-recording sites: {_DESYNC_SITES})"
+                )
             arg = parts[3] if len(parts) > 3 else None
             self._rules.append(_Rule(site, action, nth, arg))
 
@@ -111,6 +125,14 @@ class FaultInjector:
             while time.monotonic() < deadline:
                 time.sleep(min(1.0, deadline - time.monotonic() + 0.01))
             return
+        if r.action == "desync":
+            target = int(r.arg) if r.arg else 0
+            if int(os.environ.get("PADDLE_TRAINER_ID", "0")) != target:
+                return  # the rule desyncs exactly one rank of the job
+            print(f"fault_injection: arming desync at {tag}",
+                  file=sys.stderr, flush=True)
+            self.flags.add("desync")
+            return
         if r.action == "corrupt":
             if path is None:
                 return  # site carries no file — nothing to corrupt
@@ -136,6 +158,16 @@ def _injector() -> FaultInjector:
 def fault_point(site: str, path: Optional[str] = None) -> None:
     """Instrumentation hook: no-op unless a spec rule matches this hit."""
     _injector().fire(site, path)
+
+
+def consume_flag(flag: str) -> bool:
+    """One-shot read of a marker an action armed (e.g. ``desync``): True
+    exactly once after the rule fires, then cleared."""
+    inj = _active
+    if inj is not None and flag in inj.flags:
+        inj.flags.discard(flag)
+        return True
+    return False
 
 
 def reset() -> None:

@@ -532,7 +532,14 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         xs = x1[:, None] + rw[:, None] / out_w * gx[None, :]  # [R, ow*sr]
 
         def bilinear(r_feat, yy, xx):
-            # r_feat [C, H, W]; yy [oh*sr], xx [ow*sr]
+            # r_feat [C, H, W]; yy [oh*sr], xx [ow*sr]. Samples outside
+            # the [-1, H] / [-1, W] window contribute exactly ZERO (the
+            # reference kernel's `y < -1.0 || y > height -> return 0`),
+            # not a border-clamped replica; inside the window the
+            # coordinates clamp to the border like the reference's
+            # `if (y <= 0) y = 0` + high-edge snap.
+            vy = (yy >= -1.0) & (yy <= H)
+            vx = (xx >= -1.0) & (xx <= W)
             y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
             x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
             y1_ = jnp.clip(y0 + 1, 0, H - 1)
@@ -547,8 +554,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             f11 = r_feat[:, y1i][:, :, x1i]
             wy1 = wy1[None, :, None]
             wx1 = wx1[None, None, :]
-            return (f00 * (1 - wy1) * (1 - wx1) + f01 * (1 - wy1) * wx1
-                    + f10 * wy1 * (1 - wx1) + f11 * wy1 * wx1)
+            out = (f00 * (1 - wy1) * (1 - wx1) + f01 * (1 - wy1) * wx1
+                   + f10 * wy1 * (1 - wx1) + f11 * wy1 * wx1)
+            return out * (vy[None, :, None] & vx[None, None, :])
 
         roi_feats = feat[img_of_roi]                   # [R, C, H, W]
         sampled = jax.vmap(bilinear)(roi_feats, ys, xs)

@@ -1,0 +1,38 @@
+"""Jax child for the slow monitored-collectives E2E: a few eager
+all_reduces under the comm monitor, then a monitored barrier.
+
+With PADDLE_FAULT_SPEC="coll:hang:3:3600" and PADDLE_COLL_TIMEOUT set,
+attempt 0 wedges inside its 3rd collective; the monitor dumps the flight
+recorder, writes the event line, and aborts with COLL_TIMEOUT_RC so the
+elastic launcher can attribute the kill and relaunch. Attempt >= 1 drops
+the fault spec (the injected hang belongs to attempt 0) and completes.
+"""
+import json
+import os
+
+if int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0")) >= 1:
+    os.environ.pop("PADDLE_FAULT_SPEC", None)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed.elastic import heartbeat  # noqa: E402
+
+dist.init_parallel_env()
+n = dist.ParallelEnv().world_size
+x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+for i in range(4):
+    t = paddle.to_tensor(x)
+    dist.all_reduce(t)
+    heartbeat()
+dist.monitored_barrier()
+
+out = os.environ.get("COLL_TRAIN_LOG")
+if out:
+    with open(out, "a") as f:
+        f.write(json.dumps({
+            "attempt": int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0")),
+            "sum0": float(np.asarray(t.numpy())[0, 0]),
+        }) + "\n")
+print("coll_train done", flush=True)

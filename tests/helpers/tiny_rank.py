@@ -3,14 +3,23 @@ NOT import jax/paddle_tpu, so watchdog/budget/propagation tests measure
 the launcher, not interpreter startup.
 
 Modes (env TINY_MODE):
-  ok      heartbeat once, exit 0
-  hang    attempt 0: heartbeat once then sleep forever (a hung rank —
-          watchdog prey); attempt >= 1: exit 0
-  exit    exit TINY_EXIT_CODE (default 3) immediately; appends a line to
-          TINY_COUNT_FILE first so the test can count spawns
-  notice  heartbeat in a loop; on SIGTERM write TINY_NOTICE_FILE and
-          exit 143 (the preemption-notice acknowledgement)
+  ok        heartbeat once, exit 0
+  hang      attempt 0: heartbeat once then sleep forever (a hung rank —
+            watchdog prey); attempt >= 1: exit 0
+  exit      exit TINY_EXIT_CODE (default 3) immediately; appends a line to
+            TINY_COUNT_FILE first so the test can count spawns
+  notice    heartbeat in a loop; on SIGTERM write TINY_NOTICE_FILE and
+            exit 143 (the preemption-notice acknowledgement)
+  collstall attempt 0: wedge inside a monitored collective (the REAL
+            comm_monitor, loaded standalone — no jax) so its watchdog
+            dumps the flight recorder, writes the event line, and aborts
+            with COLL_TIMEOUT_RC; attempt >= 1: exit 0
+  collrun   run a few monitored collectives + a monitored-barrier
+            rendezvous across the job's ranks; exits 31 on a
+            desync/timeout diagnostic (armed via PADDLE_FAULT_SPEC
+            coll:* rules), 0 on a clean pass
 """
+import importlib.util
 import os
 import signal
 import sys
@@ -19,6 +28,19 @@ import time
 mode = os.environ.get("TINY_MODE", "ok")
 attempt = int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0"))
 hb = os.environ.get("PADDLE_HEARTBEAT_FILE")
+
+
+def _load_standalone(modname, relpath):
+    """Load a stdlib-pure paddle_tpu module WITHOUT importing the package
+    (which would pull jax — these tests time the launcher, not imports)."""
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        modname, os.path.join(repo, *relpath))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod  # comm_monitor finds fault_injection here
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def beat():
@@ -40,6 +62,36 @@ elif mode == "exit":
         with open(count_file, "a") as f:
             f.write(f"attempt={attempt}\n")
     sys.exit(int(os.environ.get("TINY_EXIT_CODE", "3")))
+elif mode == "collstall":
+    cm = _load_standalone(
+        "comm_monitor", ("paddle_tpu", "distributed", "comm_monitor.py"))
+    beat()
+    if attempt >= 1:
+        sys.exit(0)
+    mon = cm.CommMonitor(
+        timeout=float(os.environ.get("TINY_COLL_TIMEOUT", "0.5")))
+    with mon.watch("all_reduce", 0, "dp", 8, (8, 4), "float32"):
+        time.sleep(3600)  # wedged in the collective; the monitor aborts
+    sys.exit(0)
+elif mode == "collrun":
+    _load_standalone(
+        "fault_injection", ("paddle_tpu", "utils", "fault_injection.py"))
+    cm = _load_standalone(
+        "comm_monitor", ("paddle_tpu", "distributed", "comm_monitor.py"))
+    beat()
+    mon = cm.CommMonitor()
+    world = mon.world
+    try:
+        for _ in range(3):
+            with mon.watch("all_reduce", 0, "dp", world, (8, 4),
+                           "float32"):
+                pass
+        mon.barrier_rendezvous(
+            timeout=float(os.environ.get("TINY_COLL_TIMEOUT", "20")))
+    except (cm.CollectiveDesyncError, cm.CollectiveTimeoutError) as e:
+        print(f"collrun diagnostic: {e}", file=sys.stderr, flush=True)
+        sys.exit(31)
+    sys.exit(0)
 elif mode == "notice":
     flag = os.environ["TINY_NOTICE_FILE"]
 

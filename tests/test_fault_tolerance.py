@@ -46,19 +46,26 @@ def _free_port():
 
 @pytest.fixture
 def scoped_env(monkeypatch):
-    """Blank out fault/elastic knobs that could leak between tests and
-    re-arm the in-process injector on exit."""
+    """Blank out fault/elastic/comm-monitor knobs that could leak between
+    tests and re-arm the in-process injector + monitor on exit."""
+    from paddle_tpu.distributed import comm_monitor
     from paddle_tpu.utils import fault_injection
 
     for k in ("PADDLE_FAULT_SPEC", "PADDLE_WATCHDOG_TIMEOUT",
               "PADDLE_WATCHDOG_GRACE", "PADDLE_ELASTIC_BACKOFF",
               "PADDLE_ELASTIC_WINDOW", "PADDLE_LOG_DIR",
               "PADDLE_HEARTBEAT_FILE", "PADDLE_TRAINER_ID",
-              "PADDLE_CHECKPOINT_KEEP"):
+              "PADDLE_CHECKPOINT_KEEP", "PADDLE_COLL_TIMEOUT",
+              "PADDLE_COLL_TIMEOUT_ACTION", "PADDLE_COLL_DEBUG_DIR",
+              "PADDLE_COLL_EVENT_FILE", "PADDLE_COLL_SYNC_DIR",
+              "PADDLE_COLL_DESYNC_INTERVAL", "PADDLE_COLL_RECORDER_SIZE",
+              "PADDLE_RDV_DEADLINE", "PADDLE_RDV_BACKOFF"):
         monkeypatch.delenv(k, raising=False)
     fault_injection.reset()
+    comm_monitor.reset()
     yield monkeypatch
     fault_injection.reset()
+    comm_monitor.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -405,6 +412,381 @@ class TestElasticRuntime:
 
 
 # ---------------------------------------------------------------------------
+# monitored collectives: flight recorder, timeout watchdog, desync
+# (distributed/comm_monitor.py — the ISSUE 2 tentpole matrix)
+# ---------------------------------------------------------------------------
+
+
+class TestCommMonitor:
+    """In-process: the monitor machinery itself, on the 8-device mesh."""
+
+    def _dist(self):
+        import paddle_tpu.distributed as dist
+
+        dist.init_parallel_env()
+        return dist
+
+    def test_coll_hang_detected_within_timeout_dump_names_op(
+            self, tmp_path, scoped_env):
+        """Acceptance pin: an injected collective hang is detected within
+        PADDLE_COLL_TIMEOUT and the flight-recorder dump names the op,
+        group, and stalled rank."""
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import comm_monitor
+        from paddle_tpu.utils import fault_injection
+
+        dist = self._dist()
+        scoped_env.setenv("PADDLE_COLL_DEBUG_DIR", str(tmp_path))
+        scoped_env.setenv("PADDLE_COLL_EVENT_FILE", str(tmp_path / "ev"))
+        scoped_env.setenv("PADDLE_COLL_TIMEOUT", "0.5")
+        scoped_env.setenv("PADDLE_COLL_TIMEOUT_ACTION", "dump")
+        # hang on the SECOND collective: the first warms the XLA program
+        # cache so compile time stays out of the timed window
+        scoped_env.setenv("PADDLE_FAULT_SPEC", "coll:hang:2:2")
+        fault_injection.reset()
+        comm_monitor.reset()
+        x = np.random.rand(8, 3).astype(np.float32)
+        dist.all_reduce(paddle.to_tensor(x))           # warmup (hit 1)
+        t0 = time.time()
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t)                             # hit 2: hangs 2s
+        np.testing.assert_allclose(                    # result still right
+            t.numpy(), np.broadcast_to(x.sum(0, keepdims=True), x.shape),
+            rtol=1e-6)
+
+        dump = json.load(open(tmp_path / "comm_dump.rank0.json"))
+        assert dump["reason"] == "timeout"
+        last = dump["records"][-1]
+        assert last["op"] == "all_reduce"
+        assert last["group"] == 0
+        assert last["rank"] == 0            # the stalled rank, by name
+        assert last["status"] == "timeout"
+        assert last["shape"] == [8, 3]
+        events = comm_monitor.read_events(str(tmp_path / "ev"))
+        assert events and events[-1]["event"] == "coll_timeout"
+        # detected DURING the 2s hang (timer fired at ~0.5s), not after
+        assert events[-1]["time"] - t0 < 1.8
+
+    def test_coll_fail_raises_and_marks_record_failed(self, scoped_env):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import comm_monitor
+        from paddle_tpu.utils.fault_injection import InjectedFault, reset
+
+        dist = self._dist()
+        scoped_env.setenv("PADDLE_FAULT_SPEC", "coll:fail:1")
+        reset()
+        comm_monitor.reset()
+        with pytest.raises(InjectedFault):
+            dist.all_reduce(paddle.to_tensor(
+                np.zeros((8, 2), np.float32)))
+        recs = comm_monitor.monitor().snapshot()
+        assert recs[-1]["status"] == "failed"
+        assert recs[-1]["op"] == "all_reduce"
+
+    def test_seq_numbers_increment_per_group(self, scoped_env):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import comm_monitor
+
+        dist = self._dist()
+        comm_monitor.reset()
+        for _ in range(3):
+            dist.all_reduce(paddle.to_tensor(np.zeros((8, 2), np.float32)))
+        recs = comm_monitor.monitor().snapshot()
+        assert [r["seq"] for r in recs[-3:]] == \
+               [recs[-3]["seq"], recs[-3]["seq"] + 1, recs[-3]["seq"] + 2]
+
+    def test_ring_buffer_is_bounded(self):
+        from paddle_tpu.distributed.comm_monitor import CommMonitor
+
+        mon = CommMonitor(rank=0, world=1, timeout=0, recorder_size=16)
+        for _ in range(40):
+            mon.record("all_reduce", 0, "dp", 8, (4,), "float32")
+        recs = mon.snapshot()
+        assert len(recs) == 16
+        assert recs[-1]["seq"] == 40       # newest kept, oldest dropped
+        assert recs[0]["seq"] == 25
+
+    def test_monitored_barrier_single_process_passes(self, scoped_env):
+        dist = self._dist()
+        dist.monitored_barrier(timeout=30)  # world=1: no exchange needed
+
+    def test_monitored_barrier_subgroup_skips_process_rendezvous(
+            self, tmp_path, scoped_env):
+        """A device-subgroup barrier must not wait on trainer PROCESSES
+        that never joined it: with world=2 armed in the env (and no peer
+        process running), a non-default-group monitored_barrier still
+        completes — only the job-wide group runs the phase-1 exchange."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import comm_monitor
+
+        dist.init_parallel_env()
+        scoped_env.setenv("PADDLE_TRAINERS_NUM", "2")
+        scoped_env.setenv("PADDLE_COLL_SYNC_DIR", str(tmp_path))
+        comm_monitor.reset()
+        g = dist.new_group(list(range(4)))
+        t0 = time.monotonic()
+        dist.monitored_barrier(group=g, timeout=5)   # must not block 5s
+        assert time.monotonic() - t0 < 3
+
+    def test_monitored_barrier_names_missing_ranks(self, tmp_path):
+        from paddle_tpu.distributed.comm_monitor import (
+            CollectiveTimeoutError, CommMonitor,
+        )
+
+        mon = CommMonitor(rank=0, world=3, sync_dir=str(tmp_path),
+                          timeout=0)
+        with pytest.raises(CollectiveTimeoutError,
+                           match=r"missing ranks \[1, 2\]"):
+            mon.barrier_rendezvous(timeout=0.3)
+
+    def test_desync_names_both_call_sites(self, tmp_path):
+        """Acceptance pin: a desync raises a diagnostic naming the two
+        mismatched call sites instead of deadlocking."""
+        import threading
+
+        from paddle_tpu.distributed.comm_monitor import (
+            CollectiveDesyncError, CommMonitor,
+        )
+
+        m0 = CommMonitor(rank=0, world=2, sync_dir=str(tmp_path),
+                         timeout=0)
+        m1 = CommMonitor(rank=1, world=2, sync_dir=str(tmp_path),
+                         timeout=0)
+        m0.record("all_reduce", 0, "dp", 2, (8, 3), "float32")  # site A
+        m1.record("broadcast", 0, "dp", 2, (8, 3), "float32")   # site B
+        errs = {}
+
+        def go(m, key):
+            try:
+                m.check_desync(timeout=10)
+            except Exception as e:      # noqa: BLE001 — recorded for asserts
+                errs[key] = e
+
+        ts = [threading.Thread(target=go, args=(m, k))
+              for k, m in ((0, m0), (1, m1))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert isinstance(errs.get(0), CollectiveDesyncError)
+        assert isinstance(errs.get(1), CollectiveDesyncError)
+        msg = str(errs[0])
+        # names both ops AND both call sites (this file, two lines)
+        assert "all_reduce" in msg and "broadcast" in msg
+        assert msg.count("test_fault_tolerance.py") == 2
+
+    def test_desync_interval_checks_every_kth_collective(self, tmp_path):
+        """PADDLE_COLL_DESYNC_INTERVAL=K wires the exchange into every
+        K-th record, not just barriers: two lockstep ranks pass, and a
+        diverged op stream is caught at the next interval boundary."""
+        import threading
+
+        from paddle_tpu.distributed.comm_monitor import (
+            CollectiveDesyncError, CommMonitor,
+        )
+
+        mons = [CommMonitor(rank=r, world=2, sync_dir=str(tmp_path),
+                            timeout=5) for r in range(2)]
+        for m in mons:
+            m.desync_interval = 2
+        errs = {}
+
+        def go(m, ops):
+            try:
+                for op in ops:
+                    m.record(op, 0, "dp", 2, (4,), "float32")
+            except Exception as e:      # noqa: BLE001
+                errs[m.rank] = e
+
+        # round 1 (after 2 records): in sync; round 2 (after 4): diverged
+        ops0 = ["all_reduce", "broadcast", "all_gather", "all_reduce"]
+        ops1 = ["all_reduce", "broadcast", "all_gather", "barrier"]
+        ts = [threading.Thread(target=go, args=(mons[0], ops0)),
+              threading.Thread(target=go, args=(mons[1], ops1))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert isinstance(errs.get(0), CollectiveDesyncError)
+        assert isinstance(errs.get(1), CollectiveDesyncError)
+        assert "all_reduce" in str(errs[0]) and "barrier" in str(errs[0])
+
+    def test_desync_injection_mutates_fingerprint(self, tmp_path,
+                                                  scoped_env):
+        """coll:desync arms a flag the monitor consumes: the rank's
+        fingerprint mutates as if it issued a different collective."""
+        from paddle_tpu.distributed.comm_monitor import CommMonitor
+        from paddle_tpu.utils import fault_injection
+
+        scoped_env.setenv("PADDLE_FAULT_SPEC", "coll:desync:1:0")
+        fault_injection.reset()
+        mon = CommMonitor(rank=0, world=1, timeout=0)
+        with mon.watch("all_reduce", 0, "dp", 8, (4,), "float32"):
+            pass
+        assert mon.snapshot()[-1]["op"] == "all_reduce[desync-injected]"
+        # one-shot: the next collective is clean again
+        with mon.watch("all_reduce", 0, "dp", 8, (4,), "float32"):
+            pass
+        assert mon.snapshot()[-1]["op"] == "all_reduce"
+
+    def test_desync_rule_rejected_off_coll_site(self):
+        from paddle_tpu.utils.fault_injection import FaultInjector
+
+        with pytest.raises(ValueError, match="un-instrumented"):
+            FaultInjector("io.save:desync:1")
+
+    def test_sigterm_notice_dumps_flight_recorder(self, tmp_path,
+                                                  scoped_env):
+        """SIGTERM (the preemption notice) is a dump trigger: the
+        install_preempt_notice chain writes the recorder before the
+        trainer's own notice logic runs."""
+        from paddle_tpu.distributed import comm_monitor
+        from paddle_tpu.distributed.elastic import (
+            install_preempt_notice, restore_preempt_notice,
+        )
+
+        scoped_env.setenv("PADDLE_COLL_DEBUG_DIR", str(tmp_path))
+        comm_monitor.reset()
+        comm_monitor.monitor().record("all_reduce", 0, "dp", 8,
+                                      (2, 2), "float32")
+        noticed = []
+        old = install_preempt_notice(lambda: noticed.append(1))
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+        finally:
+            restore_preempt_notice(old)
+        assert noticed == [1]
+        dump = json.load(open(tmp_path / "comm_dump.rank0.json"))
+        assert dump["reason"] == "sigterm"
+        assert dump["records"][-1]["op"] == "all_reduce"
+
+
+class TestRendezvousRetry:
+    """Bootstrap hardening: retry with backoff + deadline + attribution
+    (comm._rendezvous_with_retry, unit-tested against stub init fns)."""
+
+    def test_flaky_coordinator_eventually_succeeds(self):
+        from paddle_tpu.distributed.comm import _rendezvous_with_retry
+
+        calls = []
+
+        def flaky(remaining):
+            calls.append(remaining)
+            if len(calls) < 3:
+                raise ConnectionError("coordinator not up yet")
+
+        naps = []
+        _rendezvous_with_retry(flaky, "127.0.0.1:1", 2, 1, deadline=60,
+                               backoff_base=0.25, sleep=naps.append)
+        assert len(calls) == 3
+        assert len(naps) == 2
+        # exponential with ±50% jitter: nominal 0.25 then 0.5
+        assert 0.125 <= naps[0] <= 0.375
+        assert 0.25 <= naps[1] <= 0.75
+        # remaining budget passed through shrinks monotonically... the
+        # stub sleep doesn't advance time, but the deadline plumb is live
+        assert all(r <= 60 for r in calls)
+
+    def test_deadline_failure_names_unreached_ranks(self, tmp_path,
+                                                    scoped_env):
+        from paddle_tpu.distributed.comm import _rendezvous_with_retry
+
+        scoped_env.setenv("PADDLE_COLL_SYNC_DIR", str(tmp_path))
+
+        def always(remaining):
+            raise ConnectionError("refused")
+
+        with pytest.raises(RuntimeError) as ei:
+            _rendezvous_with_retry(
+                always, "127.0.0.1:9", 4, 0, deadline=0.2,
+                backoff_base=0.05,
+                sleep=lambda s: time.sleep(min(s, 0.02)))
+        msg = str(ei.value)
+        # rank 0 (us) checked in; 1-3 never reached rendezvous
+        assert "ranks that never reached rendezvous: [1, 2, 3]" in msg
+        assert "UNREACHABLE" in msg or "reachable" in msg
+        assert "refused" in msg
+
+    def test_all_checked_in_blames_network_not_ranks(self, tmp_path,
+                                                     scoped_env):
+        from paddle_tpu.distributed.comm import _rendezvous_with_retry
+
+        scoped_env.setenv("PADDLE_COLL_SYNC_DIR", str(tmp_path))
+        d = tmp_path / "rdv"
+        d.mkdir()
+        for r in range(2):
+            (d / f"rank{r}").write_text("1.0")
+
+        with pytest.raises(RuntimeError, match="all ranks checked in"):
+            _rendezvous_with_retry(
+                lambda remaining: (_ for _ in ()).throw(OSError("down")),
+                "127.0.0.1:9", 2, 0, deadline=0.05, backoff_base=0.01,
+                sleep=lambda s: None)
+
+
+class TestCommElastic:
+    """Fast subprocess matrix: the REAL monitor (loaded jax-free inside
+    tiny_rank.py) under the real ElasticManager — kill attribution,
+    relaunch, and the desync exit path end to end."""
+
+    def test_stalled_collective_attributed_and_relaunched(
+            self, tmp_path, scoped_env, capfd):
+        """Acceptance pin: the elastic relaunch log attributes the kill
+        to the named collective (not a generic hang), and the dump lands
+        next to the workerlogs."""
+        from paddle_tpu.distributed.comm_monitor import COLL_TIMEOUT_RC
+        from paddle_tpu.distributed.launch import launch
+
+        logd = tmp_path / "logs"
+        scoped_env.setenv("TINY_MODE", "collstall")
+        scoped_env.setenv("PADDLE_ELASTIC_BACKOFF", "0.05")
+        t0 = time.monotonic()
+        rc = launch(TINY, [], nproc_per_node=1, start_port=_free_port(),
+                    elastic_retries=1, log_dir=str(logd))
+        assert rc == 0                    # attempt 1 completed clean
+        assert time.monotonic() - t0 < 30
+        err = capfd.readouterr().err
+        assert f"rc={COLL_TIMEOUT_RC}" in err
+        assert "attributed to coll_timeout" in err
+        assert "all_reduce(seq 1, group 0" in err   # named collective
+        dump = json.load(open(logd / "comm_dump.rank0.json"))
+        assert dump["reason"] == "timeout"
+        assert dump["records"][-1]["op"] == "all_reduce"
+        assert dump["records"][-1]["rank"] == 0
+
+    def test_collrun_clean_pass_two_ranks(self, scoped_env):
+        from paddle_tpu.distributed.launch import launch
+
+        scoped_env.setenv("TINY_MODE", "collrun")
+        rc = launch(TINY, [], nproc_per_node=2, start_port=_free_port())
+        assert rc == 0   # monitored barrier + desync exchange all green
+
+    def test_injected_desync_diagnosed_not_deadlocked(
+            self, tmp_path, scoped_env, capfd):
+        """Acceptance pin (E2E half): coll:desync on rank 1 makes both
+        ranks raise the two-call-site diagnostic and exit, the manager
+        attributes the failure — nobody deadlocks."""
+        from paddle_tpu.distributed.launch import launch
+
+        logd = tmp_path / "logs"
+        scoped_env.setenv("TINY_MODE", "collrun")
+        scoped_env.setenv("PADDLE_FAULT_SPEC", "coll:desync:2:1")
+        t0 = time.monotonic()
+        rc = launch(TINY, [], nproc_per_node=2, start_port=_free_port(),
+                    log_dir=str(logd))
+        assert rc == 31                   # the diagnostic exit, not 0/hang
+        assert time.monotonic() - t0 < 30
+        err = capfd.readouterr().err
+        assert "attributed to coll_desync" in err
+        for rank in (0, 1):
+            log = (logd / f"workerlog.{rank}").read_text()
+            assert "desync detected" in log
+            # both call sites named in the diagnostic
+            assert log.count("tiny_rank.py") >= 2
+            assert "all_reduce[desync-injected]" in log
+
+
+# ---------------------------------------------------------------------------
 # E2E matrix with jax children (slow: multi-process, interpreter-heavy)
 # ---------------------------------------------------------------------------
 
@@ -523,6 +905,50 @@ def test_sigterm_propagates_to_ranks(tmp_path):
             p.kill()
     assert notice.read_text().strip() == "preempted"
     assert rc == 143  # preemption is not a retryable failure
+
+
+@pytest.mark.slow
+def test_injected_coll_hang_full_matrix(tmp_path, capfd):
+    """Acceptance pin, full-jax E2E: PADDLE_FAULT_SPEC="coll:hang:..."
+    wedges a real eager all_reduce; the monitor detects it within
+    PADDLE_COLL_TIMEOUT, the dump (next to the workerlog) names the op,
+    group, and stalled rank, the elastic relaunch log attributes the
+    kill to that collective, and the relaunched attempt completes."""
+    from paddle_tpu.distributed.comm_monitor import COLL_TIMEOUT_RC
+    from paddle_tpu.distributed.launch import launch
+
+    logd = tmp_path / "logs"
+    out = tmp_path / "out.jsonl"
+    env2 = _clean_env()
+    env2["PADDLE_FAULT_SPEC"] = "coll:hang:3:3600"
+    env2["PADDLE_ELASTIC_BACKOFF"] = "0.05"
+    env2["COLL_TRAIN_LOG"] = str(out)
+    old = dict(os.environ)
+    os.environ.clear()
+    os.environ.update(env2)
+    t0 = time.monotonic()
+    try:
+        rc = launch(os.path.join(HELPERS, "coll_train.py"), [],
+                    nproc_per_node=1, start_port=_free_port(),
+                    backend="cpu", elastic_retries=1,
+                    log_dir=str(logd), coll_timeout=15.0)
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+    elapsed = time.monotonic() - t0
+    assert rc == 0                       # attempt 1 completed clean
+    assert elapsed < 180, f"hang not recycled in time: {elapsed:.0f}s"
+    err = capfd.readouterr().err
+    assert f"rc={COLL_TIMEOUT_RC}" in err
+    assert "attributed to coll_timeout" in err
+    assert "all_reduce" in err
+    dump = json.load(open(logd / "comm_dump.rank0.json"))
+    assert dump["reason"] == "timeout"
+    last = dump["records"][-1]
+    assert last["op"] == "all_reduce" and last["status"] == "timeout"
+    assert last["rank"] == 0 and last["group"] == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [r["attempt"] for r in rows] == [1]   # only attempt 1 finished
 
 
 @pytest.mark.slow

@@ -90,6 +90,51 @@ def test_roi_pool_constant_and_max():
     assert out.max() == 9.0
 
 
+def test_density_prior_box_step_average_reference():
+    """ADVICE r5 (medium): the density grid is spaced/centered by
+    step_average = int((step_w + step_h) * 0.5) — the CELL extent —
+    per density_prior_box_op.h:69,91-101, NOT by fixed_size. Numpy
+    reference below IS the reference kernel's loop."""
+    H = W = 2
+    IH = IW = 16
+    densities, fixed_sizes, fixed_ratios = [2, 1], [4.0, 6.0], [1.0, 2.0]
+    offset = 0.5
+    inp = paddle.to_tensor(np.zeros((1, 3, H, W), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, IH, IW), np.float32))
+    got, var = F.density_prior_box(
+        inp, img, densities=densities, fixed_sizes=fixed_sizes,
+        fixed_ratios=fixed_ratios, offset=offset)
+
+    step_w, step_h = IW / W, IH / H
+    step_average = int((step_w + step_h) * 0.5)
+    ref = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for density, fs in zip(densities, fixed_sizes):
+                for ar in fixed_ratios:
+                    bw, bh = fs * np.sqrt(ar), fs / np.sqrt(ar)
+                    shift = step_average // density
+                    for di in range(density):
+                        for dj in range(density):
+                            ccx = (cx - step_average / 2.0
+                                   + shift / 2.0 + dj * shift)
+                            ccy = (cy - step_average / 2.0
+                                   + shift / 2.0 + di * shift)
+                            ref.append([(ccx - bw / 2) / IW,
+                                        (ccy - bh / 2) / IH,
+                                        (ccx + bw / 2) / IW,
+                                        (ccy + bh / 2) / IH])
+    ref = np.asarray(ref, np.float32).reshape(got.numpy().shape)
+    np.testing.assert_allclose(got.numpy(), ref, rtol=1e-6, atol=1e-7)
+    # step_average (8) != fixed_size (4): the old fs-derived grid would
+    # place the density-2 boxes 2px apart; the reference spacing is 4px
+    assert step_average != fixed_sizes[0]
+    p0 = got.numpy()[0, 0]  # cell (0,0), density 2 grid of fixed_size 4
+    assert np.isclose((p0[1, 0] - p0[0, 0]) * IW, step_average // 2)
+
+
 def test_spectral_norm_unit_sigma():
     w = np.random.RandomState(1).rand(6, 4).astype(np.float32) * 3
     out = F.spectral_norm(paddle.to_tensor(w), power_iters=50).numpy()

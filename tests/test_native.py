@@ -1,16 +1,32 @@
-"""Native C++ staging library tests (SURVEY.md §2 native mandate)."""
+"""Native C++ staging library tests (SURVEY.md §2 native mandate).
+
+VERDICT r5 next #10: the suite states WHICH staging path (C++ vs numpy)
+it exercised instead of silently skipping. Every stack-function test
+below runs on whichever path is live — the functions fall back to numpy
+internally — and `test_report_staging_path` prints the verdict into the
+CI output; only the builds-and-loads test is inherently native-only.
+"""
 import numpy as np
 import pytest
 
-from paddle_tpu import native
+from paddle_tpu import native, sysconfig
+
+STAGING_PATH = "C++" if native.available() else "numpy-fallback"
 
 
-pytestmark = pytest.mark.skipif(
+def test_report_staging_path(capsys):
+    """Loud, greppable: which staging path did this CI run exercise?"""
+    assert sysconfig.native_available() == native.available()
+    with capsys.disabled():
+        print(f"\n[staging-path] native.available()={native.available()} "
+              f"-> the suite below exercised the {STAGING_PATH} path")
+
+
+@pytest.mark.skipif(
     not native.available(),
-    reason="no C++ toolchain: numpy fallback is exercised elsewhere",
+    reason="no C++ toolchain — numpy-fallback path in use "
+           "(reported by test_report_staging_path, not silently skipped)",
 )
-
-
 def test_library_builds_and_loads():
     assert native.lib() is not None
     assert native.lib().pt_version() == 1

@@ -131,23 +131,31 @@ class TestLaunch:
         assert rc == 3
 
     def test_bad_coordinator_raises(self, monkeypatch):
-        """init_parallel_env must NOT swallow bootstrap failures."""
+        """init_parallel_env must NOT swallow bootstrap failures — the
+        rendezvous RETRIES under PADDLE_RDV_DEADLINE (hardening, ISSUE 2)
+        and then fails loudly with the original error attributed."""
         import jax
 
-        calls = {}
+        calls = {"n": 0}
 
-        def fake_init(coordinator_address, num_processes, process_id):
+        def fake_init(coordinator_address, num_processes, process_id,
+                      **kw):
             calls["addr"] = coordinator_address
+            calls["n"] += 1
             raise RuntimeError("no route to coordinator")
 
         monkeypatch.setattr(jax.distributed, "initialize", fake_init)
         monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
         monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
                            "badhost:6170,other:6170")
+        # a test must not sit out the production 300s deadline
+        monkeypatch.setenv("PADDLE_RDV_DEADLINE", "0.3")
+        monkeypatch.setenv("PADDLE_RDV_BACKOFF", "0.05")
         monkeypatch.setattr(comm, "_jax_dist_initialized", False)
         with pytest.raises(RuntimeError, match="no route"):
             comm.init_parallel_env()
         assert calls["addr"] == "badhost:6170"
+        assert calls["n"] >= 2   # it retried before giving up
 
     def test_malformed_endpoint_raises(self, monkeypatch):
         monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
