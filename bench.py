@@ -198,12 +198,14 @@ def _bench_bert(steps=10, batch=32, seq=128):
     }
 
 
-def _gpt_medium():
+def _gpt_medium(use_flash=False):
     """GPT-medium-shaped causal decoder (the single-chip proxy for
     BASELINE config 5's GPT-3 1.3B, which needs the dp x pp x mp hybrid
     dryrun_multichip proves): 24 ParallelGPTBlock layers (trivial 1-chip
     mesh — same code path the hybrid shards), d_model 1024, 16 heads,
-    seq 1024, tied-free 32k vocab head."""
+    seq 1024, tied-free 32k vocab head. `use_flash` routes each block's
+    attention core through the Pallas flash kernel (weak #1 first step;
+    set PADDLE_BENCH_GPT_FLASH=1 to record the routed/unrouted pair)."""
     import paddle_tpu as paddle
     from paddle_tpu import nn
     from paddle_tpu.distributed import ParallelGPTBlock, comm
@@ -218,7 +220,8 @@ def _gpt_medium():
             self.embed = nn.Embedding(vocab, d)
             self.pos = nn.Embedding(seq, d)
             self.blocks = nn.LayerList([
-                ParallelGPTBlock(d, heads, dropout=0.0)
+                ParallelGPTBlock(d, heads, dropout=0.0,
+                                 use_flash_attention=use_flash)
                 for _ in range(layers)
             ])
             self.head = nn.Linear(d, vocab)
@@ -234,7 +237,7 @@ def _gpt_medium():
     return GPT()
 
 
-def _bench_gpt(steps=10, batch=4, seq=1024):
+def _bench_gpt(steps=10, batch=4, seq=1024, use_flash=False):
     """Causal-LM training step: next-token CE over the full sequence."""
     import jax
     import jax.numpy as jnp
@@ -249,7 +252,7 @@ def _bench_gpt(steps=10, batch=4, seq=1024):
     strategy = DistributedStrategy()
     strategy.amp = True
     fleet.init(is_collective=True, strategy=strategy)
-    model = _gpt_medium()
+    model = _gpt_medium(use_flash=use_flash)
     opt = fleet.distributed_optimizer(
         optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
                         parameters=model.parameters())
@@ -430,6 +433,21 @@ def main():
     )
     extra.update(gpt_bd)
     extra["gpt_medium_bf16_tokens_per_sec_spread"] = sp
+
+    if os.environ.get("PADDLE_BENCH_GPT_FLASH", "") not in ("", "0"):
+        # record the routed/unrouted pair (weak #1 first step): the
+        # unrouted numbers are the gpt_medium_* keys above; this run
+        # swaps every block's attention core for the Pallas flash
+        # kernel, through the SAME _repeat median so the pair is
+        # statistically comparable
+        _, flash_d, fsp = _repeat(
+            lambda: (lambda d: (d["gpt_medium_bf16_tokens_per_sec"], d))(
+                _bench_gpt(use_flash=True))
+        )
+        for k in ("step_ms", "tokens_per_sec", "compile_s"):
+            extra[f"gpt_medium_bf16_{k}_flash"] = \
+                flash_d[f"gpt_medium_bf16_{k}"]
+        extra["gpt_medium_bf16_tokens_per_sec_flash_spread"] = fsp
     import jax
 
     if jax.default_backend() == "tpu":  # compiled pallas is TPU-only

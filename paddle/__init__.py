@@ -1,0 +1,37 @@
+"""`paddle` — the stock-script compatibility package.
+
+The north star is Paddle training scripts running **verbatim** on TPU:
+``import paddle`` / ``import paddle.fluid as fluid`` must resolve in the
+same environment as `paddle_tpu`, not a lookalike spelling of it. This
+package is an *alias tree*, not a port: every public name here is the
+same object as its `paddle_tpu` counterpart (see `_alias.py` for the
+module-identity mechanism), and the fluid-era spellings
+(`fluid.layers.fc`, `fluid.dygraph.guard`, `fluid.Executor`) live in the
+real `paddle/fluid/` subpackage, mapped onto the existing facades.
+
+Parity is enforced, not asserted: `tools/check_alias.py` lints this
+namespace against the reference manifest, and
+`tests/test_reference_scripts.py` executes reference-shaped training
+scripts verbatim in subprocesses through this package.
+"""
+import paddle_tpu as _pt
+
+from . import _alias as _alias_mod
+
+_alias_mod.install()
+
+# the full top-level namespace: paddle.add, paddle.Tensor, paddle.nn, ...
+# (same objects — functions close over paddle_tpu module state, so
+# enable_static()/set_device() et al. act on the single real flag)
+globals().update({
+    _k: _v for _k, _v in vars(_pt).items()
+    if not _k.startswith("__") and _k != "annotations"
+})
+
+__version__ = _pt.__version__
+
+# the fluid-era tree is real files (new spellings), imported last so its
+# own `import paddle_tpu...` lines see a finished alias table
+from . import fluid  # noqa: E402,F401
+
+__all__ = [k for k in globals() if not k.startswith("_")]

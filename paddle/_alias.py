@@ -1,0 +1,97 @@
+"""Module-identity aliasing: `paddle.X` IS `paddle_tpu.X`.
+
+The alias package re-exports *module objects*, not copies: after
+`install()`, ``sys.modules["paddle.nn"] is sys.modules["paddle_tpu.nn"]``,
+so classes, functions, and module-level state are single-sourced — there
+is no second `Layer` class to defeat isinstance checks and no snapshot of
+mutable state (e.g. the static-mode flag) to drift.
+
+Two mechanisms:
+  1. `install()` eagerly aliases every `paddle_tpu.*` module already in
+     `sys.modules` (importing `paddle_tpu` pulls in the whole public
+     tree, so this covers the normal surface).
+  2. `_AliasFinder`, inserted at the FRONT of `sys.meta_path`, lazily
+     resolves any straggler `import paddle.x.y` to `paddle_tpu.x.y`.
+     It must run BEFORE the stock PathFinder: an aliased parent's
+     `__path__` is the paddle_tpu directory, so PathFinder would happily
+     re-execute a not-yet-imported submodule's file as a SECOND module
+     object under the `paddle.` name — duplicate classes, forked state.
+     The finder defers (returns None) exactly for names that are real
+     files under the `paddle/` package directory (the fluid tree), so
+     those still win.
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.abc
+import importlib.machinery
+import importlib.util
+import os
+import sys
+
+_SRC = "paddle_tpu"
+_DST = "paddle"
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _is_real_file(suffix: str) -> bool:
+    """True when paddle/<suffix as path> exists on disk (fluid tree &
+    friends) — those modules belong to PathFinder, not the alias."""
+    rel = os.path.join(_PKG_DIR, *suffix.split("."))
+    return os.path.exists(rel + ".py") or \
+        os.path.exists(os.path.join(rel, "__init__.py"))
+
+
+def _alias_name(fullname: str) -> str | None:
+    """'paddle.x.y' -> 'paddle_tpu.x.y', or None if not aliasable."""
+    if not fullname.startswith(_DST + "."):
+        return None
+    suffix = fullname[len(_DST) + 1:]
+    if _is_real_file(suffix):
+        return None
+    return _SRC + "." + suffix
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, target: str):
+        self._target = target
+
+    def create_module(self, spec):
+        # return the EXISTING paddle_tpu module object: exact identity
+        return importlib.import_module(self._target)
+
+    def exec_module(self, module):
+        pass  # already executed under its real name
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        tgt = _alias_name(fullname)
+        if tgt is None:
+            return None
+        try:
+            t_spec = importlib.util.find_spec(tgt)
+        except (ImportError, ValueError):
+            return None
+        if t_spec is None:
+            return None
+        return importlib.machinery.ModuleSpec(
+            fullname,
+            _AliasLoader(tgt),
+            is_package=t_spec.submodule_search_locations is not None,
+        )
+
+
+def install() -> None:
+    import paddle_tpu  # noqa: F401 — materializes the module tree
+
+    for name in sorted(k for k in list(sys.modules)
+                       if k.startswith(_SRC + ".")):
+        mod = sys.modules[name]
+        if mod is None:
+            continue
+        # real files under paddle/ (fluid) are never in sys.modules under
+        # a paddle_tpu name, so setdefault cannot shadow them
+        sys.modules.setdefault(_DST + name[len(_SRC):], mod)
+    if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+        sys.meta_path.insert(0, _AliasFinder())

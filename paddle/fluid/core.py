@@ -1,0 +1,35 @@
+"""paddle.fluid.core — the pybind-surface names scripts actually touch.
+
+Reference: paddle/fluid/pybind/ exposed as `fluid.core`. Scripts reach
+into it for places and device counts; everything else of the pybind
+surface is owned by XLA/PJRT here and is out of the alias scope (see
+tools/check_alias.py OUT_OF_SCOPE).
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from paddle_tpu.core import is_compiled_with_cuda  # noqa: F401
+
+from .executor import Scope  # noqa: F401
+
+__all__ = [
+    "CPUPlace", "CUDAPlace", "TPUPlace", "CUDAPinnedPlace",
+    "is_compiled_with_cuda", "get_cuda_device_count", "Scope",
+]
+
+
+def CUDAPinnedPlace():
+    """Pinned host memory is a CUDA-transfer concept; host staging under
+    PJRT is always pinned-equivalent, so this is CPUPlace."""
+    return CPUPlace()
+
+
+def get_cuda_device_count() -> int:
+    """Device count of the accelerator backend (the .cuda()->TPU alias
+    policy, core/tensor.py): TPU chips when present, else 0."""
+    try:
+        return len(jax.devices("tpu"))
+    except RuntimeError:
+        return 0

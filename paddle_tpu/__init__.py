@@ -39,6 +39,7 @@ from .core.flags import get_flags, set_flags  # noqa: F401
 # the full flat op namespace (paddle.add, paddle.matmul, ...)
 from .ops import *  # noqa: F401,F403
 from . import nn  # noqa: F401
+from .nn.layer import ParamAttr  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import io  # noqa: F401

@@ -43,6 +43,7 @@ class Tensor:
         "_grad_hooks",
         "_inplace_version",
         "_static_var",  # static-mode symbolic Variable (static/program.py)
+        "_backward_ran",  # user ran backward on this tensor (minimize)
         "__weakref__",
     )
 
@@ -190,6 +191,10 @@ class Tensor:
     def backward(self, grad_tensor=None, retain_graph=False):
         """Run reverse autograd from this tensor (varbase_patch_methods.py:136)."""
         autograd.run_backward(self, grad_tensor, retain_graph=retain_graph)
+        # lets optimizer.minimize(loss) distinguish "user already ran
+        # backward on THIS loss" (1.x idiom: apply, don't re-derive) from
+        # a minimize-only loop (minimize owns backward)
+        self._backward_ran = True
 
     def gradient(self) -> Optional[np.ndarray]:
         """Numpy value of accumulated grad (varbase_patch_methods.py:185)."""

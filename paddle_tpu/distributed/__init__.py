@@ -31,6 +31,7 @@ from .collective import (  # noqa: F401
     reduce,
     reduce_scatter,
     scatter,
+    wait,
 )
 from . import comm_monitor  # noqa: F401  (flight recorder, CommMonitor)
 from .parallel import DataParallel  # noqa: F401

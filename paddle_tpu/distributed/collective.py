@@ -413,6 +413,16 @@ def barrier(group=None):
         jax.block_until_ready(_allreduce_prog(g.id, ReduceOp.SUM)(x))
 
 
+def wait(tensor, group=None, use_calc_stream=True):
+    """collective.py wait: block until the tensor's pending work is done.
+    XLA has no separate comm stream to synchronize against — dispatch is
+    async-by-value — so this is block_until_ready on the backing array
+    (the calc/comm stream distinction collapses under PJRT)."""
+    raw = getattr(tensor, "_data", tensor)
+    jax.block_until_ready(raw)
+    return tensor
+
+
 def monitored_barrier(group=None, timeout: Optional[float] = None):
     """Barrier that NAMES the missing ranks instead of deadlocking
     (torch.distributed.monitored_barrier analog, built on the file-based

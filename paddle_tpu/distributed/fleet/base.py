@@ -145,6 +145,28 @@ class _DistributedOptimizer:
         s = self.user_defined_strategy
         return int(s.sharding_configs["stage"]) if s.sharding else 0
 
+    def _comm_cast(self, g):
+        """strategy.fp16_allreduce as a grad-COMM DTYPE policy
+        (fp16_allreduce_optimizer.py:18: cast grads to half around the
+        explicit NCCL all-reduce, fp32 master apply). On TPU the dp
+        reduction is emitted by XLA inside the compiled step and its wire
+        dtype follows the tensor dtype at the reduction point, and bf16
+        is the chip-native half type — so the policy is a bf16 round
+        trip at the optimizer's comm boundary: the grad value entering
+        the f32 master update is exactly a bf16-width number (what a
+        bf16 all-reduce would have delivered), halving grad-comm bytes
+        wherever the boundary is a real wire. Non-f32 grads (already
+        half, or int) pass through untouched."""
+        import jax.numpy as jnp
+
+        if g.dtype != jnp.float32:
+            return g
+        return g.astype(jnp.bfloat16).astype(jnp.float32)
+
+    @property
+    def _fp16_allreduce(self) -> bool:
+        return bool(self.user_defined_strategy.fp16_allreduce)
+
     # -- functional path hooks (consumed by jit.TrainStep) -------------------
     def _functional_state(self, params):
         state = self._inner._functional_state(params)
@@ -187,6 +209,10 @@ class _DistributedOptimizer:
         state = dict(state)
         gm_buf = state.pop("@gm_buf", None)
         gm_cnt = state.pop("@gm_cnt", None)
+
+        if self._fp16_allreduce:
+            g_raws = [g if g is None else self._comm_cast(g)
+                      for g in g_raws]
 
         if stage >= 2:
             g_raws = [g if g is None else self._zero_constrain(g)
@@ -243,6 +269,11 @@ class _DistributedOptimizer:
         return new_p, new_state
 
     # -- eager path ----------------------------------------------------------
+    def _comm_cast_grads(self):
+        for p in self._inner._get_params():
+            if p.grad is not None:
+                p.grad._data = self._comm_cast(p.grad._data)
+
     def step(self):
         k = self._gm_k
         if k > 1:
@@ -253,9 +284,16 @@ class _DistributedOptimizer:
                 for p in self._inner._get_params():
                     if p.grad is not None:
                         p.grad._data = p.grad._data / k
+            # ONE bf16 round trip on the merged grad at the apply
+            # boundary — casting every micro-step would re-quantize the
+            # running sum k times and compound the error
+            if self._fp16_allreduce:
+                self._comm_cast_grads()
             out = self._inner.step()
             self._inner.clear_grad()
             return out
+        if self._fp16_allreduce:
+            self._comm_cast_grads()
         return self._inner.step()
 
     def clear_grad(self):
@@ -267,7 +305,11 @@ class _DistributedOptimizer:
                  no_grad_set=None):
         if parameters is not None:
             self._inner._parameter_list = list(parameters)
-        loss.backward()
+        # dygraph reference semantics (see Optimizer.minimize): apply
+        # grads the user's own backward produced for this loss; run
+        # backward only in the minimize-only idiom
+        if not getattr(loss, "_backward_ran", False):
+            loss.backward()
         self.step()
         return None, None
 
@@ -446,14 +488,6 @@ class Fleet:
         if s.a_sync:
             raise NotImplementedError(
                 "a_sync is parameter-server mode — out of the TPU scope"
-            )
-        if s.fp16_allreduce:
-            raise NotImplementedError(
-                "fp16_allreduce casts grads around an explicit NCCL "
-                "all-reduce (fp16_allreduce_optimizer.py:18); here the "
-                "grad reduction is emitted by XLA inside the compiled "
-                "step and its precision follows the tensor dtype — use "
-                "strategy.amp (bf16/fp16 compute) to reduce comm bytes"
             )
         if s.sharding and s.sharding_configs["hybrid_dp"]:
             raise NotImplementedError(

@@ -173,7 +173,8 @@ class ParallelMultiHeadAttention(Layer):
     """
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, causal=True,
-                 weight_attr=None, bias_attr=None):
+                 weight_attr=None, bias_attr=None,
+                 use_flash_attention=False):
         super().__init__()
         self.mesh = comm.mp_mesh()
         mp = self.mesh.shape["mp"]
@@ -187,6 +188,16 @@ class ParallelMultiHeadAttention(Layer):
         self.head_dim = embed_dim // num_heads
         self.causal = causal
         self.dropout = dropout
+        # route the softmax(QK^T)V core through the Pallas flash kernel
+        # (ops/pallas/flash_attention: K/V stream through the grid, no
+        # [T, T] score matrix in HBM). Attention-prob dropout needs the
+        # materialized probs, so the kernel path requires dropout == 0.
+        if use_flash_attention and dropout:
+            raise ValueError(
+                "use_flash_attention requires dropout=0.0: the flash "
+                "kernel never materializes the attention probabilities"
+            )
+        self.use_flash_attention = use_flash_attention
         self.qkv = ColumnParallelLinear(
             embed_dim, 3 * embed_dim, weight_attr=weight_attr,
             bias_attr=bias_attr, gather_output=False,
@@ -206,6 +217,25 @@ class ParallelMultiHeadAttention(Layer):
         qkv = qkv.reshape([B, T, 3, H, dh]).transpose([2, 0, 3, 1, 4])
         qkv = _constrain(qkv, self.mesh, P(None, None, "mp", None, None))
         q, k, v = qkv[0], qkv[1], qkv[2]  # [B, H, T, dh]
+        if self.use_flash_attention:
+            from ..ops.pallas import flash_attention
+
+            # largest power-of-two tile <= 256 that divides T (the
+            # kernel requires S % block == 0; odd lengths fall back to
+            # small tiles rather than crashing)
+            block = 256
+            while block > 1 and T % block != 0:
+                block //= 2
+            interpret = jax.default_backend() != "tpu"
+            ctx = AG.apply(
+                lambda q_, k_, v_: flash_attention(
+                    q_, k_, v_, self.causal, block, block, None, interpret
+                ),
+                (q, k, v), name="flash_attention",
+            )
+            ctx = ctx.transpose([0, 2, 1, 3]).reshape([B, T, H * dh])
+            ctx = _constrain(ctx, self.mesh, P(None, None, "mp"))
+            return self.out_proj(ctx)
         scores = ops.matmul(q, k, transpose_y=True) * (dh ** -0.5)
         if self.causal:
             import numpy as np
@@ -230,14 +260,15 @@ class ParallelGPTBlock(Layer):
     the unit the BASELINE GPT-3 configs stack inside pipeline stages."""
 
     def __init__(self, d_model, num_heads, dim_feedforward=None,
-                 dropout=0.0, causal=True):
+                 dropout=0.0, causal=True, use_flash_attention=False):
         super().__init__()
         from ..nn.layers.norm import LayerNorm
 
         ffn = dim_feedforward or 4 * d_model
         self.ln1 = LayerNorm(d_model)
         self.attn = ParallelMultiHeadAttention(
-            d_model, num_heads, dropout=dropout, causal=causal
+            d_model, num_heads, dropout=dropout, causal=causal,
+            use_flash_attention=use_flash_attention,
         )
         self.ln2 = LayerNorm(d_model)
         self.fc1 = ColumnParallelLinear(d_model, ffn, gather_output=False)

@@ -177,7 +177,14 @@ class Optimizer:
             prog.optimize_directives.append((self, loss_var))
             prog._version += 1
             return None, None
-        loss.backward()
+        # dygraph reference semantics (optimizer.py minimize under
+        # imperative mode): when the user already ran backward on THIS
+        # loss — the stock 1.x idiom `loss.backward(); opt.minimize()` —
+        # apply the existing grads; a second backward would double every
+        # gradient. A minimize-only loop (no explicit backward) still
+        # gets backward here, fresh each call.
+        if not getattr(loss, "_backward_ran", False):
+            loss.backward()
         self.step()
         return None, None
 

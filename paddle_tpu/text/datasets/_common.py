@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import os
 
+from ...utils.download import dataset_home  # noqa: F401  (shared root)
+
 
 def resolve_data_file(data_file, download, name, url):
     """Reference _check_exists_and_download analog, egress-free: the file
@@ -17,10 +19,7 @@ def resolve_data_file(data_file, download, name, url):
         raise AssertionError(
             "data_file is not set and downloading automatically is disabled"
         )
-    cache = os.path.join(
-        os.path.expanduser("~"), ".cache", "paddle_tpu", "dataset", name,
-        os.path.basename(url),
-    )
+    cache = os.path.join(dataset_home(), name, os.path.basename(url))
     if os.path.exists(cache):
         return cache
     raise RuntimeError(

@@ -1,4 +1,4 @@
 """paddle_tpu.utils — developer tooling (custom ops, op benchmarking,
 deterministic fault injection for the elastic runtime)."""
-from . import custom_op, fault_injection, op_bench  # noqa: F401
+from . import custom_op, download, fault_injection, op_bench  # noqa: F401
 from .custom_op import register_op  # noqa: F401

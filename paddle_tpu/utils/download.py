@@ -1,0 +1,36 @@
+"""Dataset staging paths (reference: python/paddle/utils/download.py,
+egress-free).
+
+The reference downloads datasets into `~/.cache/paddle/dataset/<name>/`;
+this environment has no egress, so the same layout is a *staging* dir:
+loaders in text/ and vision/ resolve default file paths under it, and the
+verbatim-script harness (tests/test_reference_scripts.py) pre-writes
+files there so reference scripts run with no path arguments.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["dataset_home", "get_path_from_url"]
+
+
+def dataset_home() -> str:
+    """Root for pre-staged dataset files; `PADDLE_DATASET_HOME`
+    overrides the default cache dir."""
+    return os.environ.get(
+        "PADDLE_DATASET_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "dataset"),
+    )
+
+
+def get_path_from_url(url: str, root_dir: str | None = None, **kw) -> str:
+    """download.py get_path_from_url, egress-free: resolve where the
+    file WOULD be cached and require it staged there."""
+    path = os.path.join(root_dir or dataset_home(), os.path.basename(url))
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"automatic download is unavailable in this environment; "
+            f"fetch {url} and place it at {path}"
+        )
+    return path

@@ -17,20 +17,47 @@ __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
 
 class MNIST(Dataset):
     """IDX-format MNIST reader (reference: vision/datasets/mnist.py parses the
-    same gzip IDX files). Pass image_path/label_path; no downloading."""
+    same gzip IDX files). Pass image_path/label_path, or pre-stage the
+    standard file names under `$PADDLE_DATASET_HOME/<_NAME>/` (the
+    reference's download-cache layout) so `MNIST(mode="train")` resolves
+    with no arguments — what verbatim reference scripts call.
+    No downloading in this environment."""
+
+    _NAME = "mnist"
 
     def __init__(self, image_path=None, label_path=None, mode="train",
-                 transform=None, download=False, backend=None):
+                 transform=None, download=True, backend=None):
         self.mode = mode
         self.transform = transform
         if image_path is None or label_path is None:
+            image_path, label_path = self._default_paths(mode)
+        if image_path is None or label_path is None:
+            from ..utils.download import dataset_home
+
             raise ValueError(
-                "MNIST requires local image_path/label_path (no network in "
-                "this environment); for synthetic data use "
-                "paddle_tpu.vision.datasets.FakeData"
+                f"{type(self).__name__} requires local image_path/"
+                "label_path (no network in this environment); stage the "
+                f"IDX files under {os.path.join(dataset_home(), self._NAME)}"
+                " or use paddle_tpu.vision.datasets.FakeData"
             )
         self.images = self._parse_images(image_path)
         self.labels = self._parse_labels(label_path)
+
+    @classmethod
+    def _default_paths(cls, mode):
+        from ..utils.download import dataset_home
+
+        prefix = "train" if mode == "train" else "t10k"
+        root = os.path.join(dataset_home(), cls._NAME)
+        img = lbl = None
+        for ext in (".gz", ""):
+            p = os.path.join(root, f"{prefix}-images-idx3-ubyte{ext}")
+            q = os.path.join(root, f"{prefix}-labels-idx1-ubyte{ext}")
+            if img is None and os.path.exists(p):
+                img = p
+            if lbl is None and os.path.exists(q):
+                lbl = q
+        return img, lbl
 
     @staticmethod
     def _open(path):
@@ -59,7 +86,7 @@ class MNIST(Dataset):
 
 
 class FashionMNIST(MNIST):
-    pass
+    _NAME = "fashion-mnist"
 
 
 class _CifarBase(Dataset):

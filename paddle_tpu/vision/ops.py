@@ -836,3 +836,110 @@ def target_assign(input, matched_indices, mismatch_value=0.0, name=None):
 
     out = AG.apply_nondiff(f, (x, m))
     return out[0], out[1]
+
+
+__all__ += ["nms", "roi_pool"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """python/paddle/vision/ops.py nms: greedy suppression returning the
+    KEPT INDICES in descending score order. The kept count is
+    data-dependent, so this is an eager (host-synced) op like
+    sequence_expand — the in-graph fixed-size form is multiclass_nms.
+
+    boxes [M, 4] (x1, y1, x2, y2); optional scores [M]; optional
+    category_idxs [M] + categories list for per-category suppression."""
+    b = boxes if isinstance(boxes, Tensor) else Tensor(boxes)
+    bx = np.asarray(jax.device_get(b._data), np.float32)
+    M = bx.shape[0]
+    sc = (np.asarray(jax.device_get(
+        (scores if isinstance(scores, Tensor) else Tensor(scores))._data
+    ), np.float32) if scores is not None else np.arange(M, 0, -1,
+                                                        dtype=np.float32))
+    cat = (np.asarray(jax.device_get(
+        (category_idxs if isinstance(category_idxs, Tensor)
+         else Tensor(category_idxs))._data
+    )) if category_idxs is not None else np.zeros((M,), np.int64))
+
+    x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    order = np.argsort(-sc, kind="stable")
+    kept = []
+    for i in order:
+        ok = True
+        for j in kept:
+            if cat[i] != cat[j]:
+                continue  # suppression is per category
+            iw = max(min(x2[i], x2[j]) - max(x1[i], x1[j]), 0.0)
+            ih = max(min(y2[i], y2[j]) - max(y1[i], y1[j]), 0.0)
+            inter = iw * ih
+            union = area[i] + area[j] - inter
+            if union > 0 and inter / union > iou_threshold:
+                ok = False
+                break
+        if ok:
+            kept.append(i)
+    if top_k is not None:
+        kept = kept[: int(top_k)]
+    return Tensor(np.asarray(kept, np.int64))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """operators/roi_pool_op: QUANTIZED max pooling over each RoI (the
+    pre-align RoI op: integer bin boundaries, max — not bilinear mean).
+    x [N, C, H, W]; boxes [R, 4]; boxes_num [N]. Out [R, C, oh, ow].
+    Differentiable through the max gather (the CUDA argmax backward's
+    VJP)."""
+    if isinstance(output_size, int):
+        out_h = out_w = int(output_size)
+    else:
+        out_h, out_w = int(output_size[0]), int(output_size[1])
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    boxes = boxes if isinstance(boxes, Tensor) else Tensor(boxes)
+    bn = boxes_num if isinstance(boxes_num, Tensor) else Tensor(
+        np.asarray(boxes_num)
+    )
+
+    def f(feat, bxs, bnum):
+        N, C, H, W = feat.shape
+        R = bxs.shape[0]
+        img_of_roi = jnp.repeat(jnp.arange(N), bnum, total_repeat_length=R)
+        # roi_pool_op.h: round the scaled corners, force size >= 1
+        rx1 = jnp.round(bxs[:, 0] * spatial_scale)
+        ry1 = jnp.round(bxs[:, 1] * spatial_scale)
+        rx2 = jnp.round(bxs[:, 2] * spatial_scale)
+        ry2 = jnp.round(bxs[:, 3] * spatial_scale)
+        rw = jnp.maximum(rx2 - rx1 + 1, 1.0)
+        rh = jnp.maximum(ry2 - ry1 + 1, 1.0)
+
+        def pool_one(r_feat, px1, py1, w, h):
+            # bin [i, j] covers rows floor(i*h/oh)..ceil((i+1)*h/oh);
+            # build a [oh*ow, H*W] membership mask and take a masked max
+            # (static shapes; XLA fuses the one-hot reduce)
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+            i = jnp.arange(out_h, dtype=jnp.float32)
+            j = jnp.arange(out_w, dtype=jnp.float32)
+            y_lo = jnp.floor(py1 + i * h / out_h)
+            y_hi = jnp.ceil(py1 + (i + 1) * h / out_h)
+            x_lo = jnp.floor(px1 + j * w / out_w)
+            x_hi = jnp.ceil(px1 + (j + 1) * w / out_w)
+            in_y = (ys[None, :] >= jnp.clip(y_lo, 0, H)[:, None]) & \
+                   (ys[None, :] < jnp.clip(y_hi, 0, H)[:, None])   # [oh, H]
+            in_x = (xs[None, :] >= jnp.clip(x_lo, 0, W)[:, None]) & \
+                   (xs[None, :] < jnp.clip(x_hi, 0, W)[:, None])   # [ow, W]
+            mask = in_y[:, None, :, None] & in_x[None, :, None, :]
+            masked = jnp.where(                         # [oh, ow, C, H, W]
+                mask[:, :, None, :, :], r_feat[None, None], -jnp.inf
+            )
+            pooled = masked.max(axis=(3, 4))            # [oh, ow, C]
+            empty = ~mask.any(axis=(2, 3))              # [oh, ow]
+            pooled = jnp.where(empty[..., None], 0.0, pooled)
+            return pooled.transpose(2, 0, 1)            # [C, oh, ow]
+
+        roi_feats = feat[img_of_roi]
+        return jax.vmap(pool_one)(roi_feats, rx1, ry1, rw, rh)
+
+    return AG.apply(f, (x, boxes, bn), name="roi_pool")

@@ -1,0 +1,97 @@
+# Reference-shaped dygraph MNIST script (modeled on
+# python/paddle/fluid/tests/unittests/test_imperative_mnist.py and the
+# dygraph chapter of the book tests). Runs VERBATIM through the `paddle`
+# alias package: only stock imports below. The harness caps work via
+# BATCH_SIZE / MAX_STEPS env (dataset-size/iteration caps only).
+from __future__ import print_function
+
+import os
+
+import numpy as np
+
+import paddle
+import paddle.fluid as fluid
+from paddle.fluid.dygraph import Conv2D, Linear, Pool2D
+from paddle.fluid.optimizer import AdamOptimizer
+
+BATCH_SIZE = int(os.environ.get("BATCH_SIZE", "64"))
+MAX_STEPS = int(os.environ.get("MAX_STEPS", "40"))
+EPOCHS = int(os.environ.get("EPOCHS", "1"))
+
+
+class SimpleImgConvPool(fluid.dygraph.Layer):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_size, pool_stride, act="relu"):
+        super(SimpleImgConvPool, self).__init__()
+        self._conv2d = Conv2D(
+            num_channels=num_channels,
+            num_filters=num_filters,
+            filter_size=filter_size,
+            act=act,
+        )
+        self._pool2d = Pool2D(
+            pool_size=pool_size,
+            pool_type="max",
+            pool_stride=pool_stride,
+        )
+
+    def forward(self, inputs):
+        x = self._conv2d(inputs)
+        x = self._pool2d(x)
+        return x
+
+
+class MNIST(fluid.dygraph.Layer):
+    def __init__(self):
+        super(MNIST, self).__init__()
+        self._simple_img_conv_pool_1 = SimpleImgConvPool(1, 20, 5, 2, 2)
+        self._simple_img_conv_pool_2 = SimpleImgConvPool(20, 50, 5, 2, 2)
+        self.pool_2_shape = 50 * 4 * 4
+        self._fc = Linear(self.pool_2_shape, 10, act="softmax")
+
+    def forward(self, inputs):
+        x = self._simple_img_conv_pool_1(inputs)
+        x = self._simple_img_conv_pool_2(x)
+        x = fluid.layers.reshape(x, shape=[-1, self.pool_2_shape])
+        x = self._fc(x)
+        return x
+
+
+def train():
+    with fluid.dygraph.guard():
+        mnist = MNIST()
+        adam = AdamOptimizer(
+            learning_rate=0.001, parameter_list=mnist.parameters()
+        )
+        train_reader = paddle.batch(
+            paddle.dataset.mnist.train(), batch_size=BATCH_SIZE,
+            drop_last=True,
+        )
+        for epoch in range(EPOCHS):
+            for batch_id, data in enumerate(train_reader()):
+                if batch_id >= MAX_STEPS:
+                    break
+                dy_x_data = np.array(
+                    [x[0].reshape(1, 28, 28) for x in data]
+                ).astype("float32")
+                y_data = np.array(
+                    [x[1] for x in data]
+                ).astype("int64").reshape(-1, 1)
+                img = fluid.dygraph.to_variable(dy_x_data)
+                label = fluid.dygraph.to_variable(y_data)
+                label.stop_gradient = True
+
+                cost = mnist(img)
+                loss = fluid.layers.cross_entropy(cost, label)
+                avg_loss = fluid.layers.mean(loss)
+                avg_loss.backward()
+                adam.minimize(avg_loss)
+                mnist.clear_gradients()
+                if batch_id % 10 == 0:
+                    print("Loss at epoch {} step {}: {}".format(
+                        epoch, batch_id, float(avg_loss.numpy())))
+        print("Final loss: {}".format(float(avg_loss.numpy())))
+
+
+if __name__ == "__main__":
+    train()

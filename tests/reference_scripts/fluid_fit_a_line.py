@@ -1,0 +1,60 @@
+# Reference-shaped static-graph book script (modeled on
+# python/paddle/fluid/tests/book/test_fit_a_line.py). A fluid-1.x script:
+# no enable_static() call — `fluid.data` implies graph mode — Executor
+# compiles and runs the program. Caps come from BATCH_SIZE / NUM_EPOCHS
+# env (dataset-size/iteration caps only).
+from __future__ import print_function
+
+import os
+import sys
+
+import numpy
+
+import paddle
+import paddle.fluid as fluid
+
+BATCH_SIZE = int(os.environ.get("BATCH_SIZE", "20"))
+NUM_EPOCHS = int(os.environ.get("NUM_EPOCHS", "15"))
+
+
+def main(use_cuda):
+    x = fluid.data(name="x", shape=[None, 13], dtype="float32")
+    y = fluid.data(name="y", shape=[None, 1], dtype="float32")
+
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_loss = fluid.layers.mean(cost)
+
+    sgd_optimizer = fluid.optimizer.SGD(learning_rate=0.01)
+    sgd_optimizer.minimize(avg_loss)
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                              buf_size=500),
+        batch_size=BATCH_SIZE,
+    )
+
+    place = fluid.CUDAPlace(0) if use_cuda else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+    main_program = fluid.default_main_program()
+
+    avg_loss_value = None
+    for pass_id in range(NUM_EPOCHS):
+        for data_train in train_reader():
+            (avg_loss_value,) = exe.run(
+                main_program, feed=feeder.feed(data_train),
+                fetch_list=[avg_loss],
+            )
+        print("Pass {}, Cost {}".format(pass_id, float(avg_loss_value)))
+        if numpy.isnan(float(avg_loss_value)):
+            print("got NaN loss, training failed.")
+            sys.exit(1)
+    print("Final loss: {}".format(float(avg_loss_value)))
+
+
+if __name__ == "__main__":
+    use_cuda = fluid.core.is_compiled_with_cuda()
+    main(use_cuda)

@@ -1,0 +1,84 @@
+# Reference-shaped static-graph conv script (modeled on
+# python/paddle/fluid/tests/book/test_recognize_digits.py, conv variant):
+# fluid.nets.simple_img_conv_pool backbone, softmax fc head, 1.x
+# cross_entropy over probabilities, Adam, Executor + DataFeeder loop.
+# Caps come from BATCH_SIZE / NUM_EPOCHS / MAX_STEPS env.
+from __future__ import print_function
+
+import os
+import sys
+
+import numpy
+
+import paddle
+import paddle.fluid as fluid
+
+BATCH_SIZE = int(os.environ.get("BATCH_SIZE", "64"))
+NUM_EPOCHS = int(os.environ.get("NUM_EPOCHS", "1"))
+MAX_STEPS = int(os.environ.get("MAX_STEPS", "40"))
+
+
+def convolutional_neural_network(img, label):
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=img,
+        filter_size=5,
+        num_filters=20,
+        pool_size=2,
+        pool_stride=2,
+        act="relu",
+    )
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1,
+        filter_size=5,
+        num_filters=50,
+        pool_size=2,
+        pool_stride=2,
+        act="relu",
+    )
+    prediction = fluid.layers.fc(input=conv_pool_2, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+def main(use_cuda):
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+
+    prediction, avg_cost, acc = convolutional_neural_network(img, label)
+
+    optimizer = fluid.optimizer.Adam(learning_rate=0.001)
+    optimizer.minimize(avg_cost)
+
+    place = fluid.CUDAPlace(0) if use_cuda else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    train_reader = paddle.batch(
+        paddle.dataset.mnist.train(), batch_size=BATCH_SIZE,
+        drop_last=True,
+    )
+    feeder = fluid.DataFeeder(feed_list=[img, label], place=place)
+    main_program = fluid.default_main_program()
+
+    loss_val = None
+    for pass_id in range(NUM_EPOCHS):
+        for step_id, data in enumerate(train_reader()):
+            if step_id >= MAX_STEPS:
+                break
+            loss_val, acc_val = exe.run(
+                main_program, feed=feeder.feed(data),
+                fetch_list=[avg_cost, acc],
+            )
+            if step_id % 10 == 0:
+                print("Pass {}, Batch {}, Cost {}, Acc {}".format(
+                    pass_id, step_id, float(loss_val), float(acc_val)))
+        if numpy.isnan(float(loss_val)):
+            print("got NaN loss, training failed.")
+            sys.exit(1)
+    print("Final loss: {}".format(float(loss_val)))
+
+
+if __name__ == "__main__":
+    main(fluid.core.is_compiled_with_cuda())

@@ -1,0 +1,43 @@
+# Reference-shaped high-level-API (hapi) script (modeled on the
+# python/paddle/hapi/model.py docstring examples and
+# tests/unittests/test_model.py): Model.prepare + Model.fit over the
+# vision MNIST dataset. Caps come from BATCH_SIZE / EPOCHS / MAX_STEPS
+# env (dataset-size/iteration caps only).
+from __future__ import print_function
+
+import os
+
+import paddle
+from paddle.metric import Accuracy
+from paddle.vision.datasets import MNIST
+from paddle.vision.models import LeNet
+
+BATCH_SIZE = int(os.environ.get("BATCH_SIZE", "64"))
+EPOCHS = int(os.environ.get("EPOCHS", "2"))
+MAX_STEPS = os.environ.get("MAX_STEPS")
+
+
+def main():
+    train_dataset = MNIST(mode="train")
+    val_dataset = MNIST(mode="test")
+
+    model = paddle.Model(LeNet())
+    optim = paddle.optimizer.Adam(
+        learning_rate=0.001, parameters=model.parameters()
+    )
+    model.prepare(optim, paddle.nn.CrossEntropyLoss(), Accuracy())
+
+    model.fit(
+        train_dataset,
+        epochs=EPOCHS,
+        batch_size=BATCH_SIZE,
+        num_iters=int(MAX_STEPS) if MAX_STEPS else None,
+        verbose=2,
+    )
+    result = model.evaluate(val_dataset, batch_size=BATCH_SIZE, verbose=0)
+    print("Eval result:", result)
+    print("Final acc: {}".format(float(result["acc"])))
+
+
+if __name__ == "__main__":
+    main()

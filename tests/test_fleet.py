@@ -232,3 +232,119 @@ class TestFleetE2E:
         )
         assert opt.user_defined_strategy is s
         assert hasattr(opt, "minimize")
+
+
+class TestFp16Allreduce:
+    """strategy.fp16_allreduce as a grad-comm dtype policy: bf16 grads at
+    the dp reduction boundary, f32 master apply (closes VERDICT no#35 —
+    the reference's fp16_allreduce_optimizer casts around ncclAllReduce)."""
+
+    def _train(self, fp16_allreduce, steps=5):
+        paddle.seed(7)
+        strategy = DistributedStrategy()
+        strategy.fp16_allreduce = fp16_allreduce
+        fleet.init(is_collective=True, strategy=strategy)
+        net = _DenseNet()
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+            strategy=strategy,
+        )
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(8, 10).astype(np.float32)
+        )
+        losses = []
+        for _ in range(steps):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses, [p.numpy() for p in net.parameters()]
+
+    def test_no_longer_raises_and_parity_vs_f32(self):
+        losses16, params16 = self._train(True)
+        losses32, params32 = self._train(False)
+        # same seed/data: the bf16 comm round trip perturbs each grad by
+        # at most one bf16 ulp (~2^-8 relative), so training tracks the
+        # f32 run within a loose tolerance and still converges
+        assert losses16[-1] < losses16[0]
+        np.testing.assert_allclose(
+            np.asarray(losses16), np.asarray(losses32), rtol=2e-2, atol=1e-3
+        )
+        for a, b in zip(params16, params32):
+            np.testing.assert_allclose(a, b, rtol=2e-2, atol=1e-3)
+
+    def test_grads_pass_through_bf16_width(self):
+        import jax.numpy as jnp
+
+        strategy = DistributedStrategy()
+        strategy.fp16_allreduce = True
+        fleet.init(is_collective=True, strategy=strategy)
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(learning_rate=1.0,
+                          parameters=_DenseNet().parameters()),
+            strategy=strategy,
+        )
+        # 1 + 2^-12 needs 12 mantissa bits: survives f32, quantizes in bf16
+        g = jnp.asarray(1.0 + 2.0 ** -12, jnp.float32)
+        out = opt._comm_cast(g)
+        assert out.dtype == jnp.float32  # master apply stays f32
+        assert float(out) == 1.0  # the wire value is bf16-width
+        # non-f32 grads pass through untouched
+        h = jnp.asarray(3, jnp.int32)
+        assert opt._comm_cast(h) is h
+
+    def test_functional_path_applies_policy(self):
+        """TrainStep (fused path) consumes _functional_update: the cast
+        must live there too, not only in eager step()."""
+        paddle.seed(7)
+        strategy = DistributedStrategy()
+        strategy.fp16_allreduce = True
+        fleet.init(is_collective=True, strategy=strategy)
+        net = _DenseNet()
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+            strategy=strategy,
+        )
+        step = TrainStep(net, lambda out, y: (out ** 2).mean(), opt)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).rand(8, 10).astype(np.float32)
+        )
+        y = paddle.to_tensor(np.zeros((8, 4), np.float32))
+        first = float(step(x, y).numpy())
+        for _ in range(4):
+            last = float(step(x, y).numpy())
+        assert last < first
+
+
+class TestFp16AllreduceGradientMerge:
+    def test_eager_gm_casts_once_at_boundary(self):
+        """With gradient_merge k>1 the bf16 round trip happens ONCE on
+        the merged grad at the apply boundary — not on the running sum
+        every micro-step (which would compound quantization error)."""
+        def run(fp16):
+            paddle.seed(11)
+            strategy = DistributedStrategy()
+            strategy.fp16_allreduce = fp16
+            strategy.gradient_merge = True
+            strategy.gradient_merge_configs = {"k_steps": 4, "avg": True}
+            fleet.init(is_collective=True, strategy=strategy)
+            net = _DenseNet()
+            opt = fleet.distributed_optimizer(
+                optimizer.SGD(learning_rate=0.1,
+                              parameters=net.parameters()),
+                strategy=strategy,
+            )
+            x = paddle.to_tensor(
+                np.random.RandomState(3).rand(8, 10).astype(np.float32)
+            )
+            for _ in range(8):  # two full merge windows
+                loss = (net(x) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return [p.numpy() for p in net.parameters()]
+
+        p16, p32 = run(True), run(False)
+        for a, b in zip(p16, p32):
+            np.testing.assert_allclose(a, b, rtol=2e-2, atol=1e-3)

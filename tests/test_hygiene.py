@@ -290,6 +290,90 @@ class TestNamespaceParity:
             paddle.onnx.export(None, "/tmp/x")
 
 
+class TestAliasParity:
+    """The `import paddle` compatibility subsystem stays honest in CI:
+    tools/check_alias.py must report zero missing reference names, zero
+    stale out-of-scope entries, and zero paddle_tpu public names without
+    a `paddle` alias — a new paddle_tpu export that is not reachable via
+    `paddle.*` (and is not on the out-of-scope list) fails here."""
+
+    @staticmethod
+    def _linter():
+        import importlib.util
+        import os
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "check_alias.py",
+        )
+        spec = importlib.util.spec_from_file_location("check_alias", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_reference_coverage_zero_missing(self):
+        ca = self._linter()
+        rows, missing, stale = ca.check_reference_coverage()
+        assert rows, "linter walked no modules"
+        assert not missing, f"aliased-but-missing reference names: {missing}"
+        assert not stale, f"stale out-of-scope entries: {stale}"
+
+    def test_every_paddle_tpu_name_is_aliased(self):
+        ca = self._linter()
+        unaliased = ca.check_alias_completeness()
+        assert not unaliased, (
+            "paddle_tpu public names with no `paddle` alias (add the "
+            f"alias or an OUT_OF_SCOPE entry): {unaliased}"
+        )
+
+    def test_module_identity_is_exact(self):
+        """The alias is the SAME module object, not a copy — mutable
+        state (static-mode flag, default programs) must be single-
+        sourced."""
+        import paddle
+        import paddle.nn
+        import paddle.static
+        import paddle_tpu
+
+        assert paddle.nn is paddle_tpu.nn
+        assert paddle.static is paddle_tpu.static
+        assert paddle.Tensor is paddle_tpu.Tensor
+        import importlib
+
+        assert importlib.import_module("paddle.nn.functional") \
+            is paddle_tpu.nn.functional
+        # a module paddle_tpu does NOT import eagerly: the alias finder
+        # must still return the same object, never re-execute the file
+        # through the aliased parent's __path__ (duplicate custom_vjp
+        # registrations / second class objects)
+        lazy_alias = importlib.import_module(
+            "paddle.ops.pallas.flash_attention"
+        )
+        lazy_src = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention"
+        )
+        assert lazy_alias is lazy_src
+
+    def test_fluid_mode_policy(self):
+        """fluid.data implies static mode; dygraph.guard scopes it off;
+        both restore the prior mode (framework.py mode policy)."""
+        import paddle.fluid as fluid
+        import paddle_tpu.static as static
+
+        was = static._static_mode_on()
+        try:
+            static._disable()
+            with fluid.dygraph.guard():
+                assert fluid.in_dygraph_mode()
+            assert fluid.in_dygraph_mode()  # restored (was dygraph)
+            static._enable()
+            with fluid.dygraph.guard():
+                assert fluid.in_dygraph_mode()
+            assert not fluid.in_dygraph_mode()  # restored (was static)
+        finally:
+            (static._enable if was else static._disable)()
+
+
 class TestReaderDecorators:
     """paddle.reader decorator parity (reference reader/decorator.py)."""
 

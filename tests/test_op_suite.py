@@ -796,6 +796,74 @@ def test_sequence_expand_slice_enumerate():
     )
 
 
+def test_sequence_expand_as_matches_reference():
+    """sequence_expand_as_op: row i of x repeats to fill row i of y's
+    length — the dense+lengths form takes y's lengths directly."""
+    x = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+    y_lens = np.array([3, 1, 2], np.int64)
+    got = P.sequence_expand_as(P.to_tensor(x), P.to_tensor(y_lens)).numpy()
+    ref = np.repeat(x, y_lens, axis=0)           # [6, 2]
+    np.testing.assert_array_equal(got, ref)
+    check_grad(
+        lambda v: P.sequence_expand_as(v, P.to_tensor(y_lens)), [x]
+    )
+
+
+def test_sequence_enumerate_respects_lengths():
+    """sequence_enumerate_op with explicit lengths: positions past each
+    row's valid prefix fill with pad_value (the LoD-boundary behavior of
+    the reference kernel, dense+lengths form) — the ERNIE-style n-gram
+    windowing over a ragged batch."""
+    ids = np.array([[1, 2, 3, 4], [5, 6, 0, 0]], np.int64)
+    lens = np.array([4, 2], np.int64)
+    win = P.sequence_enumerate(
+        P.to_tensor(ids), 3, pad_value=9, lengths=P.to_tensor(lens)
+    ).numpy()
+    np.testing.assert_array_equal(
+        win[0], [[1, 2, 3], [2, 3, 4], [3, 4, 9], [4, 9, 9]]
+    )
+    # row 1: only the first 2 positions are valid; windows never read
+    # past the row length even though the padded ids are in range
+    np.testing.assert_array_equal(
+        win[1], [[5, 6, 9], [6, 9, 9], [9, 9, 9], [9, 9, 9]]
+    )
+
+
+def test_sequence_ops_ernie_shaped_pipeline():
+    """ERNIE-config composition (missing #2): reverse a ragged batch,
+    enumerate bigrams, expand_as back over token counts — every stage in
+    the dense+lengths policy with the padding untouched."""
+    rng = np.random.RandomState(5)
+    B, T = 3, 6
+    lens = np.array([6, 3, 4], np.int64)
+    ids = np.zeros((B, T), np.int64)
+    for b, l in enumerate(lens):
+        ids[b, :l] = rng.randint(1, 50, l)
+
+    rev = P.sequence_reverse(
+        P.to_tensor(ids.astype(np.float32)), P.to_tensor(lens)
+    ).numpy().astype(np.int64)
+    for b, l in enumerate(lens):
+        np.testing.assert_array_equal(rev[b, :l], ids[b, :l][::-1])
+        np.testing.assert_array_equal(rev[b, l:], ids[b, l:])
+
+    bigrams = P.sequence_enumerate(
+        P.to_tensor(ids), 2, pad_value=0, lengths=P.to_tensor(lens)
+    ).numpy()
+    assert bigrams.shape == (B, T, 2)
+    for b, l in enumerate(lens):
+        np.testing.assert_array_equal(bigrams[b, : l - 1, 0], ids[b, : l - 1])
+        np.testing.assert_array_equal(bigrams[b, : l - 1, 1], ids[b, 1:l])
+
+    # one sentence-level feature per row, expanded to token positions
+    feats = rng.rand(B, 4).astype(np.float32)
+    per_tok = P.sequence_expand_as(
+        P.to_tensor(feats), P.to_tensor(lens)
+    ).numpy()
+    assert per_tok.shape == (int(lens.sum()), 4)
+    np.testing.assert_array_equal(per_tok, np.repeat(feats, lens, axis=0))
+
+
 # ---------------------------------------------------------------------------
 # round-5 detection-op tail
 # ---------------------------------------------------------------------------

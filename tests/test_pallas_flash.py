@@ -80,3 +80,63 @@ def test_mha_blockwise_stays_on_xla_path_on_cpu():
         out.numpy(), np.asarray(_dense(q, k, v, True)), rtol=2e-4,
         atol=2e-5,
     )
+
+
+class TestParallelMHAFlashRouting:
+    """ParallelMultiHeadAttention(use_flash_attention=True): the GPT
+    bench routing (PADDLE_BENCH_GPT_FLASH) — flash core must match the
+    dense softmax path, forward and backward, on shared weights."""
+
+    def _pair(self, T=128, d=32, heads=2):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import comm
+        from paddle_tpu.distributed.meta_parallel import (
+            ParallelMultiHeadAttention,
+        )
+
+        if comm.hybrid_mesh() is None:
+            comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+        paddle.seed(3)
+        dense = ParallelMultiHeadAttention(d, heads, causal=True)
+        flash = ParallelMultiHeadAttention(
+            d, heads, causal=True, use_flash_attention=True
+        )
+        flash.set_state_dict(dense.state_dict())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(2, T, d).astype(np.float32),
+            stop_gradient=False,
+        )
+        return dense, flash, x
+
+    def test_forward_matches_dense(self):
+        dense, flash, x = self._pair()
+        np.testing.assert_allclose(
+            flash(x).numpy(), dense(x).numpy(), rtol=2e-4, atol=2e-5
+        )
+
+    def test_backward_matches_dense(self):
+        import paddle_tpu as paddle
+
+        dense, flash, x = self._pair()
+        flash(x).sum().backward()
+        g_flash = x.grad.numpy().copy()
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        dense(x2).sum().backward()
+        np.testing.assert_allclose(
+            g_flash, x2.grad.numpy(), rtol=5e-4, atol=5e-5
+        )
+
+    def test_dropout_with_flash_raises(self):
+        import pytest as _pytest
+
+        from paddle_tpu.distributed import comm
+        from paddle_tpu.distributed.meta_parallel import (
+            ParallelMultiHeadAttention,
+        )
+
+        if comm.hybrid_mesh() is None:
+            comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+        with _pytest.raises(ValueError, match="dropout"):
+            ParallelMultiHeadAttention(
+                32, 2, dropout=0.1, use_flash_attention=True
+            )

@@ -197,3 +197,140 @@ class TestDeformConv:
         assert x.grad is not None and np.any(x.grad.numpy() != 0)
         assert off.grad is not None and np.any(off.grad.numpy() != 0)
         assert w.grad is not None and np.any(w.grad.numpy() != 0)
+
+
+class TestNms:
+    """paddle.vision.ops.nms: kept indices, descending score, greedy IoU
+    suppression (eager op — data-dependent output length)."""
+
+    def test_suppresses_overlaps_keeps_distinct(self):
+        from paddle_tpu.vision.ops import nms
+
+        boxes = np.array(
+            [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+             [0.5, 0.5, 10.5, 10.5]], np.float32,
+        )
+        scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+        keep = nms(paddle.to_tensor(boxes), 0.5,
+                   paddle.to_tensor(scores)).numpy()
+        # box 3 wins its cluster (highest score), boxes 0/1 suppressed
+        np.testing.assert_array_equal(keep, [3, 2])
+
+    def test_per_category_suppression_and_top_k(self):
+        from paddle_tpu.vision.ops import nms
+
+        boxes = np.array(
+            [[0, 0, 10, 10], [1, 1, 11, 11], [1, 1, 11, 11]], np.float32,
+        )
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        cats = np.array([0, 1, 0], np.int64)
+        keep = nms(paddle.to_tensor(boxes), 0.5,
+                   paddle.to_tensor(scores),
+                   category_idxs=paddle.to_tensor(cats),
+                   categories=[0, 1]).numpy()
+        # 1 overlaps 0 but is a different category -> survives; 2 (cat 0)
+        # overlaps 0 -> suppressed
+        np.testing.assert_array_equal(keep, [0, 1])
+        keep1 = nms(paddle.to_tensor(boxes), 0.5,
+                    paddle.to_tensor(scores),
+                    category_idxs=paddle.to_tensor(cats),
+                    categories=[0, 1], top_k=1).numpy()
+        np.testing.assert_array_equal(keep1, [0])
+
+
+class TestRoiPool:
+    def test_matches_quantized_max(self):
+        from paddle_tpu.vision.ops import roi_pool
+
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        out = roi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                       [1], 2).numpy().reshape(2, 2)
+        np.testing.assert_array_equal(out, [[5, 7], [13, 15]])
+
+    def test_grad_flows_to_max_elements(self):
+        from paddle_tpu.vision.ops import roi_pool
+
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        x = paddle.to_tensor(feat, stop_gradient=False)
+        rois = paddle.to_tensor(np.array([[0, 0, 3, 3]], np.float32))
+        roi_pool(x, rois, [1], 2).sum().backward()
+        g = x.grad.numpy().reshape(4, 4)
+        # exactly the 4 max positions get gradient 1
+        want = np.zeros((4, 4), np.float32)
+        for r, c in ((1, 1), (1, 3), (3, 1), (3, 3)):
+            want[r, c] = 1.0
+        np.testing.assert_array_equal(g, want)
+
+
+class TestColorTransforms:
+    def test_contrast_saturation_hue_shapes_and_bounds(self):
+        from paddle_tpu.vision.transforms import (
+            ColorJitter, ContrastTransform, HueTransform,
+            SaturationTransform,
+        )
+
+        img = np.random.RandomState(0).rand(3, 8, 8).astype(np.float32)
+        for t in (ContrastTransform(0.4), SaturationTransform(0.4),
+                  HueTransform(0.2), ColorJitter(0.4, 0.4, 0.4, 0.2)):
+            out = t(img)
+            assert out.shape == img.shape
+            assert out.dtype == np.float32
+            assert out.min() >= 0.0 and out.max() <= 1.0
+
+    def test_hue_preserves_luminance_grayscale_passthrough(self):
+        from paddle_tpu.vision.transforms import HueTransform
+
+        # mid-gray with small chroma so the rotated values stay inside
+        # [0, 1] — clipping would otherwise perturb the luma too
+        img = (0.5 + (np.random.RandomState(1).rand(3, 6, 6) - 0.5) * 0.1
+               ).astype(np.float32)
+        out = HueTransform(0.5)(img)
+        # YIQ rotation moves chroma, not luma
+        luma = np.array([0.299, 0.587, 0.114], np.float32)
+        np.testing.assert_allclose(
+            np.einsum("c,chw->hw", luma, out),
+            np.einsum("c,chw->hw", luma, img), atol=1e-5,
+        )
+        gray = np.random.rand(1, 6, 6).astype(np.float32)
+        np.testing.assert_array_equal(HueTransform(0.3)(gray), gray)
+
+    def test_random_rotation_zero_degrees_is_identity(self):
+        from paddle_tpu.vision.transforms import RandomRotation
+
+        img = np.random.RandomState(2).rand(3, 7, 7).astype(np.float32)
+        np.testing.assert_allclose(RandomRotation(0)(img), img)
+        np.testing.assert_allclose(
+            RandomRotation(0, interpolation="bilinear")(img), img,
+            rtol=1e-6,
+        )
+
+    def test_random_rotation_expand_holds_whole_image(self):
+        from paddle_tpu.vision.transforms import RandomRotation
+
+        img = np.ones((1, 10, 20), np.float32)
+        t = RandomRotation((90, 90), expand=True)  # exact 90 degrees
+        out = t(img)
+        # 90-degree rotation of 10x20 needs a 20x10 canvas; all mass kept
+        assert out.shape == (1, 20, 10)
+        np.testing.assert_allclose(out.sum(), img.sum())
+        cropped = RandomRotation((90, 90), expand=False)(img)
+        assert cropped.shape == (1, 10, 20)
+        assert cropped.sum() < img.sum()  # corners cut without expand
+
+
+class TestColorTransformLuma:
+    def test_contrast_blends_toward_luma_mean(self):
+        """Pure-red image: the contrast target is the ITU-R 601 luma
+        mean 0.299, not the unweighted channel mean 1/3."""
+        from paddle_tpu.vision.transforms import ContrastTransform
+
+        img = np.zeros((3, 4, 4), np.float32)
+        img[0] = 1.0
+        t = ContrastTransform(0.5)
+        np.random.seed(0)
+        factor = 1 + np.random.uniform(-0.5, 0.5)
+        np.random.seed(0)
+        out = t(img)
+        want = np.clip(img * factor + 0.299 * (1 - factor), 0, 1)
+        np.testing.assert_allclose(out, want, atol=1e-6)
