@@ -29,7 +29,15 @@ __all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate"]
 
 # op categories (imperative/amp_auto_cast.cc AmpOperators)
 WHITE_LIST = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum",
-              "bmm", "mm", "mv", "attention_scores", "attention_context"}
+              "bmm", "mm", "mv", "attention_scores", "attention_context",
+              "flash_attention"}
+# fused_layer_norm / fused_residual_layer_norm are deliberately on NEITHER
+# list: the Pallas kernels take bf16 activations as-is and do their
+# statistics in f32 internally — black-listing them would reintroduce the
+# f32 HBM round trip they exist to remove (the dense "layer_norm" stays
+# black-listed). fused_linear_cross_entropy likewise: its vocab-chunk
+# matmuls accumulate f32 via preferred_element_type while the [N, d]
+# hidden input stays in the compute dtype.
 BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "mean", "sum",
               "layer_norm", "exp", "log", "logsumexp",
               "softmax_with_cross_entropy"}

@@ -383,14 +383,23 @@ def in_spmd_region(axis_name: Optional[str] = None) -> bool:
 
 
 def init_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1,
-                     sp: int = 1) -> Mesh:
+                     sp: int = 1, dp_inner: int = 1) -> Mesh:
     """Build the job-wide hybrid mesh (dp, pp, sp, mp axes; mp innermost for
     ICI locality — model-parallel collectives are the latency-critical ones).
 
     The analog of the reference's per-strategy comm-ring construction
     (fleet meta_optimizers/common.py CollectiveHelper ring setup): here ONE
     declaration; each strategy consumes its axis by sharding on it.
-    """
+
+    `dp_inner > 1` factors the dp axis into TWO mesh axes ('dcn' outer x
+    'ici' inner, dp = dcn * dp_inner) — the two-level topology behind
+    DistributedStrategy.hierarchical_allreduce: anything sharded or
+    reduced over data-parallel uses the axis PAIR, so GSPMD emits the
+    grad reduction as reduce-scatter/all-reduce over the fast inner
+    (intra-pod ICI) axis composed with the slow outer (cross-pod DCN)
+    axis, instead of one flat ring spanning both fabrics (the reference's
+    hierarchical_allreduce inter/exter NCCL ring split,
+    fleet meta_optimizers/common.py)."""
     _ensure_init()
     devs = jax.devices()
     need = dp * mp * pp * sp
@@ -399,14 +408,49 @@ def init_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1,
             f"hybrid topology dp={dp} x pp={pp} x sp={sp} x mp={mp} needs "
             f"{need} devices, have {len(devs)}"
         )
-    arr = np.array(devs[:need]).reshape(dp, pp, sp, mp)
-    mesh = Mesh(arr, ("dp", "pp", "sp", "mp"))
+    if dp_inner > 1:
+        if dp % dp_inner:
+            raise ValueError(
+                f"hierarchical dp: dp={dp} not divisible by "
+                f"dp_inner={dp_inner}"
+            )
+        arr = np.array(devs[:need]).reshape(
+            dp // dp_inner, dp_inner, pp, sp, mp
+        )
+        mesh = Mesh(arr, ("dcn", "ici", "pp", "sp", "mp"))
+    else:
+        arr = np.array(devs[:need]).reshape(dp, pp, sp, mp)
+        mesh = Mesh(arr, ("dp", "pp", "sp", "mp"))
     _state.hybrid_mesh = mesh
     return mesh
 
 
 def hybrid_mesh() -> Optional[Mesh]:
     return _state.hybrid_mesh
+
+
+def dp_axes(mesh: Optional[Mesh] = None):
+    """The mesh axis (or axis pair) data-parallel work shards over:
+    'dp' on a flat mesh, ('dcn', 'ici') on a hierarchical one. The tuple
+    drops straight into a PartitionSpec element."""
+    m = mesh if mesh is not None else _state.hybrid_mesh
+    if m is not None and "ici" in m.axis_names:
+        return ("dcn", "ici")
+    return "dp"
+
+
+def dp_size(mesh: Optional[Mesh] = None) -> int:
+    """Total data-parallel degree of the mesh (product over dp axes)."""
+    m = mesh if mesh is not None else _state.hybrid_mesh
+    if m is None:
+        return 1
+    ax = dp_axes(m)
+    if isinstance(ax, tuple):
+        n = 1
+        for a in ax:
+            n *= m.shape[a]
+        return int(n)
+    return int(m.shape[ax]) if ax in m.shape else 1
 
 
 def mp_mesh() -> Mesh:

@@ -36,7 +36,11 @@ class HybridCommunicateGroup:
         self.mesh = mesh
 
     def _size(self, axis):
-        return self.mesh.shape[axis] if self.mesh is not None else 1
+        if self.mesh is None:
+            return 1
+        if axis == "dp":  # flat axis or the hierarchical dcn x ici pair
+            return comm.dp_size(self.mesh)
+        return self.mesh.shape[axis]
 
     def get_data_parallel_world_size(self):
         return self._size("dp")
@@ -123,11 +127,12 @@ class _DistributedOptimizer:
         mesh = getattr(self, "_constrain_mesh", None) or comm.hybrid_mesh()
         if mesh is None:
             return x
-        dp = mesh.shape["dp"]
+        dp = comm.dp_size(mesh)
+        dp_ax = comm.dp_axes(mesh)  # 'dp', or ('dcn','ici') hierarchical
 
         def constrain(axis):
             spec = P(*(
-                [None] * axis + ["dp"] + [None] * (x.ndim - axis - 1)
+                [None] * axis + [dp_ax] + [None] * (x.ndim - axis - 1)
             ))
             return jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, spec)
@@ -351,7 +356,35 @@ class Fleet:
                 "this job; set hybrid_configs degrees whose product (with "
                 "dp inferred when left at 1) equals the device count"
             )
-        mesh = comm.init_hybrid_mesh(dp=dp, mp=mp, pp=pp, sp=sp)
+        ici = 1
+        if self._strategy.hierarchical_allreduce and dp > 1:
+            ici = int(
+                self._strategy.hierarchical_allreduce_inter_nranks
+            )
+            if ici <= 0:
+                # auto: the largest proper divisor of dp — two REAL
+                # levels (the reference defaults inter_nranks to the
+                # 8-GPU node size; here the inner degree is a topology
+                # choice the operator pins explicitly when the dcn/ici
+                # boundary differs). A prime dp has no two-level
+                # factoring: fail loudly rather than silently flat.
+                ici = next(
+                    (d for d in range(dp // 2, 1, -1) if dp % d == 0), 0
+                )
+                if ici < 2:
+                    raise ValueError(
+                        f"hierarchical_allreduce: dp_degree={dp} has no "
+                        "two-level factoring (prime or 2); set "
+                        "hierarchical_allreduce_inter_nranks explicitly "
+                        "or disable the flag"
+                    )
+            if dp % ici:
+                raise ValueError(
+                    f"hierarchical_allreduce_inter_nranks={ici} must "
+                    f"divide dp_degree={dp}"
+                )
+        mesh = comm.init_hybrid_mesh(dp=dp, mp=mp, pp=pp, sp=sp,
+                                     dp_inner=ici)
         self._hcg = HybridCommunicateGroup(mesh)
         self._is_initialized = True
         return self

@@ -60,13 +60,20 @@ _DEFAULTS = {
     "hybrid_configs": {
         "dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sp_degree": 1,
     },
+    # two-level grad reduction (reference: hierarchical_allreduce +
+    # hierarchical_allreduce_inter_nranks inter/exter NCCL ring split).
+    # TPU-native: fleet.init factors the dp mesh axis into dcn x ici
+    # (inter_nranks = the fast inner degree; 0 = auto dp//2), and every
+    # dp-sharded spec/reduction uses the axis pair — GSPMD then emits the
+    # reduction per level instead of one flat ring across both fabrics.
+    "hierarchical_allreduce": False,
+    "hierarchical_allreduce_inter_nranks": 0,
     "dgc": False,
     "a_sync": False,
     # parity-accepted, no-op on TPU (XLA owns comm fusion/scheduling)
     "fuse_all_reduce_ops": True,
     "fuse_grad_size_in_MB": 32,
     "nccl_comm_num": 1,
-    "hierarchical_allreduce": False,
     "find_unused_parameters": False,
     "without_graph_optimization": False,
     "last_comm_group_size_MB": 1,

@@ -174,7 +174,7 @@ class ParallelMultiHeadAttention(Layer):
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, causal=True,
                  weight_attr=None, bias_attr=None,
-                 use_flash_attention=False):
+                 use_flash_attention=None):
         super().__init__()
         self.mesh = comm.mp_mesh()
         mp = self.mesh.shape["mp"]
@@ -188,10 +188,14 @@ class ParallelMultiHeadAttention(Layer):
         self.head_dim = embed_dim // num_heads
         self.causal = causal
         self.dropout = dropout
-        # route the softmax(QK^T)V core through the Pallas flash kernel
-        # (ops/pallas/flash_attention: K/V stream through the grid, no
-        # [T, T] score matrix in HBM). Attention-prob dropout needs the
-        # materialized probs, so the kernel path requires dropout == 0.
+        # softmax(QK^T)V core routing (ISSUE 4 flash-by-default):
+        #   None  -> AUTO: the Pallas flash kernel whenever the
+        #            functional.attention policy allows (causal,
+        #            dropout-free, TPU; PADDLE_FLASH_DEFAULT=0 escape
+        #            hatch) — dense fallback otherwise;
+        #   True  -> force the kernel (requires dropout == 0: flash
+        #            never materializes the attention probabilities);
+        #   False -> force the dense materialized-score path.
         if use_flash_attention and dropout:
             raise ValueError(
                 "use_flash_attention requires dropout=0.0: the flash "
@@ -217,22 +221,16 @@ class ParallelMultiHeadAttention(Layer):
         qkv = qkv.reshape([B, T, 3, H, dh]).transpose([2, 0, 3, 1, 4])
         qkv = _constrain(qkv, self.mesh, P(None, None, "mp", None, None))
         q, k, v = qkv[0], qkv[1], qkv[2]  # [B, H, T, dh]
-        if self.use_flash_attention:
-            from ..ops.pallas import flash_attention
+        from ..nn.functional import attention as attn_route
 
-            # largest power-of-two tile <= 256 that divides T (the
-            # kernel requires S % block == 0; odd lengths fall back to
-            # small tiles rather than crashing)
-            block = 256
-            while block > 1 and T % block != 0:
-                block //= 2
-            interpret = jax.default_backend() != "tpu"
-            ctx = AG.apply(
-                lambda q_, k_, v_: flash_attention(
-                    q_, k_, v_, self.causal, block, block, None, interpret
-                ),
-                (q, k, v), name="flash_attention",
+        route_flash = self.use_flash_attention
+        if route_flash is None:  # AUTO: the flash-by-default policy
+            route_flash = attn_route.flash_routable(
+                T, T, causal=self.causal,
+                dropout_active=bool(self.dropout) and self.training,
             )
+        if route_flash:
+            ctx = attn_route.flash_core(q, k, v, causal=self.causal)
             ctx = ctx.transpose([0, 2, 1, 3]).reshape([B, T, H * dh])
             ctx = _constrain(ctx, self.mesh, P(None, None, "mp"))
             return self.out_proj(ctx)
@@ -260,11 +258,12 @@ class ParallelGPTBlock(Layer):
     the unit the BASELINE GPT-3 configs stack inside pipeline stages."""
 
     def __init__(self, d_model, num_heads, dim_feedforward=None,
-                 dropout=0.0, causal=True, use_flash_attention=False):
+                 dropout=0.0, causal=True, use_flash_attention=None):
         super().__init__()
         from ..nn.layers.norm import LayerNorm
 
         ffn = dim_feedforward or 4 * d_model
+        self._d_model = d_model
         self.ln1 = LayerNorm(d_model)
         self.attn = ParallelMultiHeadAttention(
             d_model, num_heads, dropout=dropout, causal=causal,
@@ -276,8 +275,14 @@ class ParallelGPTBlock(Layer):
         self.dropout = dropout
 
     def forward(self, x):
-        h = x + self.attn(self.ln1(x))
-        m = F.gelu(self.fc1(self.ln2(h)))
+        # residual-add + LN fused in one Pallas pass on TPU (the sum is
+        # formed once; both the residual stream and its normalization
+        # come back) — dense x+LN fallback elsewhere
+        h, n2 = F.fused_residual_layer_norm(
+            x, self.attn(self.ln1(x)), [self._d_model],
+            self.ln2.weight, self.ln2.bias, self.ln2._epsilon,
+        )
+        m = F.gelu(self.fc1(n2))
         if self.dropout:
             m = F.dropout(m, p=self.dropout, training=self.training)
         return h + self.fc2(m)

@@ -25,9 +25,13 @@ from ..nn.layer import Layer
 from . import comm
 
 
-def shard_batch(x, mesh, axis_name: str = "dp") -> Tensor:
+def shard_batch(x, mesh, axis_name="dp") -> Tensor:
     """Lay a global batch out sharded over `axis_name` on its leading dim —
-    the one input-sharding helper every data-parallel surface uses."""
+    the one input-sharding helper every data-parallel surface uses. On a
+    hierarchical mesh (hierarchical_allreduce: dp factored into dcn x ici)
+    'dp' resolves to the axis pair."""
+    if axis_name == "dp" and "dp" not in mesh.axis_names:
+        axis_name = comm.dp_axes(mesh)
     raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
     return Tensor._wrap(
         jax.device_put(raw, NamedSharding(mesh, P(axis_name))),

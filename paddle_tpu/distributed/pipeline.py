@@ -187,7 +187,7 @@ class _Stage:
             p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
         for b in self.b_objs:
             b._data = jax.device_put(b._data, NamedSharding(mesh, P()))
-        self.batch_sharding = NamedSharding(mesh, P("dp"))
+        self.batch_sharding = NamedSharding(mesh, P(comm.dp_axes(mesh)))
         self._fwd = jax.jit(self._fwd_fn)
         self._bwd = jax.jit(self._bwd_fn)
 
@@ -270,9 +270,13 @@ class PipelineParallel(Layer):
 
         seg = layer.segment(S)
         self.stages: List[_Stage] = []
-        devs = mesh.devices  # (dp, pp, sp, mp)
+        devs = mesh.devices  # (dp, pp, sp, mp) / (dcn, ici, pp, sp, mp)
+        hier = "ici" in mesh.axis_names
         for s in range(S):
-            sub = Mesh(devs[:, s], ("dp", "sp", "mp"))
+            if hier:  # hierarchical dp keeps both levels in the submesh
+                sub = Mesh(devs[:, :, s], ("dcn", "ici", "sp", "mp"))
+            else:
+                sub = Mesh(devs[:, s], ("dp", "sp", "mp"))
             mod = Sequential(*[layer.funcs[i] for i in seg[s]])
             self.stages.append(
                 _Stage(mod, sub, is_last=(s == S - 1),
@@ -324,7 +328,7 @@ class PipelineParallel(Layer):
                 f"batch {x.shape[0]} not divisible by accumulate_steps {M}"
             )
         mb = x.shape[0] // M
-        dp = self.mesh.shape["dp"]
+        dp = comm.dp_size(self.mesh)
         if mb % dp != 0:
             raise ValueError(
                 f"microbatch size {mb} (batch {x.shape[0]} / "

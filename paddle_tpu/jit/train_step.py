@@ -363,9 +363,15 @@ class TrainStep:
                 None,                                    # outs
                 None,                                    # scaler_state
             )
+            # params, opt state, buffers — and the loss-scaler state when
+            # dynamic scaling is on (replaced every step, same shape) —
+            # are donated so XLA updates them in place in HBM
+            donate = (0, 1, 2) if self._donate else ()
+            if self._donate and self._loss_scale_cfg is not None:
+                donate = donate + (6,)
             self._jitted = jax.jit(
                 self._step_fn,
-                donate_argnums=(0, 1, 2) if self._donate else (),
+                donate_argnums=donate,
                 out_shardings=out_sh,
             )
         opt._step_count += 1

@@ -5,7 +5,14 @@ from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
-from . import activation, common, conv, loss, norm, pooling  # noqa: F401
+from .attention import (  # noqa: F401
+    flash_default_enabled,
+    flash_routable,
+    scaled_dot_product_attention,
+)
+from . import (  # noqa: F401
+    activation, attention, common, conv, loss, norm, pooling,
+)
 
 # -- fluid-era functional tail (round 5): real ops + aliases ---------------
 from .extras import (  # noqa: F401,E402
@@ -53,7 +60,7 @@ _SEQUENCE_ALIASES = [
     "sequence_pad", "sequence_pool", "sequence_reverse",
     "sequence_slice", "sequence_softmax", "sequence_unpad",
     "sequence_concat", "sequence_expand_as", "sequence_reshape",
-    "sequence_scatter",
+    "sequence_scatter", "sequence_erase",
 ]
 _OPS_ALIASES = {"erf": "math", "diag_embed": "manipulation"}
 

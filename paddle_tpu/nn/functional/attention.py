@@ -1,0 +1,143 @@
+"""Attention routing policy — flash attention by DEFAULT on the causal
+decoder hot path (ISSUE 4 tentpole).
+
+The Pallas flash kernel (ops/pallas/flash_attention.py) has been the
+measured-faster path since round 5 (1.3 ms vs 3.6 ms dense at S=2048
+causal) but was only reachable through an opt-in flag plus the
+`PADDLE_BENCH_GPT_FLASH` bench side channel. This module centralizes the
+routing decision so `nn.MultiHeadAttention` and
+`distributed.ParallelMultiHeadAttention` pick the kernel automatically
+whenever it computes the same function as the dense path:
+
+  * causal self/cross attention with NO arbitrary mask (the kernel masks
+    by global position; an additive mask would need materialized scores),
+  * no attention-probability dropout while training (flash never
+    materializes the probabilities),
+  * no need_weights / incremental-decode cache,
+  * sequence lengths tileable to >= 8 (the kernel requires S % block == 0;
+    degenerate tiles are slower than dense),
+  * a TPU backend — compiled Pallas is TPU-only; every other backend
+    falls back to the dense XLA path (the interpreter is for tests only).
+
+Escape hatch: `PADDLE_FLASH_DEFAULT=0` restores dense routing everywhere
+(set it when bisecting a numerics question back to the materialized-score
+path). `PADDLE_FLASH_DEFAULT=interpret` forces routing through the Pallas
+interpreter off-TPU — CPU CI uses it to exercise the routed code path.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ...core import autograd as AG
+
+__all__ = [
+    "flash_default_enabled", "flash_routable", "flash_core",
+    "scaled_dot_product_attention",
+]
+
+
+def flash_default_enabled() -> bool:
+    v = os.environ.get("PADDLE_FLASH_DEFAULT", "1").strip().lower()
+    return v not in ("0", "false", "off")
+
+
+def _interpret_forced() -> bool:
+    return os.environ.get(
+        "PADDLE_FLASH_DEFAULT", ""
+    ).strip().lower() == "interpret"
+
+
+def _flash_block(s: int) -> int:
+    """Largest power-of-two tile <= 256 dividing s (kernel contract:
+    S % block == 0)."""
+    b = 256
+    while b > 1 and s % b:
+        b //= 2
+    return b
+
+
+def flash_routable(seq_q, seq_k, *, causal, has_mask=False,
+                   dropout_active=False, need_weights=False,
+                   has_cache=False) -> bool:
+    """Would the default router send this attention to the flash kernel?"""
+    if not flash_default_enabled():
+        return False
+    if not causal or has_mask or dropout_active or need_weights \
+            or has_cache:
+        return False
+    # the kernel's causal mask compares ABSOLUTE positions from offset 0;
+    # Sq != Sk (decode-append / cross shapes) needs the end-aligned dense
+    # form — routing it would mask the wrong triangle
+    if int(seq_q) != int(seq_k):
+        return False
+    if jax.default_backend() == "tpu":
+        # single-chip only, same guard as blockwise_attention: a
+        # pallas_call inside a multi-device GSPMD program has no
+        # partitioning rule — multichip jobs keep the dense form (whose
+        # einsums GSPMD shards) unless the caller opts in explicitly
+        if len(jax.devices()) != 1:
+            return False
+    elif not _interpret_forced():
+        return False
+    return _flash_block(int(seq_q)) >= 8 and _flash_block(int(seq_k)) >= 8
+
+
+def flash_core(q, k, v, *, causal=True, scale=None):
+    """Run the Pallas flash kernel on [B, H, S, D] Tensors (tape-recorded;
+    block sizes derived from the sequence lengths)."""
+    from ...ops.pallas import flash_attention
+
+    bq = _flash_block(int(q.shape[2]))
+    bk = _flash_block(int(k.shape[2]))
+    interpret = jax.default_backend() != "tpu"
+    return AG.apply(
+        lambda a, b, c: flash_attention(
+            a, b, c, causal, bq, bk, scale, interpret
+        ),
+        (q, k, v), name="flash_attention",
+    )
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """Routed softmax attention over [B, H, S, D] Tensors.
+
+    The flash kernel handles the causal/mask-free/dropout-free case (on
+    TPU); everything else runs the dense XLA form with materialized
+    scores. Dense+causal applies the triangular mask explicitly, so the
+    two routes compute the same function.
+    """
+    import jax.numpy as jnp
+
+    dropout_active = bool(dropout_p) and training
+    if flash_routable(query.shape[2], key.shape[2], causal=is_causal,
+                      has_mask=attn_mask is not None,
+                      dropout_active=dropout_active):
+        return flash_core(query, key, value, causal=is_causal, scale=scale)
+
+    sc = scale if scale is not None else int(query.shape[-1]) ** -0.5
+    Sq, Sk = int(query.shape[2]), int(key.shape[2])
+
+    def score_fn(qr, kr, *m):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qr, kr) * sc
+        if m:
+            s = s + m[0]
+        if is_causal:
+            qpos = jnp.arange(Sq) + (Sk - Sq)  # aligned last positions
+            kpos = jnp.arange(Sk)
+            s = jnp.where(kpos[None, :] > qpos[:, None], -1e9, s)
+        return jax.nn.softmax(s, axis=-1)
+
+    args = (query, key) + ((attn_mask,) if attn_mask is not None else ())
+    weights = AG.apply(score_fn, args, name="attention_scores")
+    if dropout_active:
+        from .common import dropout as _dropout
+
+        weights = _dropout(weights, dropout_p, training=True)
+    return AG.apply(
+        lambda w, vr: jnp.einsum("bhqk,bhkd->bhqd", w, vr),
+        (weights, value), name="attention_context",
+    )

@@ -3,8 +3,12 @@ operators/cross_entropy_op.*, softmax_with_cross_entropy_op.*,
 math/cross_entropy.*)."""
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core import autograd as AG
 from ...core.tensor import Tensor
@@ -14,7 +18,7 @@ __all__ = [
     "mse_loss", "l1_loss", "nll_loss", "kl_div", "smooth_l1_loss",
     "margin_ranking_loss", "hinge_embedding_loss", "cosine_embedding_loss",
     "ctc_loss", "square_error_cost", "sigmoid_focal_loss", "log_loss",
-    "npair_loss", "triplet_margin_loss",
+    "npair_loss", "triplet_margin_loss", "fused_linear_cross_entropy",
 ]
 
 
@@ -73,6 +77,177 @@ def cross_entropy(
 
     args = (input, label) + ((weight,) if weight is not None else ())
     return AG.apply(f, args, name="cross_entropy")
+
+
+# ---------------------------------------------------------------------------
+# Blockwise fused head-projection + softmax cross-entropy (ISSUE 4
+# tentpole piece 4): the 32k-vocab LM head's loss without ever
+# materializing the [B*S, V] f32 logits or their gradient at once.
+# ---------------------------------------------------------------------------
+
+_CE_NEG = -1e30
+
+
+def _ce_chunk_default() -> int:
+    try:
+        return int(os.environ.get("PADDLE_CE_CHUNK", "8192") or 0)
+    except ValueError:
+        return 8192
+
+
+def _ce_chunk_ranges(h, wp, bp, chunk, V):
+    """Shared per-chunk logits producer: logits_c = h @ W_c + b_c in f32,
+    padded/tail columns masked to -inf."""
+    def at(c):
+        lo = c * chunk
+        wc = jax.lax.dynamic_slice_in_dim(wp, lo, chunk, 1)
+        logits = jax.lax.dot_general(
+            h, wc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        logits = logits + jax.lax.dynamic_slice_in_dim(
+            bp, lo, chunk, 0
+        ).astype(jnp.float32)[None, :]
+        col = lo + jnp.arange(chunk)
+        logits = jnp.where(col[None, :] < V, logits, _CE_NEG)
+        return lo, col, logits
+
+    return at
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_linear_ce(h, w, b, labels, chunk, ignore_index):
+    """Per-row loss [N] of softmax-CE over logits = h @ w + b, streamed
+    over vocab chunks (online logsumexp forward; the backward recomputes
+    each chunk's softmax from the saved lse — FlashAttention's recompute
+    trade applied to the vocab axis). This is also the shape
+    VocabParallel wants: chunks align with vocab shards, so each mp rank
+    streams its own slice."""
+    loss, _ = _flce_forward(h, w, b, labels, chunk, ignore_index)
+    return loss
+
+
+def _flce_forward(h, w, b, labels, chunk, ignore_index):
+    N, d = h.shape
+    V = w.shape[1]
+    n_chunks = -(-V // chunk)
+    Vp = n_chunks * chunk
+    wp = jnp.pad(w, ((0, 0), (0, Vp - V)))
+    bp = jnp.pad(b, (0, Vp - V))
+    labels = labels.astype(jnp.int32)
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    chunk_at = _ce_chunk_ranges(h, wp, bp, chunk, V)
+
+    def body(c, carry):
+        m, l, picked = carry
+        lo, col, logits = chunk_at(c)
+        rel = safe - lo
+        inside = (rel >= 0) & (rel < chunk)
+        relc = jnp.clip(rel, 0, chunk - 1)
+        p = jnp.take_along_axis(logits, relc[:, None], axis=1)[:, 0]
+        picked = jnp.where(inside, p, picked)
+        m_new = jnp.maximum(m, logits.max(axis=1))
+        l = l * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]
+        ).sum(axis=1)
+        return m_new, l, picked
+
+    m0 = jnp.full((N,), _CE_NEG, jnp.float32)
+    l0 = jnp.zeros((N,), jnp.float32)
+    p0 = jnp.zeros((N,), jnp.float32)
+    m, l, picked = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, p0))
+    lse = m + jnp.log(l)
+    loss = jnp.where(valid, lse - picked, 0.0)
+    return loss, lse
+
+
+def _flce_fwd_rule(h, w, b, labels, chunk, ignore_index):
+    loss, lse = _flce_forward(h, w, b, labels, chunk, ignore_index)
+    return loss, (h, w, b, labels, lse)
+
+
+def _flce_bwd_rule(chunk, ignore_index, res, g):
+    h, w, b, labels, lse = res
+    N, d = h.shape
+    V = w.shape[1]
+    n_chunks = -(-V // chunk)
+    Vp = n_chunks * chunk
+    wp = jnp.pad(w, ((0, 0), (0, Vp - V)))
+    bp = jnp.pad(b, (0, Vp - V))
+    labels = labels.astype(jnp.int32)
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    geff = jnp.where(valid, g.astype(jnp.float32), 0.0)
+    chunk_at = _ce_chunk_ranges(h, wp, bp, chunk, V)
+
+    def body(c, carry):
+        dh, dw, db = carry
+        lo, col, logits = chunk_at(c)
+        p = jnp.exp(logits - lse[:, None])          # masked cols -> 0
+        onehot = (col[None, :] == safe[:, None]) & valid[:, None]
+        S = (p - onehot.astype(jnp.float32)) * geff[:, None]
+        dh = dh + jax.lax.dot_general(
+            S, jax.lax.dynamic_slice_in_dim(wp, lo, chunk, 1),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        dwc = jax.lax.dot_general(
+            h, S, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # [d, chunk]
+        dw = jax.lax.dynamic_update_slice_in_dim(
+            dw, dwc.astype(dw.dtype), lo, 1
+        )
+        db = jax.lax.dynamic_update_slice_in_dim(
+            db, S.sum(axis=0).astype(db.dtype), lo, 0
+        )
+        return dh, dw, db
+
+    dh0 = jnp.zeros((N, d), jnp.float32)
+    dw0 = jnp.zeros((d, Vp), w.dtype)
+    db0 = jnp.zeros((Vp,), b.dtype)
+    dh, dw, db = jax.lax.fori_loop(0, n_chunks, body, (dh0, dw0, db0))
+    dlabels = np.zeros(labels.shape, jax.dtypes.float0)
+    return dh.astype(h.dtype), dw[:, :V], db[:V], dlabels
+
+
+_fused_linear_ce.defvjp(_flce_fwd_rule, _flce_bwd_rule)
+
+
+def fused_linear_cross_entropy(input, weight, bias=None, label=None,
+                               chunk=None, ignore_index=-100,
+                               reduction="mean", name=None):
+    """Softmax cross-entropy of `input @ weight + bias` against `label`,
+    streamed over vocab chunks of width `chunk` (default
+    `PADDLE_CE_CHUNK`, 8192): the [N, V] f32 logits and their gradient
+    exist only one chunk at a time. `input` is the pre-head hidden state
+    [N, d]; `weight` [d, V] / `bias` [V] are the LM-head parameters
+    (pass `model.head.weight` — grads flow to them through the op).
+    `chunk<=0` (or `PADDLE_CE_CHUNK=0`) is the dense escape hatch:
+    materialize logits and use the standard `cross_entropy`."""
+    chunk = _ce_chunk_default() if chunk is None else int(chunk)
+    V = int(weight.shape[1])
+    if chunk <= 0 or chunk >= V:
+        from .common import linear as _linear
+
+        return cross_entropy(
+            _linear(input, weight, bias), label,
+            ignore_index=ignore_index, reduction=reduction,
+        )
+
+    def f(h, wt, lbl, *bb):
+        braw = bb[0] if bb else jnp.zeros((V,), jnp.float32)
+        li = lbl
+        if li.ndim == 2:  # (N, 1) hard labels
+            li = jnp.squeeze(li, axis=-1)
+        rows = _fused_linear_ce(h, wt, braw, li, chunk, ignore_index)
+        if reduction == "mean":
+            valid = li.astype(jnp.int32) != ignore_index
+            return jnp.sum(rows) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(rows, reduction)
+
+    args = (input, weight, label) + ((bias,) if bias is not None else ())
+    return AG.apply(f, args, name="fused_linear_cross_entropy")
 
 
 def square_error_cost(input, label):

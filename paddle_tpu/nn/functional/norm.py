@@ -12,7 +12,8 @@ import jax.numpy as jnp
 from ...core import autograd as AG
 from ...core.tensor import Tensor
 
-__all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm", "normalize", "local_response_norm"]
+__all__ = ["batch_norm", "layer_norm", "fused_residual_layer_norm",
+           "group_norm", "instance_norm", "normalize", "local_response_norm"]
 
 
 def _stat_axes(ndim, data_format):
@@ -125,11 +126,55 @@ def batch_norm(
     return AG.apply(f, args, name="batch_norm")
 
 
+def _fused_ln_interpret(raw, normalized_shape, weight, bias):
+    """Route LayerNorm to the Pallas fused kernel? Returns the kernel's
+    `interpret` flag, or None for the dense XLA path.
+
+    Eligibility: last-axis-only normalization with both affine params, a
+    lane-tileable layout (D % 128 == 0, rows % 8 — the MXU/VPU tiling
+    floor), a float dtype, and a TPU backend. `PADDLE_FUSED_LN=0`
+    disables the kernel (dense escape hatch); `=interpret` forces the
+    routed path through the Pallas interpreter off-TPU (CPU CI)."""
+    import os
+
+    mode = os.environ.get("PADDLE_FUSED_LN", "1").strip().lower()
+    if mode in ("0", "false", "off"):
+        return None
+    if weight is None or bias is None or len(normalized_shape) != 1:
+        return None
+    if raw.ndim < 2 or raw.dtype not in (jnp.float32, jnp.bfloat16):
+        return None
+    D = raw.shape[-1]
+    rows = raw.size // D if D else 0
+    row_floor = 16 if raw.dtype == jnp.bfloat16 else 8
+    if D % 128 != 0 or rows == 0 or rows % row_floor != 0:
+        return None
+    if jax.default_backend() == "tpu":
+        # single chip only (blockwise_attention's guard): a pallas_call
+        # inside a multi-device GSPMD program has no partitioning rule —
+        # multichip programs keep the dense form XLA can shard
+        return False if len(jax.devices()) == 1 else None
+    return True if mode == "interpret" else None
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
-    nd = len(tuple(normalized_shape))
+    normalized_shape = tuple(normalized_shape)
+    nd = len(normalized_shape)
     axes = tuple(range(x._data.ndim - nd, x._data.ndim))
+
+    interp = _fused_ln_interpret(x._data, normalized_shape, weight, bias)
+    if interp is not None:
+        from ...ops.pallas.layer_norm import fused_layer_norm
+
+        # dispatched OFF the amp black list on purpose: the kernel keeps
+        # bf16 activations bf16 (f32 stats internally) instead of the
+        # dense path's f32 HBM round trip (same move as r5 batch_norm)
+        return AG.apply(
+            lambda a, w, b: fused_layer_norm(a, w, b, epsilon, interp),
+            (x, weight, bias), name="fused_layer_norm",
+        )
 
     def f(a, *wb):
         mean = jnp.mean(a, axis=axes, keepdims=True)
@@ -145,6 +190,34 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
     args = (x,) + tuple(p for p in (weight, bias) if p is not None)
     return AG.apply(f, args, name="layer_norm")
+
+
+def fused_residual_layer_norm(x, residual, normalized_shape, weight=None,
+                              bias=None, epsilon=1e-5, name=None):
+    """(x + residual, LayerNorm(x + residual)) — the pre-LN block seam.
+
+    On TPU this is ONE Pallas kernel (ops/pallas/layer_norm.py
+    fused_add_layer_norm): the sum is formed once in VMEM and both the
+    residual stream and its normalization come back without the dense
+    path's extra HBM write+2 reads of the sum. Dense fallback elsewhere.
+    Returns (sum, normalized) Tensors.
+    """
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    normalized_shape = tuple(normalized_shape)
+
+    interp = _fused_ln_interpret(x._data, normalized_shape, weight, bias)
+    if interp is not None and x._data.shape == residual._data.shape:
+        from ...ops.pallas.layer_norm import fused_add_layer_norm
+
+        return AG.apply(
+            lambda a, r, w, b: fused_add_layer_norm(
+                a, r, w, b, epsilon, interp
+            ),
+            (x, residual, weight, bias), name="fused_residual_layer_norm",
+        )
+    s = x + residual
+    return s, layer_norm(s, normalized_shape, weight, bias, epsilon)
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,

@@ -281,7 +281,20 @@ class Layer:
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):
-        """Load values into existing params/buffers (checkpoint.py analog)."""
+        """Load values into existing params/buffers (checkpoint.py analog).
+
+        Sublayers may define `_convert_legacy_state_dict(sd, prefix)` to
+        translate renamed/refactored checkpoint keys before matching —
+        e.g. MultiHeadAttention merges pre-fusion q/k/v projection
+        entries into its fused qkv_proj parameter, so old checkpoints
+        keep round-tripping through refactored layers."""
+        state_dict = dict(state_dict)
+        for lname, layer in self.named_sublayers(include_self=True):
+            conv = getattr(layer, "_convert_legacy_state_dict", None)
+            if conv is not None:
+                state_dict = conv(
+                    state_dict, f"{lname}." if lname else ""
+                )
         own = self.state_dict()
         missing = []
         for name, target in own.items():

@@ -40,7 +40,18 @@ def _convert_attention_mask(attn_mask, dtype):
 
 
 class MultiHeadAttention(Layer):
-    """reference: nn/layer/transformer.py MultiHeadAttention."""
+    """reference: nn/layer/transformer.py MultiHeadAttention.
+
+    Decoder-hot-path form (ISSUE 4): when kdim == vdim == embed_dim the
+    Q/K/V projections are ONE fused `[d, 3d]` matmul (`qkv_proj`) —
+    one MXU dispatch instead of three under-filled ones. Pre-fusion
+    checkpoints (`q_proj.*`/`k_proj.*`/`v_proj.*` keys) still load:
+    `_convert_legacy_state_dict` merges them (Layer.set_state_dict calls
+    the hook on every sublayer). Causal, mask-free, dropout-free
+    attention routes to the Pallas flash kernel by default on TPU
+    (functional.attention policy; `PADDLE_FLASH_DEFAULT=0` restores
+    dense routing).
+    """
 
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
@@ -71,10 +82,49 @@ class MultiHeadAttention(Layer):
         self.head_dim = embed_dim // num_heads
         if self.head_dim * num_heads != embed_dim:
             raise ValueError("embed_dim must be divisible by num_heads")
-        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
-        self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
-        self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
+        self._fused_qkv = (self.kdim == embed_dim
+                           and self.vdim == embed_dim)
+        if self._fused_qkv:
+            self.qkv_proj = Linear(embed_dim, 3 * embed_dim, weight_attr,
+                                   bias_attr)
+        else:
+            self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+            self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
+            self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    # -- fused-QKV plumbing --------------------------------------------------
+    def _proj(self, x, which):
+        """Project with the q/k/v slice of the fused weight (0/1/2)."""
+        if not self._fused_qkv:
+            return (self.q_proj, self.k_proj, self.v_proj)[which](x)
+        d = self.embed_dim
+        w = self.qkv_proj.weight[:, which * d:(which + 1) * d]
+        b = self.qkv_proj.bias
+        if b is not None:
+            b = b[which * d:(which + 1) * d]
+        return F.linear(x, w, b)
+
+    def _convert_legacy_state_dict(self, sd, prefix):
+        """Merge pre-fusion q_proj/k_proj/v_proj checkpoint entries into
+        the fused qkv_proj keys (state-dict round-trip compatibility)."""
+        if not self._fused_qkv:
+            return sd
+        import numpy as np
+
+        for leaf, axis in (("weight", 1), ("bias", 0)):
+            keys = [f"{prefix}{p}_proj.{leaf}" for p in ("q", "k", "v")]
+            if not all(k in sd for k in keys):
+                continue
+            parts = [
+                v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                for v in (sd[k] for k in keys)
+            ]
+            sd = dict(sd)
+            for k in keys:
+                sd.pop(k)
+            sd[f"{prefix}qkv_proj.{leaf}"] = np.concatenate(parts, axis=axis)
+        return sd
 
     def _split_heads(self, x):
         from ...ops.manipulation import reshape, transpose
@@ -85,8 +135,10 @@ class MultiHeadAttention(Layer):
 
     def gen_cache(self, key, value=None, type=None):
         if type == MultiHeadAttention.StaticCache:
-            k = self._split_heads(self.k_proj(key))
-            v = self._split_heads(self.v_proj(value if value is not None else key))
+            k = self._split_heads(self._proj(key, 1))
+            v = self._split_heads(
+                self._proj(value if value is not None else key, 2)
+            )
             return MultiHeadAttention.StaticCache(k, v)
         B = key.shape[0]
         import numpy as np
@@ -98,18 +150,33 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = key if value is None else value
 
-        q = self._split_heads(self.q_proj(query))
+        if (self._fused_qkv and key is query and value is query
+                and not isinstance(cache, MultiHeadAttention.StaticCache)):
+            # self-attention: ONE [B, T, 3d] projection, split afterwards
+            from ...ops.manipulation import reshape, transpose
+
+            B, T = query.shape[0], query.shape[1]
+            qkv = self.qkv_proj(query)
+            qkv = reshape(qkv, [B, T, 3, self.num_heads, self.head_dim])
+            qkv = transpose(qkv, [2, 0, 3, 1, 4])  # 3, B, H, T, dh
+            q, k, v = qkv[0], qkv[1], qkv[2]
+        else:
+            q = self._split_heads(self._proj(query, 0))
+            k = v = None
         if isinstance(cache, MultiHeadAttention.StaticCache):
             k, v = cache.k, cache.v
         else:
-            k = self._split_heads(self.k_proj(key))
-            v = self._split_heads(self.v_proj(value))
+            if k is None:
+                k = self._split_heads(self._proj(key, 1))
+                v = self._split_heads(self._proj(value, 2))
             if isinstance(cache, MultiHeadAttention.Cache):
                 from ...ops.manipulation import concat
 
                 k = concat([cache.k, k], axis=2)
                 v = concat([cache.v, v], axis=2)
                 cache = MultiHeadAttention.Cache(k, v)
+
+        mask = _convert_attention_mask(attn_mask, q._data.dtype)
 
         if self.attn_impl != "dense":
             # flash-style paths never materialize the weights and use
@@ -153,17 +220,37 @@ class MultiHeadAttention(Layer):
                     use_pallas=(self.attn_impl == "ring_pallas"),
                 )
             weights = None
+        elif not self.need_weights:
+            # ONE implementation of routed attention (ISSUE 4): the
+            # policy functional sends causal/mask-free/dropout-free
+            # attention to the Pallas flash kernel on TPU
+            # (PADDLE_FLASH_DEFAULT=0 escape hatch) and computes the
+            # dense masked form — including causal masking, which the
+            # pre-r06 dense path silently dropped — otherwise
+            weights = None
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=self.dropout,
+                is_causal=self.causal, training=self.training,
+            )
         else:
             out = None
 
-        mask = _convert_attention_mask(attn_mask, q._data.dtype)
         scale = self.head_dim ** -0.5
 
         if out is None:
+            Sq, Sk = q.shape[2], k.shape[2]
+            causal_here = self.causal  # need_weights path masks too
+
             def score_fn(qr, kr, *m):
                 scores = jnp.einsum("bhqd,bhkd->bhqk", qr, kr) * scale
                 if m:
                     scores = scores + m[0]
+                if causal_here:
+                    qpos = jnp.arange(Sq) + (Sk - Sq)
+                    kpos = jnp.arange(Sk)
+                    scores = jnp.where(
+                        kpos[None, :] > qpos[:, None], -1e9, scores
+                    )
                 return jax.nn.softmax(scores, axis=-1)
 
             args = (q, k) + ((mask,) if mask is not None else ())

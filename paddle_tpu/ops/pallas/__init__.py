@@ -1,5 +1,9 @@
 """Pallas TPU kernels — hand-tiled hot ops (SURVEY.md §2.4 TPU mapping:
 'dense op layer collapses into XLA ops + Pallas kernels')."""
 from .flash_attention import flash_attention  # noqa: F401
+from .layer_norm import (  # noqa: F401
+    fused_add_layer_norm,
+    fused_layer_norm,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_layer_norm", "fused_add_layer_norm"]

@@ -347,7 +347,39 @@ def sequence_enumerate(x, win_size, pad_value=0, lengths=None, name=None):
 
 
 __all__ += ["sequence_concat", "sequence_expand_as", "sequence_reshape",
-            "sequence_scatter"]
+            "sequence_scatter", "sequence_erase"]
+
+
+def sequence_erase(x, tokens, lengths=None, name=None):
+    """sequence_erase_op in padded form: drop every occurrence of the ids
+    in `tokens` from each row's valid prefix, compacting the survivors
+    left (stable order). Output keeps the [B, T] padded shape (zeros past
+    the new end); returns (out, new_lengths) — static shapes, the LoD
+    policy's dense+lengths encoding of the reference's shrinking rows."""
+    x = as_tensor(x)
+    tokens = tuple(int(t) for t in tokens)
+    args = (x,) if lengths is None else (x, as_tensor(lengths))
+
+    def f(ids, *ln):
+        B, T = ids.shape
+        pos = jnp.arange(T)
+        lens = ln[0] if ln else jnp.full((B,), T, jnp.int32)
+        keep = pos[None, :] < lens[:, None]
+        for t in tokens:
+            keep = keep & (ids != t)
+        # stable left-compaction: sort by (dropped, position)
+        order = jnp.argsort(
+            jnp.where(keep, 0, 1) * T + pos[None, :], axis=1
+        )
+        gathered = jnp.take_along_axis(ids, order, axis=1)
+        new_len = keep.sum(axis=1).astype(lens.dtype)
+        out = jnp.where(
+            pos[None, :] < new_len[:, None], gathered,
+            jnp.asarray(0, ids.dtype),
+        )
+        return out, new_len
+
+    return AG.apply_nondiff(f, args)
 
 
 def sequence_concat(x, name=None):

@@ -1,0 +1,471 @@
+"""Decoder hot-path seams (ISSUE 4 tentpole): flash-by-default routing
+(+ the PADDLE_FLASH_DEFAULT escape hatch), flash-vs-dense parity inside
+the GPT block, Pallas fused LayerNorm / residual-add+LN dispatch,
+blockwise fused vocab CE vs dense CE, and the fused-QKV state_dict
+round-trip against a pre-fusion checkpoint.
+
+CPU CI runs the Pallas kernels in interpreter mode
+(`PADDLE_FLASH_DEFAULT=interpret`, `PADDLE_FUSED_LN=interpret`); on the
+real TPU the same policies compile the kernels.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import comm
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.functional import attention as attn_route
+
+rng = np.random.RandomState(3)
+
+
+def _mesh():
+    if comm.hybrid_mesh() is None:
+        comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+
+
+# ---------------------------------------------------------------------------
+# routing policy + escape hatch
+# ---------------------------------------------------------------------------
+
+
+class TestFlashDefaultPolicy:
+    def test_routes_causal_dropout_free_only(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FLASH_DEFAULT", "interpret")
+        ok = dict(causal=True)
+        assert attn_route.flash_routable(128, 128, **ok)
+        assert not attn_route.flash_routable(128, 128, causal=False)
+        assert not attn_route.flash_routable(128, 128, causal=True,
+                                             has_mask=True)
+        assert not attn_route.flash_routable(128, 128, causal=True,
+                                             dropout_active=True)
+        assert not attn_route.flash_routable(128, 128, causal=True,
+                                             need_weights=True)
+        assert not attn_route.flash_routable(128, 128, causal=True,
+                                             has_cache=True)
+        # degenerate tiles (odd lengths) fall back to dense
+        assert not attn_route.flash_routable(127, 127, **ok)
+
+    def test_escape_hatch_disables_routing(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FLASH_DEFAULT", "0")
+        assert not attn_route.flash_default_enabled()
+        assert not attn_route.flash_routable(128, 128, causal=True)
+
+    def test_cpu_backend_defaults_to_dense(self, monkeypatch):
+        # compiled Pallas is TPU-only: without the interpret override the
+        # CPU backend must NOT route (the interpreter is test-only slow)
+        monkeypatch.delenv("PADDLE_FLASH_DEFAULT", raising=False)
+        assert attn_route.flash_default_enabled()
+        assert not attn_route.flash_routable(128, 128, causal=True)
+
+    def test_mha_dense_escape_hatch_matches_routed(self, monkeypatch):
+        paddle.seed(5)
+        mha = nn.MultiHeadAttention(32, 4, dropout=0.0, causal=True)
+        x = paddle.to_tensor(rng.rand(2, 64, 32).astype(np.float32),
+                             stop_gradient=False)
+        calls = []
+        real = attn_route.flash_core
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(attn_route, "flash_core", spy)
+        monkeypatch.setenv("PADDLE_FLASH_DEFAULT", "interpret")
+        routed = mha(x)
+        assert calls, "flash default did not route"
+        monkeypatch.setenv("PADDLE_FLASH_DEFAULT", "0")
+        calls.clear()
+        dense = mha(x)
+        assert not calls, "escape hatch still routed"
+        np.testing.assert_allclose(
+            routed.numpy(), dense.numpy(), rtol=2e-4, atol=2e-5
+        )
+
+
+class TestScaledDotProductAttention:
+    """The routed public functional: flash route == dense route on the
+    causal mask-free case; masked/non-causal cases take the dense form."""
+
+    def _qkv(self, B=2, H=2, S=32, D=16):
+        return [
+            paddle.to_tensor(rng.rand(B, H, S, D).astype(np.float32)
+                             - 0.5)
+            for _ in range(3)
+        ]
+
+    def test_causal_routes_match(self, monkeypatch):
+        q, k, v = self._qkv()
+        monkeypatch.setenv("PADDLE_FLASH_DEFAULT", "interpret")
+        routed = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        monkeypatch.setenv("PADDLE_FLASH_DEFAULT", "0")
+        dense = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(routed.numpy(), dense.numpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_mask_applies(self):
+        q, k, v = self._qkv()
+        S = q.shape[2]
+        mask = paddle.to_tensor(
+            np.triu(np.full((S, S), -1e9, np.float32), k=1)
+        )
+        with_mask = F.scaled_dot_product_attention(q, k, v,
+                                                   attn_mask=mask)
+        causal = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(with_mask.numpy(), causal.numpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# flash vs dense inside the GPT block (fwd + bwd, shared weights)
+# ---------------------------------------------------------------------------
+
+
+class TestGPTBlockFlashParity:
+    def _pair(self, T=64, d=32, heads=4):
+        from paddle_tpu.distributed import ParallelGPTBlock
+
+        _mesh()
+        paddle.seed(7)
+        dense = ParallelGPTBlock(d, heads, dropout=0.0,
+                                 use_flash_attention=False)
+        flash = ParallelGPTBlock(d, heads, dropout=0.0,
+                                 use_flash_attention=True)
+        flash.set_state_dict(dense.state_dict())
+        x = paddle.to_tensor(rng.rand(2, T, d).astype(np.float32),
+                             stop_gradient=False)
+        return dense, flash, x
+
+    def test_forward_matches(self):
+        dense, flash, x = self._pair()
+        np.testing.assert_allclose(
+            flash(x).numpy(), dense(x).numpy(), rtol=2e-4, atol=2e-5
+        )
+
+    def test_backward_matches(self):
+        dense, flash, x = self._pair()
+        flash(x).sum().backward()
+        gx = x.grad.numpy().copy()
+        g_qkv = flash.attn.qkv.weight.grad.numpy().copy()
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        dense(x2).sum().backward()
+        np.testing.assert_allclose(gx, x2.grad.numpy(), rtol=5e-4,
+                                   atol=5e-5)
+        np.testing.assert_allclose(
+            g_qkv, dense.attn.qkv.weight.grad.numpy(), rtol=5e-4,
+            atol=5e-4,
+        )
+
+    def test_auto_routing_in_block(self, monkeypatch):
+        """use_flash_attention=None (the default) follows the policy."""
+        from paddle_tpu.distributed import ParallelGPTBlock
+
+        _mesh()
+        paddle.seed(7)
+        auto = ParallelGPTBlock(32, 4, dropout=0.0)  # default: auto
+        dense = ParallelGPTBlock(32, 4, dropout=0.0,
+                                 use_flash_attention=False)
+        dense.set_state_dict(auto.state_dict())
+        x = paddle.to_tensor(rng.rand(2, 64, 32).astype(np.float32))
+        monkeypatch.setenv("PADDLE_FLASH_DEFAULT", "interpret")
+        out_auto = auto(x)
+        np.testing.assert_allclose(
+            out_auto.numpy(), dense(x).numpy(), rtol=2e-4, atol=2e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused LayerNorm dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestFusedLayerNorm:
+    def _data(self, R=32, D=128):
+        x = paddle.to_tensor(rng.rand(R, D).astype(np.float32) - 0.5,
+                             stop_gradient=False)
+        ln = nn.LayerNorm(D)
+        ln.weight.set_value((rng.rand(D).astype(np.float32) + 0.5))
+        ln.bias.set_value(rng.rand(D).astype(np.float32))
+        return ln, x
+
+    def test_layer_norm_dispatches_and_matches(self, monkeypatch):
+        ln, x = self._data()
+        monkeypatch.setenv("PADDLE_FUSED_LN", "interpret")
+        fused = ln(x)
+        monkeypatch.setenv("PADDLE_FUSED_LN", "0")
+        dense = ln(x)
+        np.testing.assert_allclose(fused.numpy(), dense.numpy(),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_backward_matches(self, monkeypatch):
+        ln, x = self._data()
+        monkeypatch.setenv("PADDLE_FUSED_LN", "interpret")
+        ln(x).sum().backward()
+        gx = x.grad.numpy().copy()
+        gw = ln.weight.grad.numpy().copy()
+        gb = ln.bias.grad.numpy().copy()
+        ln.weight.clear_grad()
+        ln.bias.clear_grad()
+        monkeypatch.setenv("PADDLE_FUSED_LN", "0")
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        ln(x2).sum().backward()
+        np.testing.assert_allclose(gx, x2.grad.numpy(), rtol=2e-4,
+                                   atol=2e-5)
+        np.testing.assert_allclose(gw, ln.weight.grad.numpy(), rtol=2e-4,
+                                   atol=2e-4)
+        np.testing.assert_allclose(gb, ln.bias.grad.numpy(), rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_ineligible_shapes_stay_dense(self, monkeypatch):
+        # D not a lane multiple -> dense path even when forced
+        monkeypatch.setenv("PADDLE_FUSED_LN", "interpret")
+        ln = nn.LayerNorm(96)
+        x = paddle.to_tensor(rng.rand(8, 96).astype(np.float32))
+        out = ln(x)  # must not crash in the kernel
+        ref = (x.numpy() - x.numpy().mean(-1, keepdims=True)) / np.sqrt(
+            x.numpy().var(-1, keepdims=True) + 1e-5
+        )
+        np.testing.assert_allclose(out.numpy(),
+                                   ref * ln.weight.numpy()
+                                   + ln.bias.numpy(),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_fused_residual_layer_norm(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FUSED_LN", "interpret")
+        D = 128
+        w = paddle.to_tensor(rng.rand(D).astype(np.float32) + 0.5,
+                             stop_gradient=False)
+        b = paddle.to_tensor(rng.rand(D).astype(np.float32),
+                             stop_gradient=False)
+        x = paddle.to_tensor(rng.rand(16, D).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(rng.rand(16, D).astype(np.float32),
+                             stop_gradient=False)
+        s, out = F.fused_residual_layer_norm(x, y, [D], w, b)
+        (s.sum() + out.sum()).backward()
+        gx = x.grad.numpy().copy()
+        gw = w.grad.numpy().copy()
+        # dense reference
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        y2 = paddle.to_tensor(y.numpy(), stop_gradient=False)
+        w2 = paddle.to_tensor(w.numpy(), stop_gradient=False)
+        b2 = paddle.to_tensor(b.numpy(), stop_gradient=False)
+        s2 = x2 + y2
+        out2 = F.layer_norm(s2, [D], w2, b2)
+        np.testing.assert_allclose(s.numpy(), s2.numpy(), rtol=1e-6,
+                                   atol=1e-6)
+        np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=2e-5,
+                                   atol=2e-5)
+        (s2.sum() + out2.sum()).backward()
+        np.testing.assert_allclose(gx, x2.grad.numpy(), rtol=2e-4,
+                                   atol=2e-5)
+        np.testing.assert_allclose(gw, w2.grad.numpy(), rtol=2e-4,
+                                   atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# blockwise fused vocab CE
+# ---------------------------------------------------------------------------
+
+
+class TestBlockwiseCE:
+    def _case(self, N=24, d=16, V=50):
+        h = paddle.to_tensor(rng.rand(N, d).astype(np.float32) - 0.5,
+                             stop_gradient=False)
+        w = paddle.to_tensor(rng.rand(d, V).astype(np.float32) - 0.5,
+                             stop_gradient=False)
+        b = paddle.to_tensor(rng.rand(V).astype(np.float32),
+                             stop_gradient=False)
+        y = np.append(rng.randint(0, V, N - 3),
+                      [-100, -100, 5]).astype(np.int64)
+        return h, w, b, paddle.to_tensor(y)
+
+    @pytest.mark.parametrize("chunk", [7, 16, 49])
+    def test_loss_and_grads_match_dense(self, chunk):
+        h, w, b, y = self._case()
+        loss = F.fused_linear_cross_entropy(h, w, b, y, chunk=chunk)
+        loss.backward()
+        gh, gw, gb = (h.grad.numpy().copy(), w.grad.numpy().copy(),
+                      b.grad.numpy().copy())
+        h2 = paddle.to_tensor(h.numpy(), stop_gradient=False)
+        w2 = paddle.to_tensor(w.numpy(), stop_gradient=False)
+        b2 = paddle.to_tensor(b.numpy(), stop_gradient=False)
+        ref = F.cross_entropy(F.linear(h2, w2, b2), y)
+        ref.backward()
+        np.testing.assert_allclose(loss.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(gh, h2.grad.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(gw, w2.grad.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(gb, b2.grad.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_sum_and_none_reductions(self):
+        h, w, b, y = self._case()
+        for red in ("sum", "none"):
+            got = F.fused_linear_cross_entropy(h, w, b, y, chunk=16,
+                                               reduction=red)
+            ref = F.cross_entropy(F.linear(h, w, b), y, reduction=red)
+            np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_chunk_zero_is_dense_escape_hatch(self, monkeypatch):
+        h, w, b, y = self._case()
+        monkeypatch.setenv("PADDLE_CE_CHUNK", "0")
+        got = F.fused_linear_cross_entropy(h, w, b, y)
+        ref = F.cross_entropy(F.linear(h, w, b), y)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-6,
+                                   atol=1e-7)
+
+    def test_inside_train_step_matches_dense_ce(self):
+        """TrainStep with the blockwise loss == TrainStep with dense CE
+        (same seed/model/data): loss and updated params."""
+        from paddle_tpu import optimizer
+        from paddle_tpu.jit import TrainStep
+
+        d, V, N = 8, 40, 16
+
+        def build():
+            paddle.seed(11)
+
+            class M(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.fc = nn.Linear(d, d)
+                    self.head = nn.Linear(d, V)
+
+                def forward(self, x):
+                    return F.relu(self.fc(x))
+
+            return M()
+
+        x = rng.rand(N, d).astype(np.float32)
+        y = rng.randint(0, V, N).astype(np.int64)
+
+        m1 = build()
+        o1 = optimizer.Adam(learning_rate=1e-2,
+                            parameters=m1.parameters())
+        s1 = TrainStep(
+            m1,
+            lambda h, lbl: F.fused_linear_cross_entropy(
+                h, m1.head.weight, m1.head.bias, lbl, chunk=16
+            ),
+            o1,
+        )
+        l1 = s1(x, y)
+
+        m2 = build()
+        o2 = optimizer.Adam(learning_rate=1e-2,
+                            parameters=m2.parameters())
+        s2 = TrainStep(
+            m2,
+            lambda h, lbl: F.cross_entropy(
+                F.linear(h, m2.head.weight, m2.head.bias), lbl
+            ),
+            o2,
+        )
+        l2 = s2(x, y)
+        np.testing.assert_allclose(l1.numpy(), l2.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(
+                p1.numpy(), p2.numpy(), rtol=1e-4, atol=1e-5,
+                err_msg=f"param {p1.name} diverged (incl. head grads "
+                        "through the fused CE)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# fused-QKV state_dict round trip
+# ---------------------------------------------------------------------------
+
+
+class TestFusedQKVStateDict:
+    def _legacy_encoder_ckpt(self, d=16, heads=4, ffn=32, seed=21):
+        """A pre-fusion checkpoint: q_proj/k_proj/v_proj keys, as the
+        pre-r06 MultiHeadAttention saved them."""
+        r = np.random.RandomState(seed)
+        ck = {}
+        for p in ("q", "k", "v"):
+            ck[f"self_attn.{p}_proj.weight"] = \
+                r.rand(d, d).astype(np.float32) - 0.5
+            ck[f"self_attn.{p}_proj.bias"] = \
+                r.rand(d).astype(np.float32) - 0.5
+        ck["self_attn.out_proj.weight"] = \
+            r.rand(d, d).astype(np.float32) - 0.5
+        ck["self_attn.out_proj.bias"] = r.rand(d).astype(np.float32)
+        ck["linear1.weight"] = r.rand(d, ffn).astype(np.float32) - 0.5
+        ck["linear1.bias"] = r.rand(ffn).astype(np.float32)
+        ck["linear2.weight"] = r.rand(ffn, d).astype(np.float32) - 0.5
+        ck["linear2.bias"] = r.rand(d).astype(np.float32)
+        for n in ("norm1", "norm2"):
+            ck[f"{n}.weight"] = r.rand(d).astype(np.float32) + 0.5
+            ck[f"{n}.bias"] = r.rand(d).astype(np.float32)
+        return ck
+
+    def test_pre_fusion_checkpoint_loads_through_parent(self):
+        """Loading happens at the PARENT layer (the normal checkpoint
+        path) — the legacy-key merge must apply through the hierarchy."""
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        ck = self._legacy_encoder_ckpt()
+        missing, unexpected = layer.set_state_dict(ck)
+        assert not missing, f"missing after legacy merge: {missing}"
+        assert not unexpected, f"unexpected: {unexpected}"
+        want = np.concatenate(
+            [ck[f"self_attn.{p}_proj.weight"] for p in ("q", "k", "v")],
+            axis=1,
+        )
+        np.testing.assert_allclose(
+            layer.self_attn.qkv_proj.weight.numpy(), want
+        )
+
+    def test_round_trip_preserves_forward(self):
+        """legacy ckpt -> model A -> save -> model B: A(x) == B(x), and
+        A's output equals the hand-computed pre-fusion attention."""
+        paddle.seed(2)
+        a = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        ck = self._legacy_encoder_ckpt()
+        a.set_state_dict(ck)
+        x = paddle.to_tensor(rng.rand(2, 6, 16).astype(np.float32))
+        out_a = a(x)
+        b = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        missing, unexpected = b.set_state_dict(a.state_dict())
+        assert not missing and not unexpected
+        np.testing.assert_allclose(out_a.numpy(), b(x).numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_fused_projection_matches_split_math(self):
+        """qkv_proj(x) sliced == the three legacy projections applied
+        separately (the checkpoint-compat contract is numeric, not just
+        key names)."""
+        mha = nn.MultiHeadAttention(16, 4)
+        ck = {
+            f"{p}_proj.{leaf}": rng.rand(
+                *( (16, 16) if leaf == "weight" else (16,) )
+            ).astype(np.float32) - 0.5
+            for p in ("q", "k", "v") for leaf in ("weight", "bias")
+        }
+        ck["out_proj.weight"] = np.eye(16, dtype=np.float32)
+        ck["out_proj.bias"] = np.zeros(16, np.float32)
+        mha.set_state_dict(ck)
+        x = rng.rand(2, 5, 16).astype(np.float32)
+        got = mha._proj(paddle.to_tensor(x), 1).numpy()  # k slice
+        want = x @ ck["k_proj.weight"] + ck["k_proj.bias"]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_cross_attention_and_cache_still_work(self):
+        dec = nn.TransformerDecoderLayer(16, 4, 32, dropout=0.0)
+        tgt = paddle.to_tensor(rng.rand(2, 5, 16).astype(np.float32))
+        mem = paddle.to_tensor(rng.rand(2, 7, 16).astype(np.float32))
+        out = dec(tgt, mem)
+        assert out.shape == [2, 5, 16]
+        cache = dec.gen_cache(mem)
+        step = paddle.to_tensor(rng.rand(2, 1, 16).astype(np.float32))
+        out2, new_cache = dec(step, mem, cache=cache)
+        assert out2.shape == [2, 1, 16]
+        assert new_cache[0].k.shape[2] == 1

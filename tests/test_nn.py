@@ -227,7 +227,7 @@ def test_multihead_attention():
     out2 = mha(q, q, q, attn_mask=mask)
     assert not np.allclose(out.numpy(), out2.numpy())
     paddle.mean(out2).backward()
-    assert mha.q_proj.weight.grad is not None
+    assert mha.qkv_proj.weight.grad is not None  # fused [d, 3d] projection
 
 
 def test_transformer_encoder():

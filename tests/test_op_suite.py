@@ -865,6 +865,102 @@ def test_sequence_ops_ernie_shaped_pipeline():
 
 
 # ---------------------------------------------------------------------------
+# round-6 sequence-op tail (ISSUE 4 satellite, VERDICT missing #2):
+# slice / erase / scatter / reshape through the OpTest harness — numpy
+# reference output parity + analytic-vs-numeric gradients
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_slice_optest():
+    B, T, D = 3, 5, 4
+    x = A(B, T, D)
+    off = np.array([0, 2, 1], np.int64)
+    ln = np.array([3, 2, 3], np.int64)
+
+    def ref(xv, offv, lnv):
+        max_out = int(lnv.max())
+        out = np.zeros((B, max_out, D), np.float32)
+        for i in range(B):
+            for j in range(int(lnv[i])):
+                out[i, j] = xv[i, min(int(offv[i]) + j, T - 1)]
+        return out, lnv
+
+    check_output(P.sequence_slice, ref, [x, off, ln])
+    check_grad(P.sequence_slice, [x, off, ln], wrt=[0], output_idx=0)
+
+
+def test_sequence_erase_optest():
+    B, T = 3, 6
+    ids = np.array([
+        [2, 5, 2, 7, 0, 0],
+        [5, 5, 5, 1, 9, 2],
+        [1, 3, 4, 2, 5, 8],
+    ], np.int64)
+    lens = np.array([4, 6, 5], np.int64)
+    tokens = [2, 5]
+
+    def ref(idv, lnv):
+        out = np.zeros_like(idv)
+        new_l = np.zeros_like(lnv)
+        for i in range(B):
+            kept = [t for t in idv[i, : int(lnv[i])] if t not in tokens]
+            out[i, : len(kept)] = kept
+            new_l[i] = len(kept)
+        return out, new_l
+
+    got, got_l = P.sequence_erase(
+        P.to_tensor(ids), tokens, P.to_tensor(lens)
+    )
+    want, want_l = ref(ids, lens)
+    np.testing.assert_array_equal(got.numpy(), want)
+    np.testing.assert_array_equal(got_l.numpy(), want_l)
+    # without lengths: the whole row is the sequence
+    got_full, got_full_l = P.sequence_erase(P.to_tensor(ids), tokens)
+    want_full, want_full_l = ref(ids, np.full((B,), T, np.int64))
+    np.testing.assert_array_equal(got_full.numpy(), want_full)
+    np.testing.assert_array_equal(got_full_l.numpy(), want_full_l)
+
+
+def test_sequence_scatter_optest():
+    B, D, T = 3, 6, 4
+    x = A(B, D)
+    idx = rng.randint(0, D, (B, T)).astype(np.int64)
+    upd = A(B, T)
+    ln = np.array([4, 2, 3], np.int64)
+
+    def ref(xv, idxv, updv, lnv):
+        out = xv.copy()
+        for i in range(B):
+            for j in range(int(lnv[i])):
+                out[i, idxv[i, j]] += updv[i, j]
+        return out
+
+    check_output(P.sequence_scatter, ref, [x, idx, upd, ln])
+    check_grad(P.sequence_scatter, [x, idx, upd, ln], wrt=[0, 2])
+
+
+def test_sequence_reshape_optest():
+    B, T, D, nd = 3, 4, 6, 3
+    x = A(B, T, D)
+    lens = np.array([4, 2, 3], np.int64)
+
+    def ref(xv, lnv, new_dim):
+        T2 = int((lnv * D).max() // new_dim)
+        flat = xv.reshape(B, T * D)
+        out = flat[:, : T2 * new_dim].reshape(B, T2, new_dim).copy()
+        new_l = lnv * D // new_dim
+        for i in range(B):
+            out[i, int(new_l[i]):] = 0
+        return out, new_l
+
+    check_output(P.sequence_reshape, ref, [x, lens, nd])
+    check_grad(P.sequence_reshape, [x, lens, nd], wrt=[0], output_idx=0)
+    # indivisible payload must raise, not silently truncate
+    with pytest.raises(ValueError, match="divisible"):
+        P.sequence_reshape(P.to_tensor(x), P.to_tensor(lens), 5)
+
+
+# ---------------------------------------------------------------------------
 # round-5 detection-op tail
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Bench continuity gate: compare the two latest `BENCH_r*.json` records
+and FAIL on any >10% per-metric median regression that the newer round
+did not annotate (VERDICT r5 weak #2 — the "explain every regression"
+methodology, made enforceable).
+
+Rules
+-----
+* Metrics: the headline `metric`/`value` pair plus every numeric
+  `extra` key. `*_compile_s` (warm-cache compile times), `vs_*` ratios
+  and `*_spread` records are excluded. Direction is inferred from the
+  name: `*per_sec*` is higher-is-better, `*_ms`/`*_s` lower-is-better;
+  anything else is skipped.
+* A regression is WAIVED when
+    - the newer round's `extra.incomparable_to_prev` is non-empty (a
+      declared methodology break applies to the whole record), or
+    - the metric's name appears in the newer round's `extra.note` /
+      `extra.incomparable_to_prev` text (per-metric annotation).
+* Rounds up to r05 were single-shot on a tunnel-shared chip (±2x jitter
+  documented in BENCH/PERF notes); enforcement only makes sense on the
+  median-of-N methodology, detected by the presence of `*_spread` keys.
+  A newer file without spreads downgrades failures to warnings.
+
+Usage: `python tools/bench_continuity.py [repo_root]` — exit 1 on an
+unwaived regression. `tests/test_hygiene.py::TestBenchContinuity` runs
+this over the repo's records in CI and unit-tests the gate on synthetic
+pairs.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+THRESHOLD = 0.10
+
+
+def _parsed(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    return d.get("parsed", d)  # harness wrapper or the bare bench line
+
+
+def load_latest_pair(root: str):
+    """The two most recent BENCH_r*.json by round number, or None."""
+    paths = glob.glob(os.path.join(root, "BENCH_r*.json"))
+    rounds = []
+    for p in paths:
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            rounds.append((int(m.group(1)), p))
+    rounds.sort()
+    if len(rounds) < 2:
+        return None
+    (_, prev_p), (_, cur_p) = rounds[-2], rounds[-1]
+    return (prev_p, _parsed(prev_p)), (cur_p, _parsed(cur_p))
+
+
+def metric_direction(name: str):
+    """+1 higher-is-better, -1 lower-is-better, None = not comparable."""
+    if name.startswith("vs_") or name.endswith("_spread"):
+        return None
+    if name.endswith("_compile_s"):
+        return None  # warm-cache artifact, not a perf metric
+    if "per_sec" in name:
+        return 1
+    if name.endswith("_ms") or name.endswith("_s"):
+        return -1
+    return None
+
+
+def metrics_of(parsed: dict) -> dict:
+    out = {}
+    if isinstance(parsed.get("value"), (int, float)) and parsed.get("metric"):
+        out[parsed["metric"]] = float(parsed["value"])
+    for k, v in (parsed.get("extra") or {}).items():
+        if isinstance(v, (int, float)) and metric_direction(k) is not None:
+            out[k] = float(v)
+    # a *_step_ms key is the same measurement as its sibling *per_sec
+    # throughput, un-normalized — it double-counts the comparison and
+    # flips spuriously when the batch size changes; keep the throughput
+    for k in [k for k in out if k.endswith("_step_ms")]:
+        prefix = k[: -len("step_ms")]
+        if any(o.startswith(prefix) and "per_sec" in o for o in out):
+            del out[k]
+    return out
+
+
+def compare(prev: dict, cur: dict):
+    """-> (regressions, waived, improvements): lists of
+    (name, prev, cur, change_fraction[, reason])."""
+    note = str((cur.get("extra") or {}).get("note", ""))
+    incomparable = str(
+        (cur.get("extra") or {}).get("incomparable_to_prev", "")
+    )
+    ann_text = note + " " + incomparable
+    pm, cm = metrics_of(prev), metrics_of(cur)
+    regressions, waived, improvements = [], [], []
+    for name in sorted(set(pm) & set(cm)):
+        sign = metric_direction(name)
+        if sign is None or pm[name] == 0:
+            continue
+        change = sign * (cm[name] - pm[name]) / abs(pm[name])
+        if change >= 0:
+            improvements.append((name, pm[name], cm[name], change))
+            continue
+        if -change <= THRESHOLD:
+            continue
+        if incomparable.strip():
+            waived.append((name, pm[name], cm[name], change,
+                           "incomparable_to_prev declared"))
+        elif re.search(  # whole-name match: annotating x_per_sec_dense
+            #  must not waive its prefix sibling x_per_sec
+            r"(?<![A-Za-z0-9_])" + re.escape(name) + r"(?![A-Za-z0-9_])",
+            ann_text,
+        ):
+            waived.append((name, pm[name], cm[name], change,
+                           "annotated in note"))
+        else:
+            regressions.append((name, pm[name], cm[name], change))
+    return regressions, waived, improvements
+
+
+def check(root: str):
+    """-> (exit_code, report_lines)."""
+    pair = load_latest_pair(root)
+    lines = []
+    if pair is None:
+        return 0, ["bench_continuity: fewer than two BENCH_r*.json — skip"]
+    (prev_p, prev), (cur_p, cur) = pair
+    lines.append(
+        f"bench_continuity: {os.path.basename(prev_p)} -> "
+        f"{os.path.basename(cur_p)} (threshold {THRESHOLD:.0%})"
+    )
+    regressions, waived, improvements = compare(prev, cur)
+    enforce = any(
+        k.endswith("_spread") for k in (cur.get("extra") or {})
+    )
+    for name, a, b, c in improvements:
+        lines.append(f"  ok      {name}: {a:g} -> {b:g} ({c:+.1%})")
+    for name, a, b, c, why in waived:
+        lines.append(f"  waived  {name}: {a:g} -> {b:g} ({c:+.1%}) [{why}]")
+    for name, a, b, c in regressions:
+        tag = "REGRESS" if enforce else "warn   "
+        lines.append(f"  {tag} {name}: {a:g} -> {b:g} ({c:+.1%})")
+    if regressions and not enforce:
+        lines.append(
+            "  (single-shot round — no *_spread keys — regressions "
+            "reported, not enforced)"
+        )
+    rc = 1 if (regressions and enforce) else 0
+    if rc:
+        lines.append(
+            "FAIL: unannotated >10% regression(s); either fix the "
+            "regression or explain it in extra.note / declare "
+            "extra.incomparable_to_prev"
+        )
+    return rc, lines
+
+
+def main(argv):
+    root = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    rc, lines = check(root)
+    print("\n".join(lines))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
