@@ -140,23 +140,60 @@ def apply(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
     return _apply_impl(raw_fn, tensors, name)
 
 
+class NanInfError(RuntimeError):
+    """FLAGS_check_nan_inf verdict: the named op produced NaN/Inf.
+
+    Carries `op_name` and `phase` ("forward" | "backward") so
+    tools/replay_step.py can turn a captured diverged step into a
+    file:op diagnosis instead of string-parsing the message."""
+
+    def __init__(self, op_name: str, phase: str = "forward",
+                 detail: str = ""):
+        self.op_name = op_name
+        self.phase = phase
+        super().__init__(
+            f"FLAGS_check_nan_inf: {'grad of ' if phase == 'backward' else ''}"
+            f"op '{op_name}' produced NaN/Inf{detail}"
+        )
+
+
 def _check_nan_inf(name, outs):
     """FLAGS_check_nan_inf (platform/flags.cc:44 ->
     CheckVarHasNanOrInf, details/nan_inf_utils_detail.cc): eager-mode
     per-op output sentinel. Host-syncs per op — a debug flag, exactly as
-    in the reference; inside jit traces it is a no-op (use the fused
-    finite check of the amp path there)."""
+    in the reference; inside jit traces it is a no-op (the fused
+    TrainStep carries its own in-graph sentinel, utils/train_guard.py)."""
     from .flags import flag
 
     if not flag("check_nan_inf") or _state.trace_depth > 0:
         return
-    for o in outs:
+    for i, o in enumerate(outs):
         if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact):
             if not bool(jnp.all(jnp.isfinite(o))):
-                raise RuntimeError(
-                    f"FLAGS_check_nan_inf: op '{name or 'op'}' produced "
-                    "NaN/Inf"
-                )
+                raise NanInfError(
+                    name or "op", "forward",
+                    detail=(f" (output {i}, shape {tuple(o.shape)}, "
+                            f"{o.dtype})"))
+
+
+def _check_nan_inf_cotangents(node, in_cots):
+    """Backward-sweep half of FLAGS_check_nan_inf: a VJP whose input
+    cotangents go nonfinite names the producing op — the reference
+    checks grad-op outputs the same way (nan_inf_utils_detail.cc runs
+    on every op, forward and grad, via the op loop)."""
+    from .flags import flag
+
+    if not flag("check_nan_inf") or _state.trace_depth > 0:
+        return
+    for i, g in enumerate(in_cots):
+        if _is_float0(g):
+            continue
+        if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.inexact):
+            if not bool(jnp.all(jnp.isfinite(g))):
+                raise NanInfError(
+                    node.name or "op", "backward",
+                    detail=(f" (input-grad {i}, shape {tuple(g.shape)}, "
+                            f"{g.dtype})"))
 
 
 def _apply_impl(raw_fn: Callable, tensors: Sequence, name: Optional[str] = None):
@@ -509,6 +546,7 @@ def _run_engine(
             final.append(c)
         arg = tuple(final) if node.multi else final[0]
         in_cots = node.vjp_fn(arg)
+        _check_nan_inf_cotangents(node, in_cots)
         if not retain_graph:
             node.vjp_fn = None
             node.released = True

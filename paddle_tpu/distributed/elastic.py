@@ -105,16 +105,17 @@ class RankProc:
     """One spawned rank (launch_utils.py TrainerProc analog)."""
 
     __slots__ = ("proc", "rank", "hb_path", "log_path", "log_file",
-                 "ev_path")
+                 "ev_path", "guard_ev_path")
 
     def __init__(self, proc, rank, hb_path, log_path=None, log_file=None,
-                 ev_path=None):
+                 ev_path=None, guard_ev_path=None):
         self.proc = proc
         self.rank = rank
         self.hb_path = hb_path
         self.log_path = log_path
         self.log_file = log_file
         self.ev_path = ev_path
+        self.guard_ev_path = guard_ev_path
 
 
 class ElasticManager:
@@ -197,6 +198,13 @@ class ElasticManager:
             with open(ev, "w"):
                 pass  # fresh per attempt: attribution reflects THIS run
             env["PADDLE_COLL_EVENT_FILE"] = ev
+            # the numerical guard's event stream (train_guard.py): same
+            # JSONL contract, read for kill attribution alongside the
+            # collective events
+            gev = os.path.join(self._run_dir, f"guardev.{rank}")
+            with open(gev, "w"):
+                pass
+            env["PADDLE_GUARD_EVENT_FILE"] = gev
             env["PADDLE_COLL_SYNC_DIR"] = sync_dir
             env.setdefault("PADDLE_COLL_DEBUG_DIR", debug_dir)
             if self.coll_timeout is not None:
@@ -211,7 +219,7 @@ class ElasticManager:
                 [sys.executable, self.script] + self.script_args,
                 env=env, stdout=log_file, stderr=log_file)
             self._procs.append(RankProc(p, rank, hb, log_path, log_file,
-                                        ev_path=ev))
+                                        ev_path=ev, guard_ev_path=gev))
 
     # -- teardown ---------------------------------------------------------
     def _kill_rank(self, rp: RankProc, why: str) -> None:
@@ -258,15 +266,18 @@ class ElasticManager:
 
     # -- kill attribution (comm_monitor event reader) ---------------------
     def _attribute(self, rp: RankProc, why: str) -> None:
-        """Name the collective behind a rank's death, when its monitor
-        managed to write an event line before the end — turns a generic
-        'hung rank' into 'stalled in all_reduce(seq 5, group 0, ...)'."""
-        if not rp.ev_path:
-            return
-        events = comm_monitor.read_events(rp.ev_path)
+        """Name the collective — or the numerical-guard verdict — behind
+        a rank's death, when a monitor managed to write an event line
+        before the end: a generic 'hung rank' becomes 'stalled in
+        all_reduce(seq 5, ...)', a guard abort (rc=96) becomes
+        'divergence: N consecutive bad steps (grads nonfinite, ...)'."""
+        events = []
+        for path in (rp.ev_path, rp.guard_ev_path):
+            if path:
+                events.extend(comm_monitor.read_events(path))
         if not events:
             return
-        ev = events[-1]
+        ev = max(events, key=lambda e: e.get("time", 0.0))
         what = (ev.get("detail") or ev.get("describe")
                 or ev.get("event", "?"))
         print(

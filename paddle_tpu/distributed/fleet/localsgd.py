@@ -26,6 +26,7 @@ from ...core import autograd as AG
 from ...core.tensor import Tensor
 from ...jit.functional_call import _swapped
 from ...nn.layer import Layer
+from ...utils import train_guard as _TG
 from .. import comm
 
 
@@ -82,10 +83,26 @@ class LocalSGDStep:
             name: tuple(stack(v) for v in vals)
             for name, vals in state.items()
         }
+        # numerical guard (utils/train_guard.py): same sentinel as
+        # TrainStep, computed per worker slice through the shared
+        # process_grads seam and combined with a lax.pmin so every
+        # replica skips (or applies) the step together — a desynced
+        # skip would make the next pmean average healthy params with
+        # stale ones
+        self._guard_mode = _TG.guard_mode()
+        self._guard = (_TG.TrainGuard(mode=self._guard_mode, model=model)
+                       if self._guard_mode != "off" else None)
+        self._guard_state = ()
+        if self._guard is not None:
+            self._guard._on_rollback = self._after_rollback
+            # replicated on the dp mesh: a single-device carry among
+            # mesh-placed operands would retrace the step on call 2
+            self._guard_state = jax.device_put(
+                _TG.init_guard_state(), NamedSharding(self.mesh, P()))
         # sync is STATIC (host-known): two cached compilations, and the
         # non-sync program contains NO collective at all — the whole point
         # of LocalSGD's reduced communication
-        self._jitted = jax.jit(self._step_fn, static_argnums=7)
+        self._jitted = jax.jit(self._step_fn, static_argnums=8)
         self._dirty = False
         # checkpoint consumers must see averaged weights: state_dict pulls
         # the replicas back into the Layer first
@@ -99,7 +116,7 @@ class LocalSGDStep:
 
     # -- the pure spmd program ----------------------------------------------
     def _step_fn(self, stk_p, stk_state, stk_b, in_raws, label_raws, lr, t,
-                 sync):
+                 guard_state, sync):
         spec_of = lambda tree: jax.tree_util.tree_map(
             lambda _: P(self.axis), tree
         )
@@ -115,10 +132,27 @@ class LocalSGDStep:
             ),
             out_specs=(
                 P(), spec_of(stk_p), spec_of(stk_state), spec_of(stk_b),
+                (P(), P(), P()),
             ),
         )
-        return f(stk_p, stk_state, stk_b, list(in_raws), list(label_raws),
-                 lr, t)
+        loss, new_p, new_st, new_b, health = f(
+            stk_p, stk_state, stk_b, list(in_raws), list(label_raws),
+            lr, t)
+        if self._guard is not None:
+            ok, bits, gnorm = health
+            guard_state, ok_apply = _TG.update_guard_state(
+                guard_state, ok, bits, gnorm, loss
+            )
+            # the gnorm-spike verdict (ok_apply) needs the EWMA state,
+            # which lives out here — mask the STACKED outputs against
+            # the stacked inputs so a finite grad-norm explosion is
+            # still a no-op before it applies, same as TrainStep
+            # (nonfinite steps were already masked in-worker; for them
+            # this select is an identity)
+            new_p = _TG.mask_step(ok_apply, new_p, list(stk_p))
+            new_st = _TG.mask_step(ok_apply, new_st, stk_state)
+            new_b = _TG.mask_step(ok_apply, new_b, list(stk_b))
+        return loss, new_p, new_st, new_b, guard_state
 
     def _worker(self, p_stk, st_stk, b_stk, ins, labels, lr, t, sync):
         p_loc = [q[0] for q in p_stk]
@@ -149,17 +183,40 @@ class LocalSGDStep:
         new_p, new_st = self._inner._functional_update(
             self._p_objs, p_loc, grads, st_loc, lr, t
         )
+        if self._guard is not None:
+            # per-worker sentinel, job-wide verdict: ANY worker tripping
+            # skips the step on EVERY worker (pmin), so the replicas
+            # stay element-wise comparable for the next pmean
+            ok, bits, gnorm = _TG.grad_health(loss, grads, new_p)
+            ok = jax.lax.pmin(ok.astype(jnp.int32), self.axis) == 1
+            bits = jax.lax.pmax(bits, self.axis)
+            gnorm = jax.lax.pmax(gnorm, self.axis)
+            health = (ok, bits, gnorm)
+        else:
+            ok = None
+            health = (jnp.asarray(True), jnp.asarray(0.0, jnp.float32),
+                      jnp.asarray(0.0, jnp.float32))
         # the periodic c_allreduce_sum/nranks of params (:194); `sync` is
         # static, so non-sync steps compile with no collective at all
         if sync:
             new_p = [jax.lax.pmean(v, self.axis) for v in new_p]
             new_b = [jax.lax.pmean(v, self.axis) for v in new_b]
+        if ok is not None:
+            # mask AFTER the sync average: a skipped step must skip the
+            # whole step INCLUDING the comm — even over bitwise-equal
+            # replicas a pmean costs an ulp (sequential f32
+            # accumulation), which would break the no-op guarantee; the
+            # deferred average simply runs at the next healthy sync
+            new_p = _TG.mask_step(ok, list(new_p), p_loc)
+            new_st = _TG.mask_step(ok, new_st, st_loc)
+            new_b = _TG.mask_step(ok, list(new_b), b_loc)
         loss_mean = jax.lax.pmean(loss, self.axis)
         return (
             loss_mean,
             [v[None] for v in new_p],
             jax.tree_util.tree_map(lambda v: v[None], new_st),
             [v[None] for v in new_b],
+            health,
         )
 
     # -- eager entry ---------------------------------------------------------
@@ -176,15 +233,47 @@ class LocalSGDStep:
         opt._step_count += 1
         t = opt._step_count
         sync = t >= self.begin_step and t % self.k_steps == 0
-        loss, self._stk_p, self._stk_state, self._stk_b = self._jitted(
+        if self._guard is not None:
+            self._guard.capture(None, in_raws, label_raws)
+        (loss, self._stk_p, self._stk_state, self._stk_b,
+         self._guard_state) = self._jitted(
             self._stk_p, self._stk_state, self._stk_b,
             in_raws, label_raws,
             jnp.asarray(opt.get_lr(), jnp.float32),
             jnp.asarray(t, jnp.float32),
+            self._guard_state,
             bool(sync),
         )
         self._dirty = True
+        if self._guard is not None:
+            # on rollback the _on_rollback hook (-> _after_rollback)
+            # restacks the replicas and re-seeds the guard carry
+            self._guard.observe(self._guard_state)
         return Tensor._wrap(loss, stop_gradient=True)
+
+    def _after_rollback(self):
+        """Guard rollback hook: the checkpoint restored the LAYER's
+        params; rebuild the per-worker replicas and guard carry."""
+        self._restack()
+        self._guard_state = jax.device_put(
+            self._guard.restored_device_state(),
+            NamedSharding(self.mesh, P()))
+
+    def _restack(self):
+        """Re-broadcast the Layer's (restored) params/buffers/opt state
+        into the per-worker stacked replicas."""
+        stack = lambda r: jax.device_put(
+            jnp.broadcast_to(r[None], (self.dp,) + r.shape),
+            NamedSharding(self.mesh, P(self.axis)),
+        )
+        self._stk_p = [stack(p._data) for p in self._p_objs]
+        self._stk_b = [stack(b._data) for b in self._b_objs]
+        state = self._inner._functional_state(self._p_objs)
+        self._stk_state = {
+            name: tuple(stack(v) for v in vals)
+            for name, vals in state.items()
+        }
+        self._dirty = False
 
     def sync_to_model(self):
         """Average the per-worker replicas back into the Layer's params

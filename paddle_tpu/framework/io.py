@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import sys
 import zlib
 
 import numpy as np
@@ -34,9 +35,18 @@ def crc32_file(path, chunk_size=1 << 20):
     return crc & 0xFFFFFFFF
 
 
+def _is_jax_array(obj) -> bool:
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(obj, jax.Array)
+
+
 def _to_numpy_tree(obj):
     if isinstance(obj, Tensor):
         return _TensorLeaf(obj.numpy())
+    if _is_jax_array(obj):
+        # raw device arrays (loss-scaler / guard carries, replay-bundle
+        # batches) persist as portable numpy leaves, never jax pickles
+        return _TensorLeaf(np.asarray(obj))
     if isinstance(obj, dict):
         return {k: _to_numpy_tree(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):

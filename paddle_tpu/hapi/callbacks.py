@@ -12,7 +12,7 @@ import numpy as np
 
 __all__ = [
     "Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-    "EarlyStopping", "VisualDL", "TerminateOnPreempt",
+    "EarlyStopping", "VisualDL", "TerminateOnPreempt", "GuardCallback",
 ]
 
 
@@ -306,6 +306,116 @@ class TerminateOnPreempt(Callback):
 
         restore_preempt_notice(self._old_handler)
         self._old_handler = None
+
+
+class GuardCallback(Callback):
+    """Numerical-guardrail face of hapi training (utils/train_guard.py).
+
+    `Model.fit` already trains through the fused `jit.TrainStep`, so the
+    in-graph sentinel and skip-and-rescue masking apply automatically
+    under `PADDLE_GUARD_MODE=skip|abort`. This callback adds the
+    hapi-level policy on top, using the per-batch loss the fit loop
+    already pulled to the host (so it costs nothing extra):
+
+    - a nonfinite logged loss — or, with ``spike_factor`` > 0, a finite
+      loss above ``spike_factor x EWMA`` — counts as a *bad batch*;
+    - every healthy epoch end writes a ``save_dir/guard_last_good``
+      snapshot (rescue anchor; reuses `Model.save`);
+    - past ``max_skips`` consecutive bad batches it restores that
+      snapshot (`Model.load`) when one exists, else stops training —
+      emitting a `guard_rollback` / `guard_stop` JSONL event either way
+      (`PADDLE_GUARD_EVENT_FILE`, the stream the ElasticManager reads
+      for kill attribution).
+    """
+
+    def __init__(self, max_skips=None, save_dir=None, spike_factor=None,
+                 ewma_decay=0.9, warmup=20, verbose=1):
+        super().__init__()
+        from ..utils import train_guard as tg
+
+        self.max_skips = (max_skips if max_skips is not None
+                          else tg._envi(tg._MAX_SKIPS_ENV, 8))
+        self.spike_factor = (spike_factor if spike_factor is not None
+                             else tg._envf(tg._SPIKE_ENV, 0.0))
+        self.save_dir = save_dir
+        self.ewma_decay = float(ewma_decay)
+        self.warmup = int(warmup)
+        self.verbose = verbose
+        self._reset()
+
+    def _reset(self):
+        self.consec = 0
+        self.total_bad = 0
+        self.rollbacks = 0
+        self._ewma = None
+        self._healthy = 0
+        self._anchor = None
+
+    def _loss_of(self, logs):
+        v = (logs or {}).get("loss")
+        if isinstance(v, (list, tuple, np.ndarray)):
+            v = np.asarray(v).reshape(-1)[0]
+        return None if v is None else float(v)
+
+    def on_train_begin(self, logs=None):
+        self._reset()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..utils import train_guard as tg
+
+        loss = self._loss_of(logs)
+        if loss is None:
+            return
+        bad = not np.isfinite(loss)
+        spiked = (not bad and self.spike_factor > 0.0
+                  and self._healthy >= self.warmup
+                  and self._ewma is not None
+                  and loss > self.spike_factor * abs(self._ewma))
+        if bad or spiked:
+            self.consec += 1
+            self.total_bad += 1
+            tg.emit_event(
+                "guard_skip", step=step, consec=self.consec,
+                loss=loss if np.isfinite(loss) else None,
+                detail=f"hapi batch {step}: "
+                       + ("loss nonfinite" if bad else
+                          f"loss spike {loss:.6g} > "
+                          f"{self.spike_factor:g}x ewma {self._ewma:.6g}"))
+            if self.consec >= self.max_skips:
+                self._rescue(step)
+            return
+        self.consec = 0
+        self._healthy += 1
+        self._ewma = (loss if self._ewma is None
+                      else self.ewma_decay * self._ewma
+                      + (1.0 - self.ewma_decay) * loss)
+
+    def _rescue(self, step):
+        from ..utils import train_guard as tg
+
+        detail = (f"hapi divergence: {self.consec} consecutive bad "
+                  f"batches (budget {self.max_skips})")
+        if self._anchor:
+            self.model.load(self._anchor)
+            self.rollbacks += 1
+            self.consec = 0
+            tg.emit_event("guard_rollback", step=step,
+                          anchor=self._anchor, detail=detail)
+            if self.verbose:
+                print(f"GuardCallback: {detail}; restored {self._anchor}")
+        else:
+            self.model.stop_training = True
+            tg.emit_event("guard_stop", step=step, detail=detail)
+            if self.verbose:
+                print(f"GuardCallback: {detail}; no last-good snapshot — "
+                      "stopping training")
+
+    def on_epoch_end(self, epoch, logs=None):
+        save_dir = self.save_dir or getattr(self.model, "_save_dir", None)
+        if save_dir and self.consec == 0:
+            path = os.path.join(save_dir, "guard_last_good")
+            self.model.save(path)
+            self._anchor = path
 
 
 class VisualDL(Callback):

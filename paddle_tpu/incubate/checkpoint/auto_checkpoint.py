@@ -25,7 +25,15 @@ Integrity layer (the elastic-runtime contract):
   snapshots at the end of the in-flight epoch and exits 143, so a
   preempted job resumes with zero lost epochs;
 - every epoch entry emits a rank heartbeat (distributed.elastic) and
-  crosses the ``epoch`` fault-injection point.
+  crosses the ``epoch`` fault-injection point;
+- registered *extras* (``register(scaler=...)`` — a jit.TrainStep, an
+  amp.GradScaler, anything with state_dict + set/load_state_dict) ride
+  each generation as optional ``extra_*.pdextra`` files, carrying the
+  dynamic loss-scaler state and numerical-guard counters that restores
+  used to silently reset; the range announces itself as the numerical
+  guard's rescue target (utils/train_guard.py) and withholds the
+  periodic snapshot while a divergence streak is active, so the
+  "last good" generation a rollback restores predates the divergence.
 """
 from __future__ import annotations
 
@@ -83,21 +91,47 @@ class TrainEpochRange:
         self._io_retries = max(int(io_retries), 1)
         self._models: List = []
         self._opts: List = []
+        self._extras: List = []
         self._restored_epoch = -1
         self._preempted = False
 
     # -- state registry (the exe/program auto-registration analog) ---------
-    def register(self, model=None, optimizer=None):
+    def register(self, model=None, optimizer=None, scaler=None,
+                 extras=None):
+        """Register state to snapshot each generation. `scaler`/`extras`
+        take anything with a ``state_dict()`` plus ``set_state_dict()``
+        (or ``load_state_dict()``) — an ``amp.GradScaler``, a
+        ``jit.TrainStep`` (whose state_dict carries the fused step's
+        dynamic loss-scaler state and numerical-guard counters), a
+        ``TrainGuard``. Their files are OPTIONAL on restore so snapshots
+        taken before an extra was registered still serve."""
         if model is not None:
             self._models.append(model)
         if optimizer is not None:
             self._opts.append(optimizer)
+        for x in ([scaler] if scaler is not None else []) + list(
+                extras if extras is not None else []):
+            if not hasattr(x, "state_dict"):
+                raise TypeError(
+                    f"extra state object {type(x).__name__} has no "
+                    "state_dict()")
+            self._extras.append(x)
         return self
 
+    @staticmethod
+    def _load_extra(obj, state):
+        setter = getattr(obj, "set_state_dict", None) \
+            or getattr(obj, "load_state_dict", None)
+        if setter is not None:
+            setter(state)
+
     # -- persistence ---------------------------------------------------------
-    def _state_files(self):
+    def _state_files(self, with_extras: bool = False):
         names = [f"model_{i}.pdparams" for i in range(len(self._models))]
         names += [f"opt_{i}.pdopt" for i in range(len(self._opts))]
+        if with_extras:
+            names += [f"extra_{i}.pdextra"
+                      for i in range(len(self._extras))]
         return names
 
     def _snap_path(self, epoch: int) -> str:
@@ -133,13 +167,16 @@ class TrainEpochRange:
         os.makedirs(tmp)
         states = [m.state_dict() for m in self._models]
         states += [getattr(o, "_inner", o).state_dict() for o in self._opts]
+        states += [x.state_dict() for x in self._extras]
         crcs = {}
-        for fname, state in zip(self._state_files(), states):
+        for fname, state in zip(self._state_files(with_extras=True),
+                                states):
             fpath = os.path.join(tmp, fname)
             fio.save(state, fpath)
             crcs[fname] = fio.crc32_file(fpath)
         meta = {"epoch": epoch, "name": self.name,
-                "max_epoch_num": self.max_epoch_num, "files": crcs}
+                "max_epoch_num": self.max_epoch_num, "files": crcs,
+                "extras": [type(x).__name__ for x in self._extras]}
         mpath = os.path.join(tmp, "meta.json")
         with open(mpath, "w") as f:
             json.dump(meta, f)
@@ -205,7 +242,26 @@ class TrainEpochRange:
                 raise CheckpointCorruptError(
                     f"unreadable snapshot file {fname} in {snap_dir}: {e}"
                 ) from e
-        return meta, states
+        # extras (scaler/guard state) are optional: a snapshot written
+        # before an extra was registered restores without it (counters
+        # keep their fresh defaults), but a PRESENT extra that fails to
+        # parse is corruption like any other state file
+        extra_states = []
+        for i in range(len(self._extras)):
+            fpath = os.path.join(snap_dir, f"extra_{i}.pdextra")
+            if not os.path.exists(fpath):
+                extra_states.append(None)
+                continue
+            try:
+                extra_states.append(
+                    fio.load(fpath, return_numpy=True))
+            except (OSError, IOError):
+                raise
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"unreadable snapshot file extra_{i}.pdextra in "
+                    f"{snap_dir}: {e}") from e
+        return meta, states + extra_states
 
     def _read_with_retry(self, snap_dir: str):
         delay = 0.05
@@ -240,11 +296,16 @@ class TrainEpochRange:
                       f"unusable ({e}); falling back to previous",
                       file=sys.stderr, flush=True)
                 continue
-            n_models = len(self._models)
+            n_models, n_opts = len(self._models), len(self._opts)
             for m, state in zip(self._models, states[:n_models]):
                 m.set_state_dict(state)
-            for o, state in zip(self._opts, states[n_models:]):
+            for o, state in zip(self._opts,
+                                states[n_models:n_models + n_opts]):
                 getattr(o, "_inner", o).set_state_dict(state)
+            for x, state in zip(self._extras,
+                                states[n_models + n_opts:]):
+                if state is not None:
+                    self._load_extra(x, state)
             self._restored_epoch = int(meta["epoch"])
             return self._restored_epoch + 1
         return 0
@@ -257,10 +318,15 @@ class TrainEpochRange:
         from ...distributed.elastic import (
             heartbeat, install_preempt_notice, restore_preempt_notice,
         )
+        from ...utils import train_guard
         from ...utils.fault_injection import fault_point
 
         start = self.restore()
         old_term = install_preempt_notice(self._on_notice)
+        # announce this range as the numerical guard's rescue target:
+        # past PADDLE_GUARD_MAX_SKIPS consecutive bad steps the guard
+        # restores the last CRC-verified generation through restore()
+        train_guard.set_rescue_target(self)
         try:
             for epoch in range(start, self.max_epoch_num):
                 fault_point("epoch")
@@ -272,14 +338,38 @@ class TrainEpochRange:
                     # just finished, then exit with the SIGTERM code so
                     # the launcher knows not to relaunch — unless this
                     # WAS the final epoch, in which case the run simply
-                    # completed
-                    self._save(epoch)
+                    # completed. Same divergence gate as the periodic
+                    # save: a preemption landing mid-streak must not
+                    # commit the diverged params as the newest
+                    # generation the relaunch (or a rollback) restores.
+                    if train_guard.divergence_active():
+                        print(
+                            f"paddle_tpu.auto_checkpoint: preemption "
+                            f"snapshot of epoch {epoch} withheld "
+                            "(numerical guard reports an active "
+                            "divergence streak); resuming from the "
+                            "previous generation",
+                            file=sys.stderr, flush=True)
+                    else:
+                        self._save(epoch)
                     if last:
                         break
                     raise SystemExit(_PREEMPT_RC)
                 if (epoch + 1) % self._inter == 0 or last:
-                    self._save(epoch)
+                    # a diverging epoch (guard mid-streak: spiking loss
+                    # whose finite updates DID apply) must not commit a
+                    # poisoned generation as "last good" — rollback's
+                    # whole value is restoring a pre-divergence snapshot
+                    if train_guard.divergence_active():
+                        print(
+                            f"paddle_tpu.auto_checkpoint: epoch {epoch} "
+                            "snapshot withheld (numerical guard reports "
+                            "an active divergence streak)",
+                            file=sys.stderr, flush=True)
+                    else:
+                        self._save(epoch)
         finally:
+            train_guard.set_rescue_target(None)
             restore_preempt_notice(old_term)
 
 

@@ -21,6 +21,8 @@ from ..core import autograd as AG
 from ..core import random as rnd
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
+from ..utils import fault_injection as _FI
+from ..utils import train_guard as _TG
 from .functional_call import _swapped, _trace_rng
 
 
@@ -96,6 +98,9 @@ class TrainStep:
         self._scaler_state = ()       # (scale, good, bad) traced state
         self._recompute = False
         self._delegate = None         # localsgd routes to LocalSGDStep
+        self._guard = None            # set below (delegate owns its own)
+        self._guard_state = ()
+        self._inject_enabled = False
         strategy = getattr(optimizer, "user_defined_strategy", None)
         if strategy is not None:
             if strategy.localsgd:
@@ -158,6 +163,23 @@ class TrainStep:
                 ):
                     o._data = jax.device_put(o._data, repl)
         self._donate = donate and jax.default_backend() != "cpu"
+        # -- numerical guardrails (utils/train_guard.py): the in-graph
+        # sentinel + skip masking engage unless PADDLE_GUARD_MODE=off;
+        # the guard-policy counters ride the program as a small f32
+        # carry, observed by the host monitor every few steps through
+        # an async prefetch (no per-step device sync).
+        self._guard_mode = _TG.guard_mode()
+        self._guard = (_TG.TrainGuard(mode=self._guard_mode, model=model)
+                       if self._guard_mode != "off" else None)
+        self._guard_state = ()
+        if self._guard is not None:
+            self._guard._on_rollback = self._after_rollback
+            self._guard_state = self._place_guard_state(
+                _TG.init_guard_state())
+        # grad-poison fault injection (PADDLE_FAULT_SPEC=grad:nan:N):
+        # decided once at construction — a clean spec keeps the compiled
+        # program byte-identical to the unguarded seed program
+        self._inject_enabled = _FI.has_site("grad")
         # per-param "participates in the loss" mask, decided once by jaxpr
         # analysis at first call: unused params keep eager semantics (no
         # update at all) instead of receiving zero grads + decay.
@@ -213,7 +235,7 @@ class TrainStep:
         return loss_raw, (new_b, out_raw if self._ret_out else None)
 
     def _step_fn(self, p_raws, opt_state, b_raws, key, lr, t, scaler_state,
-                 in_raws, label_raws):
+                 guard_state, inject, in_raws, label_raws):
         if self._loss_scale_cfg is None:
             (loss, (new_b, outs)), grads = jax.value_and_grad(
                 lambda p: self._loss_of(p, b_raws, key, in_raws, label_raws),
@@ -239,6 +261,14 @@ class TrainStep:
         if self._used_mask is not None:
             grads = [g if used else None
                      for g, used in zip(grads, self._used_mask)]
+        if self._inject_enabled:
+            # PADDLE_FAULT_SPEC=grad:nan|inf|spike — the traced selector
+            # poisons every grad in-graph (x1 on clean steps is exact,
+            # so the armed program stays numerically identical when idle)
+            factor = jnp.asarray(
+                [1.0, jnp.nan, jnp.inf, 1e4], jnp.float32)[inject]
+            grads = [None if g is None else g * factor.astype(g.dtype)
+                     for g in grads]
         grads = self._process_grads(list(p_raws), grads)
         if self._loss_scale_cfg is not None:
             # bias-correction time must count APPLIED updates, not
@@ -248,23 +278,49 @@ class TrainStep:
         new_p, new_state = self.opt._functional_update(
             self._p_objs, list(p_raws), grads, opt_state, lr, t
         )
-        if self._loss_scale_cfg is not None:
+        if self._guard is not None:
+            # the sentinel: one fused grad reduction + scalar flags;
+            # the policy update folds in spike detection and returns the
+            # apply verdict (nonfinite OR exploded-gnorm steps mask)
+            ok, bits, gnorm = _TG.grad_health(loss, grads, new_p)
+            guard_state, ok_apply = _TG.update_guard_state(
+                guard_state, ok, bits, gnorm, loss
+            )
+            if self._loss_scale_cfg is not None:
+                # the scaler's skip masking doubles as the guard's, and
+                # a guard trip counts as a bad step -> scale backoff
+                new_p, new_state, scaler_state = self._apply_loss_scaling(
+                    grads, p_raws, opt_state, new_p, new_state,
+                    scaler_state, finite=ok_apply,
+                )
+            else:
+                new_p = _TG.mask_step(ok_apply, tuple(new_p),
+                                      tuple(p_raws))
+                new_state = _TG.mask_step(ok_apply, new_state, opt_state)
+            # forward-updated buffers (BN stats) are masked too: a
+            # nonfinite activation pass must not poison running stats
+            new_b = _TG.mask_step(ok_apply, new_b, b_raws)
+        elif self._loss_scale_cfg is not None:
             new_p, new_state, scaler_state = self._apply_loss_scaling(
                 grads, p_raws, opt_state, new_p, new_state, scaler_state
             )
-        return loss, new_p, new_state, new_b, outs, scaler_state
+        return (loss, new_p, new_state, new_b, outs, scaler_state,
+                guard_state)
 
     def _apply_loss_scaling(self, grads, p_raws, opt_state, new_p, new_state,
-                            scaler_state):
+                            scaler_state, finite=None):
         """Fused check_finite_and_unscale + update_loss_scaling
         (operators/amp/check_finite_and_unscale_op.cc,
         update_loss_scaling_op.cc): ONE all-grads finite reduction in the
         compiled program — no per-param host sync (r3 weak #3). Non-finite
-        steps keep params/state and shrink the scale."""
+        steps keep params/state and shrink the scale. The numerical guard
+        passes its (wider: loss + grads + params) health word as `finite`
+        so a guard trip also backs the scale off."""
         cfg = self._loss_scale_cfg
-        finite = jnp.all(jnp.stack([
-            jnp.isfinite(g).all() for g in grads if g is not None
-        ]))
+        if finite is None:
+            finite = jnp.all(jnp.stack([
+                jnp.isfinite(g).all() for g in grads if g is not None
+            ]))
         sel = lambda new, old: jax.tree_util.tree_map(
             lambda n, o: jnp.where(finite, n, o), new, old
         )
@@ -308,6 +364,66 @@ class TrainStep:
         return process_grads(
             self.opt, self._p_objs, p_raws, g_raws, self._grad_post_hook
         )
+
+    def _place_guard_state(self, gs):
+        """Replicate the guard carry on the hybrid mesh (same reason the
+        ctor normalizes param placement: a single-device operand among
+        mesh-placed ones changes the input signature after GSPMD
+        normalizes the outputs — one full retrace of the step)."""
+        from ..distributed import comm as _comm
+
+        mesh = _comm.hybrid_mesh()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            gs = jax.device_put(gs, NamedSharding(mesh, _P()))
+        return gs
+
+    def _after_rollback(self):
+        """Guard rollback hook: the checkpoint restore already rewrote
+        p_objs/opt (and, when this step is registered as an extra, the
+        scaler + guard counters through set_state_dict) — re-seed the
+        device guard carry from the restored host counters."""
+        if self._guard is not None:
+            self._guard_state = self._place_guard_state(
+                self._guard.restored_device_state())
+
+    # -- persisted step state (the auto_checkpoint `extras` contract) -----
+    def state_dict(self):
+        """Dynamic loss-scaler state (scale, growth counter, skip count,
+        applied-update clock) + guard counters — the step state that was
+        silently lost on save/restore before this landed. Register the
+        step with TrainEpochRange (``register(extras=step)``) to carry
+        it through snapshot generations."""
+        import numpy as np
+
+        out = {}
+        if self._loss_scale_cfg is not None:
+            scale, good, bad, t_applied = self._scaler_state
+            out["scaler"] = {
+                "scale": float(np.asarray(scale)),
+                "good_steps": int(np.asarray(good)),
+                "bad_steps": int(np.asarray(bad)),
+                "applied_steps": int(np.asarray(t_applied)),
+            }
+        if self._guard is not None:
+            out["guard"] = self._guard.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        state = dict(state or {})
+        sc = state.get("scaler")
+        if self._loss_scale_cfg is not None and sc:
+            self._scaler_state = (
+                jnp.asarray(sc["scale"], jnp.float32),
+                jnp.asarray(sc["good_steps"], jnp.int32),
+                jnp.asarray(sc["bad_steps"], jnp.int32),
+                jnp.asarray(sc["applied_steps"], jnp.int32),
+            )
+        if self._guard is not None and state.get("guard"):
+            self._guard.set_state_dict(state["guard"])
+            self._guard_state = self._place_guard_state(
+                self._guard.restored_device_state())
 
     # -- eager entry ---------------------------------------------------------
     def __call__(self, inputs, labels=None):
@@ -362,10 +478,16 @@ class TrainStep:
                 pin(b_raws),                             # new_b
                 None,                                    # outs
                 None,                                    # scaler_state
+                pin(self._guard_state),                  # guard_state
             )
-            # params, opt state, buffers — and the loss-scaler state when
-            # dynamic scaling is on (replaced every step, same shape) —
-            # are donated so XLA updates them in place in HBM
+            # params, opt state, buffers — and the loss-scaler state
+            # when dynamic scaling is on (replaced every step, same
+            # shape) — are donated so XLA updates them in place in HBM.
+            # The guard carry is NOT donated: the host monitor still
+            # holds the previous step's vector for its deferred read
+            # (observe()'s async prefetch), and donating it would
+            # invalidate that buffer the moment it is re-passed — a
+            # 40-byte array buys nothing from donation anyway.
             donate = (0, 1, 2) if self._donate else ()
             if self._donate and self._loss_scale_cfg is not None:
                 donate = donate + (6,)
@@ -377,11 +499,15 @@ class TrainStep:
         opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         t = jnp.asarray(opt._step_count, jnp.float32)
-        loss, new_p, new_state, new_b, outs, self._scaler_state = \
-            self._jitted(
-                p_raws, opt_state, b_raws, key, lr, t, self._scaler_state,
-                in_raws, label_raws
-            )
+        inject = (_FI.consume_grad_action() if self._inject_enabled else 0)
+        if self._guard is not None:
+            self._guard.capture(key, in_raws, label_raws)
+        (loss, new_p, new_state, new_b, outs, self._scaler_state,
+         self._guard_state) = self._jitted(
+            p_raws, opt_state, b_raws, key, lr, t, self._scaler_state,
+            self._guard_state, jnp.asarray(inject, jnp.int32),
+            in_raws, label_raws
+        )
         for p, raw in zip(self._p_objs, new_p):
             p._data = raw
             p._node = None
@@ -390,6 +516,11 @@ class TrainStep:
         for b, raw in zip(self._b_objs, new_b):
             b._data = raw
             b._node = None
+        if self._guard is not None:
+            # lazy, interval-synced policy read; on rollback the guard's
+            # _on_rollback hook (-> _after_rollback) has already
+            # refreshed the device carries
+            self._guard.observe(self._guard_state)
         loss_t = Tensor._wrap(loss, stop_gradient=True)
         if self._ret_out:
             outs_t = jax.tree_util.tree_map(

@@ -10,19 +10,27 @@
   ``acp.save`` (before an auto-checkpoint snapshot), ``epoch`` (on
   entering each TrainEpochRange epoch), ``coll`` (inside each eager
   collective's monitored region, distributed/comm_monitor.py — the
-  collective timeout watchdog's prey).
+  collective timeout watchdog's prey), ``grad`` (once per compiled
+  TrainStep call, host side — the numerical-guard matrix's prey).
 - ``action`` one of ``fail`` (raise InjectedFault, an IOError),
   ``hang`` (sleep ``arg`` seconds, default 3600 — the watchdog's prey),
   ``kill`` (``os._exit(arg)``, default 17 — a hard preemption),
   ``corrupt`` (truncate the file the site passed via ``path=`` to half
-  its bytes — a torn write), or ``desync`` (``coll`` only: arm a flag
+  its bytes — a torn write), ``desync`` (``coll`` only: arm a flag
   the comm monitor consumes to mutate this rank's op fingerprint, as if
   it had issued a DIFFERENT collective; ``arg`` selects the rank the
-  rule fires on, default 0, so one job-wide spec desyncs one rank).
+  rule fires on, default 0, so one job-wide spec desyncs one rank), or
+  ``nan`` / ``inf`` / ``spike`` (``grad`` only: arm a flag the compiled
+  step consumes to poison that step's gradients IN-GRAPH with NaN /
+  Inf / a x1e4 magnitude spike — a traced operand selects the poison,
+  so the injection never retraces the program; ``arg`` = how many
+  consecutive step calls the rule stays armed, default 1, e.g.
+  ``grad:nan:3:5`` poisons steps 3-7).
 - ``nth``    1-based per-process call count at which the rule fires
   (each call to a site increments that site's counter), so a relaunched
   attempt that resumes later in training naturally skips the fault.
-- ``arg``    optional action parameter (kill exit code / hang seconds).
+- ``arg``    optional action parameter (kill exit code / hang seconds /
+  nan-inf-spike repeat count).
 
 Example: ``PADDLE_FAULT_SPEC="io.save:fail:1,epoch:hang:3"`` fails the
 first save and hangs the process on entering its 3rd epoch.
@@ -39,12 +47,16 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
-           "reset"]
+           "has_site", "consume_grad_action", "GRAD_POISONS", "reset"]
 
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
-_ACTIONS = ("fail", "hang", "kill", "corrupt", "desync")
+_ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
+            "spike")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
+# grad poison only makes sense where a compiled step consumes the flag
+_GRAD_ACTIONS = ("nan", "inf", "spike")
+_GRAD_SITES = ("grad",)
 # sites that pass a file path to fault_point (the only places a corrupt
 # rule can bite) — a corrupt rule elsewhere would be a silent no-op, so
 # the parser rejects it loudly instead
@@ -98,13 +110,29 @@ class FaultInjector:
                     f"desync rule targets un-instrumented site {site!r} "
                     f"(fingerprint-recording sites: {_DESYNC_SITES})"
                 )
+            if action in _GRAD_ACTIONS and site not in _GRAD_SITES:
+                raise ValueError(
+                    f"{action} rule targets un-instrumented site {site!r} "
+                    f"(grad-poisoning sites: {_GRAD_SITES})"
+                )
             arg = parts[3] if len(parts) > 3 else None
             self._rules.append(_Rule(site, action, nth, arg))
 
     def fire(self, site: str, path: Optional[str] = None) -> None:
         count = self._counts[site] = self._counts.get(site, 0) + 1
         for r in self._rules:
-            if r.site != site or r.nth != count:
+            if r.site != site:
+                continue
+            if r.action in _GRAD_ACTIONS:
+                # grad poison stays armed for `arg` consecutive calls
+                repeat = int(r.arg) if r.arg else 1
+                if r.nth <= count < r.nth + repeat:
+                    print(f"fault_injection: arming grad:{r.action} at "
+                          f"{site} (hit {count})", file=sys.stderr,
+                          flush=True)
+                    self.flags.add(f"grad:{r.action}")
+                continue
+            if r.nth != count:
                 continue
             self._act(r, site, count, path)
 
@@ -168,6 +196,27 @@ def consume_flag(flag: str) -> bool:
         inj.flags.discard(flag)
         return True
     return False
+
+
+def has_site(site: str) -> bool:
+    """Does the active spec carry any rule for `site`? Compiled steps use
+    this ONCE at trace time to decide whether to thread the in-graph
+    poison operand (a clean spec keeps the program byte-identical)."""
+    return any(r.site == site for r in _injector()._rules)
+
+
+#: traced poison selector values the compiled step consumes
+GRAD_POISONS = {"nan": 1, "inf": 2, "spike": 3}
+
+
+def consume_grad_action() -> int:
+    """Fire the ``grad`` site for this step call and consume any armed
+    poison flag; returns the GRAD_POISONS code (0 = clean step)."""
+    fault_point("grad")
+    for name, code in GRAD_POISONS.items():
+        if consume_flag(f"grad:{name}"):
+            return code
+    return 0
 
 
 def reset() -> None:

@@ -1,0 +1,47 @@
+"""Numerical-guard E2E helper: deterministic TrainStep training whose
+gradients are poisoned from the env (PADDLE_FAULT_SPEC=grad:nan:N[:R]),
+run under the elastic launcher so guard events / aborts / rollbacks are
+exercised through the real ElasticManager.
+
+Env:
+  GUARD_TRAIN_LOG        path to append one JSON line per step
+  GUARD_TRAIN_STEPS      steps to run (default 8)
+  PADDLE_GUARD_*         guard knobs (mode/max skips/sync interval)
+  PADDLE_FAULT_SPEC      grad-poison rules (utils/fault_injection)
+  PADDLE_LAUNCH_ATTEMPT  set by the launcher
+"""
+import json
+import os
+
+from paddle_tpu.core.device import force_cpu_devices
+
+force_cpu_devices(1)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+
+STEPS = int(os.environ.get("GUARD_TRAIN_STEPS", "8"))
+attempt = int(os.environ.get("PADDLE_LAUNCH_ATTEMPT", "0"))
+log_path = os.environ.get("GUARD_TRAIN_LOG")
+
+paddle.seed(0)
+model = nn.Linear(4, 4)
+opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+from paddle_tpu.jit import TrainStep  # noqa: E402
+
+step = TrainStep(model, lambda o, y: ((o - y) ** 2).mean(), opt)
+rng = np.random.RandomState(0)
+x = rng.rand(8, 4).astype(np.float32)
+y = np.ones((8, 4), np.float32)
+for i in range(STEPS):
+    loss = step(x, y)
+    if log_path:
+        with open(log_path, "a") as f:
+            f.write(json.dumps({
+                "attempt": attempt, "step": i,
+                "loss": float(loss.numpy()),
+            }) + "\n")
+if step._guard is not None:
+    step._guard.flush()

@@ -542,6 +542,38 @@ class TestBenchContinuity:
         assert rc == 0, "\n".join(lines)
         assert any("waived" in l for l in lines)
 
+    def test_guard_overhead_gate(self, tmp_path):
+        """ISSUE 5: the sentinel-on vs sentinel-off GPT pair is gated at
+        <2% overhead; a breach fails like any unannotated regression,
+        and a note naming guard_overhead_pct waives it."""
+        bc = self._tool()
+        base = {"_value": 100.0,
+                "gpt_medium_bf16_tokens_per_sec": 27000.0}
+        ok_cur = {"_value": 100.0,
+                  "gpt_medium_bf16_tokens_per_sec": 27000.0,
+                  "gpt_medium_bf16_tokens_per_sec_spread":
+                      {"n": 3, "median": 27000.0},
+                  "guard_overhead_pct": 1.4}
+        self._write_pair(tmp_path, dict(base), dict(ok_cur))
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 0, "\n".join(lines)
+        assert any("guard_overhead_pct" in l and "ok" in l
+                   for l in lines)
+        bad_cur = dict(ok_cur)
+        bad_cur["guard_overhead_pct"] = 4.2
+        self._write_pair(tmp_path, dict(base), bad_cur)
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 1
+        assert any("guard_overhead_pct" in l and "REGRESS" in l
+                   for l in lines)
+        waived_cur = dict(ok_cur)
+        waived_cur["guard_overhead_pct"] = 4.2
+        waived_cur["note"] = ("guard_overhead_pct over budget: "
+                              "PADDLE_GUARD_CHECK_PARAMS=1 this round")
+        self._write_pair(tmp_path, dict(base), waived_cur)
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 0, "\n".join(lines)
+
     def test_prefix_sibling_annotation_does_not_waive(self, tmp_path):
         """Annotating x_per_sec_dense must NOT waive its prefix sibling
         x_per_sec — whole-name matching only."""

@@ -216,7 +216,15 @@ def test_static_interior_vars_report_dynamic_batch(static_mode):
 def test_static_nn_builders_train_with_bn_stats(static_mode):
     """paddle.static.nn fluid-style builders (fc/conv2d/batch_norm/
     embedding) inside a recorded program, incl. the persistable-state
-    write-back of batch-norm running stats (executor.cc scope update)."""
+    write-back of batch-norm running stats (executor.cc scope update).
+
+    Root cause of the long-documented failure here: the old version fed
+    freshly-resampled random noise with INDEPENDENTLY random labels every
+    step — an unlearnable task, so 10 SGD steps had no reason to descend
+    (the BN machinery was never at fault: on a fixed batch the recorded
+    conv+BN+fc program descends monotonically, verified below). Training
+    now runs on one fixed batch — pure optimization — while the stat
+    write-back is still exercised by every run."""
     from paddle_tpu.static import nn as static_nn
 
     main, startup = static_mode
@@ -228,12 +236,6 @@ def test_static_nn_builders_train_with_bn_stats(static_mode):
     loss = F.cross_entropy(h, y)
     optimizer.SGD(learning_rate=0.1).minimize(loss)
 
-    # find the BN layer's running-mean buffer through the program leaves
-    bn_buffers = [
-        t for op in main.ops for t in op.inputs
-        if hasattr(t, "_data") and not getattr(t, "trainable", True)
-        and getattr(t, "persistable", True) and t.__class__.__name__ == "Tensor"
-    ]
     assert main.state_writes, "batch_norm must register stat writes"
     rm_obj = main.state_writes[0][0]
     rm_before = np.asarray(rm_obj._data).copy()
@@ -241,15 +243,15 @@ def test_static_nn_builders_train_with_bn_stats(static_mode):
     exe = paddle.static.Executor()
     exe.run(startup)
     rng = np.random.RandomState(0)
+    fx = rng.rand(16, 1, 8, 8).astype(np.float32) + 1.0
+    fy = rng.randint(0, 10, 16).astype(np.int64)
     losses = []
     for _ in range(10):
-        lv, = exe.run(
-            feed={"img": rng.rand(16, 1, 8, 8).astype(np.float32) + 1.0,
-                  "y": rng.randint(0, 10, 16).astype(np.int64)},
-            fetch_list=[loss],
-        )
+        lv, = exe.run(feed={"img": fx, "y": fy}, fetch_list=[loss])
         losses.append(float(lv))
+    # fixed batch -> the recorded fwd+bwd+update program must descend
     assert losses[-1] < losses[0]
+    assert all(b <= a + 1e-6 for a, b in zip(losses, losses[1:]))
     rm_after = np.asarray(rm_obj._data)
     assert not np.allclose(rm_before, rm_after)  # stats actually moved
 

@@ -1,0 +1,638 @@
+"""Numerical guardrails for the compiled training step (ISSUE 5 matrix).
+
+Fast layers:
+- the in-graph sentinel: injected nan/inf gradients make the fused
+  TrainStep a no-op (params/opt state pass through), guard-off runs are
+  bitwise-identical to the seed TrainStep numerics;
+- spike policy: an exploded-gradient step is masked BEFORE it applies,
+  a sustained streak exhausts the budget;
+- divergence policy: past PADDLE_GUARD_MAX_SKIPS the guard restores the
+  last auto_checkpoint generation (bitwise params) or raises;
+- the fp16 dynamic loss scaler backs off on guard trips and its state
+  (+ guard counters) round-trips through auto_checkpoint extras;
+- deterministic replay: the captured bundle re-executed eagerly under
+  FLAGS_check_nan_inf names the injected op (forward AND backward);
+- GuardCallback: the hapi-level skip/rescue policy;
+- ElasticManager attribution of guard events.
+
+The `slow` E2E runs a jax child under the real elastic launcher and
+asserts the guard abort (rc=96) is attributed from the event stream.
+"""
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPERS = os.path.join(REPO, "tests", "helpers")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def guard_env(monkeypatch, tmp_path):
+    """Scoped guard knobs: tight sync interval, clean injector, event
+    file + dump dir in tmp. Yields the monkeypatch."""
+    from paddle_tpu.utils import fault_injection
+
+    for k in ("PADDLE_FAULT_SPEC", "PADDLE_GUARD_MODE",
+              "PADDLE_GUARD_MAX_SKIPS", "PADDLE_GUARD_SYNC_EVERY",
+              "PADDLE_GUARD_SPIKE_FACTOR", "PADDLE_GUARD_EWMA",
+              "PADDLE_GUARD_SPIKE_WARMUP", "PADDLE_GUARD_EVENT_FILE",
+              "PADDLE_GUARD_DUMP_DIR", "PADDLE_GUARD_CHECK_PARAMS"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("PADDLE_GUARD_SYNC_EVERY", "1")
+    monkeypatch.setenv("PADDLE_GUARD_EVENT_FILE", str(tmp_path / "ev"))
+    fault_injection.reset()
+    yield monkeypatch
+    # _mk_step writes the spec into os.environ directly (the injector
+    # re-parses per read) — scrub it so later modules start clean
+    os.environ.pop("PADDLE_FAULT_SPEC", None)
+    fault_injection.reset()
+
+
+def _mk_step(lr=0.1, seed=0, spec=None):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.utils import fault_injection
+
+    if spec is not None:
+        os.environ["PADDLE_FAULT_SPEC"] = spec
+        fault_injection.reset()
+    paddle.seed(seed)
+    m = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=lr, parameters=m.parameters())
+    step = TrainStep(m, lambda o, y: ((o - y) ** 2).mean(), opt)
+    return m, opt, step
+
+
+_X = np.arange(32, dtype=np.float32).reshape(8, 4) / 32.0
+_Y = np.ones((8, 4), np.float32)
+
+
+def _events(tmp_path):
+    p = tmp_path / "ev"
+    if not p.exists():
+        return []
+    return [json.loads(l) for l in p.read_text().splitlines()]
+
+
+class TestSentinel:
+    def test_injected_nan_step_is_skipped_in_graph(self, guard_env,
+                                                   tmp_path):
+        """Acceptance pin: PADDLE_FAULT_SPEC=grad:nan:N poisons step N's
+        grads inside the compiled program; the sentinel masks the update
+        (params/opt state bitwise-unchanged) and training continues."""
+        m, opt, step = _mk_step(spec="grad:nan:3")
+        w = []
+        for _ in range(4):
+            loss = step(_X, _Y)
+            w.append(m.weight.numpy().copy())
+        assert np.isfinite(float(loss.numpy()))
+        # step 3 was a no-op; step 4 advanced again
+        np.testing.assert_array_equal(w[1], w[2])
+        assert not np.array_equal(w[2], w[3])
+        step._guard.flush()
+        assert step._guard._last[1] == 1.0          # one total skip
+        evs = _events(tmp_path)
+        assert any(e["event"] == "guard_skip"
+                   and "grads nonfinite" in e["detail"] for e in evs)
+
+    def test_injected_inf_step_is_skipped(self, guard_env):
+        m, opt, step = _mk_step(spec="grad:inf:2")
+        w = []
+        for _ in range(3):
+            step(_X, _Y)
+            w.append(m.weight.numpy().copy())
+        np.testing.assert_array_equal(w[0], w[1])
+        assert not np.array_equal(w[1], w[2])
+        assert np.isfinite(w[2]).all()
+
+    def test_guard_off_matches_seed_numerics(self, guard_env):
+        """Parity pin: mode=skip on healthy data is bitwise-identical to
+        mode=off (the masking select is exact on healthy steps), so
+        guardrails-by-default change nothing but the failure mode."""
+        m1, _, s1 = _mk_step(seed=7)
+        for _ in range(5):
+            l1 = s1(_X, _Y)
+        guard_env.setenv("PADDLE_GUARD_MODE", "off")
+        m2, _, s2 = _mk_step(seed=7)
+        assert s2._guard is None
+        for _ in range(5):
+            l2 = s2(_X, _Y)
+        np.testing.assert_array_equal(m1.weight.numpy(), m2.weight.numpy())
+        np.testing.assert_array_equal(m1.bias.numpy(), m2.bias.numpy())
+        np.testing.assert_array_equal(np.asarray(l1._data),
+                                      np.asarray(l2._data))
+
+    def test_gnorm_spike_masked_before_it_applies(self, guard_env):
+        """A x1e4 gradient spike is caught by the grad-norm EWMA and
+        masked BEFORE the update applies — the loss never explodes."""
+        guard_env.setenv("PADDLE_GUARD_SPIKE_FACTOR", "5")
+        guard_env.setenv("PADDLE_GUARD_SPIKE_WARMUP", "2")
+        m, opt, step = _mk_step(spec="grad:spike:4:2")
+        losses = []
+        for _ in range(7):
+            losses.append(float(step(_X, _Y).numpy()))
+        assert max(losses) < 10.0, f"spike leaked into params: {losses}"
+        step._guard.flush()
+        assert step._guard._last[1] >= 2            # both masked
+        from paddle_tpu.utils.train_guard import HEALTH_GNORM
+
+        assert int(step._guard._last[5]) & HEALTH_GNORM
+
+    def test_scaler_backs_off_on_guard_trip(self, guard_env):
+        """fp16 dynamic loss scaling composes: the guard's health word
+        feeds the scaler, so a tripped step counts bad and the scale
+        halves after decr_every_n_nan_or_inf."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.utils import fault_injection
+
+        guard_env.setenv("PADDLE_FAULT_SPEC", "grad:nan:2:2")
+        fault_injection.reset()
+        paddle.seed(0)
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        strategy.amp_configs = {
+            "use_pure_fp16": True, "use_dynamic_loss_scaling": True,
+            "init_loss_scaling": 1024.0, "incr_every_n_steps": 1000,
+            "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0,
+            "decr_ratio": 0.5,
+        }
+        fleet.init(is_collective=True, strategy=strategy)
+        m = nn.Linear(4, 4)
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(learning_rate=0.1, parameters=m.parameters()))
+        step = TrainStep(m, lambda o, y: ((o - y) ** 2).mean(), opt)
+        for _ in range(4):
+            step(_X, _Y)
+        assert float(np.asarray(step._scaler_state[0])) == 512.0
+        sd = step.state_dict()
+        assert sd["scaler"]["scale"] == 512.0
+        assert sd["guard"]["total_skips"] >= 2
+
+    def test_localsgd_step_shares_the_sentinel(self, guard_env):
+        """LocalSGDStep (the alternate compiled step) carries the same
+        sentinel through the shared process_grads seam: a nonfinite
+        batch skips the update on every worker replica."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.jit import TrainStep
+
+        paddle.seed(0)
+        strategy = DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        m = nn.Linear(4, 4)
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(learning_rate=0.1, parameters=m.parameters()))
+        step = TrainStep(m, lambda o, y: ((o - y) ** 2).mean(), opt)
+        ls = step._delegate
+        assert ls is not None and ls._guard is not None
+        n = ls.dp
+        xb = np.tile(_X, (n, 1))
+        yb = np.tile(_Y, (n, 1))
+        step(xb, yb)
+        before = [np.asarray(q) for q in ls._stk_p]
+        bad = xb.copy()
+        bad[0, 0] = np.inf                 # poisons ONE worker's batch
+        step(bad, yb)
+        after = [np.asarray(q) for q in ls._stk_p]
+        for b, a in zip(before, after):    # every replica skipped
+            np.testing.assert_array_equal(b, a)
+        step(xb, yb)
+        assert any(not np.array_equal(np.asarray(q), b)
+                   for q, b in zip(ls._stk_p, before))
+
+    def test_localsgd_gnorm_spike_masked_before_apply(self, guard_env):
+        """The gnorm-spike verdict (EWMA state lives outside the
+        shard_map) masks the STACKED outputs too: a finite gradient
+        explosion is a no-op in LocalSGD, same as TrainStep."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.utils.train_guard import HEALTH_GNORM
+
+        guard_env.setenv("PADDLE_GUARD_SPIKE_FACTOR", "5")
+        guard_env.setenv("PADDLE_GUARD_SPIKE_WARMUP", "2")
+        paddle.seed(0)
+        strategy = DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 3, "begin_step": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        m = nn.Linear(4, 4)
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(learning_rate=0.01, parameters=m.parameters()))
+        step = TrainStep(m, lambda o, y: ((o - y) ** 2).mean(), opt)
+        ls = step._delegate
+        xb = np.tile(_X, (ls.dp, 1))
+        yb = np.tile(_Y, (ls.dp, 1))
+        for _ in range(4):                 # seed the EWMAs
+            step(xb, yb)
+        before = [np.asarray(q) for q in ls._stk_p]
+        step(xb * 300.0, yb)               # finite, ~1e5x grad norm
+        after = [np.asarray(q) for q in ls._stk_p]
+        for b, a in zip(before, after):
+            np.testing.assert_array_equal(b, a)
+        ls._guard.flush()
+        assert int(ls._guard._last[5]) & HEALTH_GNORM
+
+
+class TestRollback:
+    def test_max_skips_restores_pre_injection_snapshot_bitwise(
+            self, guard_env, tmp_path):
+        """Acceptance pin: a sustained injected NaN exhausts
+        PADDLE_GUARD_MAX_SKIPS and the guard restores the last
+        auto_checkpoint generation — params bitwise-identical to the
+        pre-injection snapshot."""
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+            TrainEpochRange,
+        )
+
+        guard_env.setenv("PADDLE_GUARD_MAX_SKIPS", "3")
+        # steps 7.. poisoned: epoch 0 (steps 1-3) and epoch 1 (4-6)
+        # snapshot clean; epoch 2 trips the budget mid-epoch
+        m, opt, step = _mk_step(spec="grad:nan:7:6")
+        r = TrainEpochRange(4, name="g_rb",
+                            checkpoint_path=str(tmp_path / "ck"))
+        r.register(model=m, optimizer=opt, scaler=step)
+        snap_w = {}
+        for epoch in r.get():
+            for _ in range(3):
+                step(_X, _Y)
+            snap_w[epoch] = m.weight.numpy().copy()
+        assert step._guard.rollbacks >= 1
+        evs = _events(tmp_path)
+        rb = [e for e in evs if e["event"] == "guard_rollback"]
+        assert rb and rb[0]["restored_epoch"] is not None
+        restored = int(rb[0]["restored_epoch"])
+        # the generation it restored was written BEFORE the injection
+        # (epochs 0/1) — never a poisoned one
+        assert restored <= 1
+        # bitwise: post-restore params == that snapshot's params is
+        # implied by restore()'s set_state_dict; assert through a fresh
+        # range restoring the same generation set
+        m2, opt2, _ = _mk_step(seed=1)
+        guard_env.setenv("PADDLE_GUARD_MODE", "off")
+        r2 = TrainEpochRange(4, name="g_rb",
+                             checkpoint_path=str(tmp_path / "ck"))
+        r2.register(model=m2, optimizer=opt2)
+        r2.restore()
+        np.testing.assert_array_equal(
+            m2.weight.numpy(), snap_w[r2._restored_epoch])
+
+    def test_preemption_mid_streak_withholds_snapshot(self, guard_env,
+                                                      tmp_path):
+        """A SIGTERM landing during a divergence streak must not commit
+        the diverged epoch as the newest generation — the preempt save
+        runs through the same divergence gate as the periodic one."""
+        import signal as _signal
+
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+            TrainEpochRange,
+        )
+
+        guard_env.setenv("PADDLE_GUARD_MAX_SKIPS", "50")
+        m, opt, step = _mk_step(spec="grad:nan:4:99")
+        r = TrainEpochRange(6, name="g_pre",
+                            checkpoint_path=str(tmp_path / "ck"))
+        r.register(model=m, optimizer=opt, scaler=step)
+        with pytest.raises(SystemExit) as ei:
+            for epoch in r.get():
+                for _ in range(3):
+                    step(_X, _Y)
+                if epoch == 1:          # mid-streak (steps 4+ poisoned)
+                    os.kill(os.getpid(), _signal.SIGTERM)
+        assert ei.value.code == 143
+        # only the clean epoch-0 generation was committed
+        assert [e for e, _ in r._snapshots()] == [0]
+        from paddle_tpu.utils.train_guard import GuardDivergenceError
+
+        guard_env.setenv("PADDLE_GUARD_MAX_SKIPS", "2")
+        m, opt, step = _mk_step(spec="grad:nan:2:99")
+        with pytest.raises(GuardDivergenceError, match="consecutive bad"):
+            for _ in range(8):
+                step(_X, _Y)
+
+    def test_guard_state_round_trips_through_extras(self, guard_env,
+                                                    tmp_path):
+        """Scaler + guard counters persist through auto_checkpoint
+        generations and restore into a fresh step (the checkpoint
+        completeness bugfix; the deeper matrix lives in
+        test_fault_tolerance.py)."""
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+            TrainEpochRange,
+        )
+
+        m, opt, step = _mk_step(spec="grad:nan:2")
+        r = TrainEpochRange(2, name="g_rt",
+                            checkpoint_path=str(tmp_path / "ck"))
+        r.register(model=m, optimizer=opt, scaler=step)
+        for epoch in r.get():
+            for _ in range(3):
+                step(_X, _Y)
+        step._guard.flush()
+        assert step._guard._last[1] == 1.0
+        # fresh process analog: new step restores the guard counters
+        m2, opt2, step2 = _mk_step(seed=1)
+        r2 = TrainEpochRange(4, name="g_rt",
+                             checkpoint_path=str(tmp_path / "ck"))
+        r2.register(model=m2, optimizer=opt2, scaler=step2)
+        assert r2.restore() == 2
+        assert step2._guard.state_dict()["total_skips"] == 1.0
+        # and the device carry was re-seeded from the restored counters
+        assert float(np.asarray(step2._guard_state)[1]) == 1.0
+
+
+class TestReplay:
+    class _Exploder:
+        """exp(linear(x)): a batch of large values overflows exp."""
+
+        def __new__(cls):
+            import paddle_tpu as paddle
+            from paddle_tpu import nn
+
+            class Exploder(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.lin = nn.Linear(4, 4)
+
+                def forward(self, x):
+                    return paddle.exp(self.lin(x))
+
+            return Exploder()
+
+    def test_replay_names_the_faulting_op(self, guard_env, tmp_path):
+        """Acceptance pin: the bundle captured by the sentinel, replayed
+        eagerly under FLAGS_check_nan_inf, names the op that produced
+        the Inf — 'loss is NaN' becomes an op-level diagnosis."""
+        import paddle_tpu as paddle
+        from paddle_tpu import optimizer
+        from paddle_tpu.jit import TrainStep
+        from tools.replay_step import replay
+
+        guard_env.setenv("PADDLE_GUARD_DUMP_DIR", str(tmp_path / "dump"))
+        paddle.seed(0)
+        m = self._Exploder()
+        opt = optimizer.SGD(learning_rate=0.01,
+                            parameters=m.parameters())
+        step = TrainStep(m, lambda o, y: ((o - y) ** 2).mean(), opt)
+        for _ in range(2):
+            step(_X, _Y)
+        bad = np.full((8, 4), 200.0, np.float32)
+        step(bad, _Y)
+        step(_X, _Y)
+        step._guard.flush()
+        bundles = glob.glob(str(tmp_path / "dump" / "*.pdbundle"))
+        assert len(bundles) == 1
+        paddle.seed(0)
+        m2 = self._Exploder()
+        report = replay(bundles[0], m2,
+                        lambda o, y: ((o - y) ** 2).mean())
+        assert report["ok"] is False
+        assert report["faulting_op"] == "exp"
+        assert report["phase"] == "forward"
+        # the bundle fingerprint ties it to the emitted event
+        assert isinstance(report["fingerprint"], int)
+
+    def test_backward_nan_names_grad_op(self):
+        """FLAGS_check_nan_inf now covers the backward sweep: sqrt'(0)
+        is Inf, and the engine names the producing op + phase."""
+        import paddle_tpu as paddle
+        from paddle_tpu.core.autograd import NanInfError
+
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            t = paddle.to_tensor(np.zeros(3, np.float32))
+            t.stop_gradient = False
+            out = paddle.sqrt(t)        # forward: finite (0.0)
+            with pytest.raises(NanInfError, match="grad of op 'sqrt'"):
+                out.sum().backward()
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    @pytest.mark.slow
+    def test_replay_cli_builder_contract(self, guard_env, tmp_path):
+        """tools/replay_step.py --builder mod:fn round-trips as a
+        subprocess (the operator-facing entry point; slow: a fresh jax
+        import per invocation — the library path above is the fast
+        coverage)."""
+        import paddle_tpu as paddle
+        from paddle_tpu import optimizer
+        from paddle_tpu.jit import TrainStep
+
+        guard_env.setenv("PADDLE_GUARD_DUMP_DIR", str(tmp_path / "dump"))
+        paddle.seed(0)
+        m = self._Exploder()
+        opt = optimizer.SGD(learning_rate=0.01,
+                            parameters=m.parameters())
+        step = TrainStep(m, lambda o, y: ((o - y) ** 2).mean(), opt)
+        step(_X, _Y)
+        step(np.full((8, 4), 200.0, np.float32), _Y)
+        step(_X, _Y)
+        step._guard.flush()
+        bundle = glob.glob(str(tmp_path / "dump" / "*.pdbundle"))[0]
+        builder = tmp_path / "builder_mod.py"
+        builder.write_text(
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu import nn\n"
+            "class Exploder(nn.Layer):\n"
+            "    def __init__(self):\n"
+            "        super().__init__()\n"
+            "        self.lin = nn.Linear(4, 4)\n"
+            "    def forward(self, x):\n"
+            "        return paddle.exp(self.lin(x))\n"
+            "def build():\n"
+            "    return Exploder(), lambda o, y: ((o - y) ** 2).mean()\n"
+        )
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("PADDLE_", "FLAGS_"))}
+        env["PYTHONPATH"] = (str(tmp_path) + os.pathsep + REPO
+                             + os.pathsep + env.get("PYTHONPATH", ""))
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "replay_step.py"),
+             bundle, "--builder", "builder_mod:build"],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert out.returncode == 3, out.stderr
+        report = json.loads(out.stdout)
+        assert report["faulting_op"] == "exp"
+
+
+class TestGuardCallback:
+    class _FakeModel:
+        def __init__(self, tmp):
+            self.stop_training = False
+            self.saved = []
+            self.loaded = []
+            self._tmp = tmp
+
+        def save(self, path, training=True):
+            self.saved.append(path)
+
+        def load(self, path, **kw):
+            self.loaded.append(path)
+
+    def test_stops_training_without_anchor(self, guard_env, tmp_path):
+        from paddle_tpu.hapi.callbacks import GuardCallback
+
+        cb = GuardCallback(max_skips=2, verbose=0)
+        cb.set_model(self._FakeModel(tmp_path))
+        cb.on_train_begin()
+        for i in range(3):
+            cb.on_train_batch_end(i, {"loss": float("nan")})
+        assert cb.model.stop_training is True
+        evs = _events(tmp_path)
+        assert any(e["event"] == "guard_stop" for e in evs)
+
+    def test_restores_last_good_anchor(self, guard_env, tmp_path):
+        from paddle_tpu.hapi.callbacks import GuardCallback
+
+        cb = GuardCallback(max_skips=2, save_dir=str(tmp_path), verbose=0)
+        cb.set_model(self._FakeModel(tmp_path))
+        cb.on_train_begin()
+        for i in range(5):
+            cb.on_train_batch_end(i, {"loss": 1.0 - i * 0.01})
+        cb.on_epoch_end(0)              # writes the guard_last_good anchor
+        assert cb.model.saved
+        for i in range(2):
+            cb.on_train_batch_end(i, {"loss": float("inf")})
+        assert cb.model.loaded == [os.path.join(str(tmp_path),
+                                                "guard_last_good")]
+        assert cb.model.stop_training is False
+        assert cb.rollbacks == 1
+        evs = _events(tmp_path)
+        assert any(e["event"] == "guard_rollback" for e in evs)
+
+    def test_spike_policy_uses_host_ewma(self, guard_env, tmp_path):
+        from paddle_tpu.hapi.callbacks import GuardCallback
+
+        cb = GuardCallback(max_skips=3, spike_factor=4.0, warmup=3,
+                           verbose=0)
+        cb.set_model(self._FakeModel(tmp_path))
+        cb.on_train_begin()
+        for i in range(6):
+            cb.on_train_batch_end(i, {"loss": 1.0})
+        cb.on_train_batch_end(6, {"loss": 50.0})
+        assert cb.consec == 1
+        cb.on_train_batch_end(7, {"loss": 1.0})
+        assert cb.consec == 0
+
+
+class TestElasticAttribution:
+    def test_attribute_reads_guard_event_stream(self, tmp_path, capfd):
+        """ElasticManager._attribute names the guard verdict from the
+        per-rank PADDLE_GUARD_EVENT_FILE, exactly like collective
+        events (latest event wins)."""
+        from paddle_tpu.distributed.elastic import ElasticManager, RankProc
+
+        gev = tmp_path / "guardev.0"
+        gev.write_text(json.dumps({
+            "event": "guard_abort", "rank": 0, "time": time.time(),
+            "detail": "divergence: 8 consecutive bad steps "
+                      "(grads nonfinite, gnorm 0)",
+        }) + "\n")
+
+        class P:
+            pid = 1
+
+            def poll(self):
+                return 96
+
+        mgr = ElasticManager("x.py", [], [])
+        rp = RankProc(P(), 0, str(tmp_path / "hb"),
+                      guard_ev_path=str(gev))
+        mgr._attribute(rp, "failure (rc=96)")
+        err = capfd.readouterr().err
+        assert "attributed to guard_abort" in err
+        assert "grads nonfinite" in err
+
+    def test_fault_spec_validation(self):
+        from paddle_tpu.utils.fault_injection import FaultInjector
+
+        with pytest.raises(ValueError, match="un-instrumented"):
+            FaultInjector("io.save:nan:1")       # nan only on grad site
+        with pytest.raises(ValueError, match="un-instrumented"):
+            FaultInjector("coll:spike:1")
+        inj = FaultInjector("grad:nan:3:2")      # arms hits 3 and 4
+        for hit in range(1, 6):
+            inj.fire("grad")
+            armed = "grad:nan" in inj.flags
+            inj.flags.discard("grad:nan")
+            assert armed == (hit in (3, 4)), hit
+
+    def test_guard_mode_validation(self, guard_env):
+        from paddle_tpu.utils.train_guard import guard_mode
+
+        guard_env.setenv("PADDLE_GUARD_MODE", "sideways")
+        with pytest.raises(ValueError, match="off|skip|abort"):
+            guard_mode()
+
+
+# ---------------------------------------------------------------------------
+# E2E (slow): guard abort attributed by the real ElasticManager
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_guard_abort_attributed_by_elastic_launcher(tmp_path, capfd):
+    """Acceptance pin, full-jax E2E: sustained injected NaN under
+    PADDLE_GUARD_MODE=abort makes the rank exit rc=96 after the skip
+    budget; the ElasticManager attributes the failure to the guard_abort
+    event (op-level detail included) instead of a generic crash."""
+    from paddle_tpu.distributed.launch import launch
+    from paddle_tpu.utils.train_guard import GUARD_ABORT_RC
+
+    log = tmp_path / "log.jsonl"
+    env2 = {k: v for k, v in os.environ.items()
+            if not k.startswith(("PADDLE_", "JAX_", "XLA_"))}
+    env2["PYTHONPATH"] = REPO + os.pathsep + env2.get("PYTHONPATH", "")
+    env2["PADDLE_FAULT_SPEC"] = "grad:nan:3:99"
+    env2["PADDLE_GUARD_MODE"] = "abort"
+    env2["PADDLE_GUARD_MAX_SKIPS"] = "2"
+    env2["PADDLE_GUARD_SYNC_EVERY"] = "1"
+    env2["GUARD_TRAIN_STEPS"] = "20"
+    env2["GUARD_TRAIN_LOG"] = str(log)
+    old = dict(os.environ)
+    os.environ.clear()
+    os.environ.update(env2)
+    t0 = time.monotonic()
+    try:
+        rc = launch(os.path.join(HELPERS, "guard_train.py"), [],
+                    nproc_per_node=1, start_port=_free_port(),
+                    backend="cpu")
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+    assert rc == GUARD_ABORT_RC
+    assert time.monotonic() - t0 < 300
+    err = capfd.readouterr().err
+    assert f"rc={GUARD_ABORT_RC}" in err
+    assert "attributed to guard_abort" in err
+    assert "consecutive bad steps" in err
+    rows = [json.loads(l) for l in log.read_text().splitlines()]
+    assert rows and all(np.isfinite(r["loss"]) for r in rows)

@@ -35,6 +35,9 @@ import re
 import sys
 
 THRESHOLD = 0.10
+#: max % the numerical-guard sentinel may cost the GPT step
+#: (bench.py records `guard_overhead_pct` from the on/off pair)
+GUARD_OVERHEAD_PCT = 2.0
 
 
 def _parsed(path: str) -> dict:
@@ -151,11 +154,32 @@ def check(root: str):
             "reported, not enforced)"
         )
     rc = 1 if (regressions and enforce) else 0
+    # absolute gate: the in-graph numerical sentinel's cost on the GPT
+    # step (guard on vs off, recorded by bench.py) must stay under
+    # GUARD_OVERHEAD_PCT — waivable by naming guard_overhead_pct in the
+    # round's note, like any other regression
+    gp = (cur.get("extra") or {}).get("guard_overhead_pct")
+    if isinstance(gp, (int, float)):
+        note_txt = str((cur.get("extra") or {}).get("note", "")) + " " + \
+            str((cur.get("extra") or {}).get("incomparable_to_prev", ""))
+        if gp <= GUARD_OVERHEAD_PCT:
+            lines.append(f"  ok      guard_overhead_pct: {gp:g}% "
+                         f"(gate {GUARD_OVERHEAD_PCT:g}%)")
+        elif "guard_overhead_pct" in note_txt:
+            lines.append(f"  waived  guard_overhead_pct: {gp:g}% "
+                         f"[annotated in note]")
+        elif enforce:
+            lines.append(f"  REGRESS guard_overhead_pct: {gp:g}% > "
+                         f"{GUARD_OVERHEAD_PCT:g}% sentinel budget")
+            rc = 1
+        else:
+            lines.append(f"  warn    guard_overhead_pct: {gp:g}% > "
+                         f"{GUARD_OVERHEAD_PCT:g}% (single-shot round)")
     if rc:
         lines.append(
-            "FAIL: unannotated >10% regression(s); either fix the "
-            "regression or explain it in extra.note / declare "
-            "extra.incomparable_to_prev"
+            "FAIL: unannotated >10% regression(s) or guard-overhead "
+            "budget breach; either fix it or explain it in extra.note / "
+            "declare extra.incomparable_to_prev"
         )
     return rc, lines
 

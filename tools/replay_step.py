@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Deterministic replay of a captured diverged training step.
+
+When the in-graph sentinel (utils/train_guard.py) trips inside the fused
+``TrainStep`` XLA program, the guard dumps a *replay bundle* to
+``PADDLE_GUARD_DUMP_DIR``: the step's parameters/buffers, the batch
+(inputs + labels), the RNG key, and the health word. The compiled step
+can say *that* the step went nonfinite but not *where* — XLA fused the
+whole program. This tool re-executes the captured step **eagerly** (one
+op per dispatch, the reference's interpreter granularity) with
+``FLAGS_check_nan_inf`` armed, so the per-op tripwire — forward outputs
+AND backward cotangents (core/autograd.py) — names the first op that
+produced the NaN/Inf: "loss is NaN" becomes a ``phase:op`` diagnosis.
+
+Library use (what tests/test_train_guard.py drives)::
+
+    from tools.replay_step import replay
+    report = replay("guard_step00000007.rank0.pdbundle", model, loss_fn)
+    report["faulting_op"]   # e.g. "exp"
+    report["phase"]         # "forward" | "backward"
+
+CLI use — the builder callable returns ``(model, loss_fn)`` shaped like
+the TrainStep ctor arguments (loss_fn receives ``(outputs, *labels)``)::
+
+    python tools/replay_step.py <bundle.pdbundle> --builder mymod:build
+    python tools/replay_step.py <bundle.pdbundle> --builder mymod:build \
+        --float64     # re-run in f64: still nonfinite => true overflow,
+                      # finite => f32/bf16 precision, not the math
+
+RNG fidelity: eager draws are re-seeded from the bundle's recorded step
+key, so dropout-bearing replays are deterministic per invocation; the
+eager split sequence is not bit-identical to the traced fold_in stream,
+which matters only when the divergence is driven by one specific mask
+(re-run a few times, or replay with the model in eval()).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_bundle(bundle):
+    if isinstance(bundle, dict):
+        return bundle
+    from paddle_tpu.framework import io as fio
+
+    return fio.load(bundle, return_numpy=True)
+
+
+def _seed_rng(key_data):
+    """Re-seed the eager RNG stream from the recorded step key."""
+    import jax
+
+    from paddle_tpu.core import random as rnd
+
+    if key_data is None:
+        return
+    raw = np.asarray(key_data, np.uint32)
+    try:
+        key = jax.random.wrap_key_data(raw)
+    except Exception:  # noqa: BLE001 — older raw uint32[2] key form
+        import jax.numpy as jnp
+
+        key = jnp.asarray(raw)
+    with rnd._lock:
+        rnd._key = key
+
+
+def _to_float64(model, state):
+    """Best-effort f64 mode: enable x64, widen params/buffers so
+    set_state_dict keeps the f64 values instead of casting back down."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    for t in model.state_dict().values():
+        if np.issubdtype(np.dtype(t.dtype), np.floating):
+            t._data = t._data.astype("float64")
+    return {
+        k: (np.asarray(v, np.float64)
+            if np.issubdtype(np.asarray(v).dtype, np.floating) else v)
+        for k, v in state.items()
+    }
+
+
+def replay(bundle, model, loss_fn, float64=False, check_backward=True):
+    """Re-execute the captured step eagerly under FLAGS_check_nan_inf.
+
+    Returns a report dict: ``ok`` (True = replay stayed finite),
+    ``faulting_op`` / ``phase`` / ``message`` (the first tripped op),
+    plus the bundle's recorded ``step`` / ``health_bits`` /
+    ``fingerprint`` for cross-checking against the guard event line.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.core import autograd as AG
+    from paddle_tpu.core.tensor import Tensor
+
+    data = _load_bundle(bundle)
+    report = {
+        "bundle": bundle if isinstance(bundle, str) else "<dict>",
+        "step": data.get("step"),
+        "health_bits": data.get("health_bits"),
+        "fingerprint": data.get("fingerprint"),
+        "float64": bool(float64),
+        "ok": True, "faulting_op": None, "phase": None, "message": "",
+    }
+    state = data.get("state") or {}
+    inputs = [np.asarray(x) for x in data.get("inputs", [])]
+    labels = [np.asarray(y) for y in data.get("labels", [])]
+    if float64:
+        state = _to_float64(model, state)
+        inputs = [x.astype(np.float64)
+                  if np.issubdtype(x.dtype, np.floating) else x
+                  for x in inputs]
+    if state:
+        model.set_state_dict(state)
+    _seed_rng(data.get("key_data"))
+    model.train()
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        ins = [paddle.to_tensor(x) for x in inputs]
+        labs = [paddle.to_tensor(y) for y in labels]
+        out = model(*ins)
+        loss = loss_fn(out, *labs)
+        loss_raw = loss._data if isinstance(loss, Tensor) else loss
+        if not bool(np.isfinite(np.asarray(loss_raw)).all()):
+            # every op stayed finite but the composition didn't — the
+            # loss_fn itself (outside the per-op dispatch) is the site
+            raise AG.NanInfError("loss_fn", "forward")
+        if check_backward:
+            loss.backward()
+            for name, p in model.named_parameters():
+                if p.grad is not None and not bool(
+                        np.isfinite(np.asarray(p.grad._data)).all()):
+                    raise AG.NanInfError(f"param_grad[{name}]", "backward")
+    except AG.NanInfError as e:
+        report.update(ok=False, faulting_op=e.op_name, phase=e.phase,
+                      message=str(e))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    return report
+
+
+def _resolve_builder(spec: str):
+    mod, sep, attr = spec.partition(":")
+    if not sep:
+        raise SystemExit(f"--builder wants module:callable, got {spec!r}")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="guard_step*.pdbundle path")
+    ap.add_argument("--builder", required=True,
+                    help="module:callable returning (model, loss_fn)")
+    ap.add_argument("--float64", action="store_true",
+                    help="re-run in float64 to separate true overflow "
+                         "from low-precision artifacts")
+    ap.add_argument("--no-backward", action="store_true",
+                    help="forward-only replay")
+    args = ap.parse_args(argv)
+    model, loss_fn = _resolve_builder(args.builder)()
+    report = replay(args.bundle, model, loss_fn, float64=args.float64,
+                    check_backward=not args.no_backward)
+    print(json.dumps(report, indent=1, default=str))
+    if report["ok"]:
+        print("replay: step stayed finite (divergence is data/state "
+              "dependent — check the scaler/optimizer state, or re-run "
+              "with --float64 off)", file=sys.stderr)
+        return 0
+    print(f"replay: first nonfinite at {report['phase']} op "
+          f"'{report['faulting_op']}'", file=sys.stderr)
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
