@@ -316,6 +316,90 @@ def _bench_gpt(steps=10, batch=4, seq=1024, dense=False, guard=None):
     }
 
 
+def _bench_gpt_multichip(steps=10, seq=1024, shard_off=False):
+    """GPT-medium training step on a dp x mp2 mesh (ISSUE 6): the
+    sharded-flash/fused-LN default vs the `PADDLE_FLASH_SHARD=0` dense
+    fallback (the r6 multi-device behavior). Records the pair so the
+    shard_map-seam win is tracked by tools/bench_continuity.py's >10%
+    gate instead of anecdote. Runs only when the job spans >= 2 devices
+    with an even count (mp=2, dp fills the rest)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.jit import TrainStep
+
+    ndev = len(jax.devices())
+    mp = 2
+    dp = ndev // mp
+    shard_before = os.environ.get("PADDLE_FLASH_SHARD")
+    if shard_off:
+        os.environ["PADDLE_FLASH_SHARD"] = "0"
+    try:
+        paddle.seed(0)
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = _gpt_medium()
+        fl_model = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(
+            optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                            parameters=model.parameters())
+        )
+
+        def lm_loss(h, labels):
+            d = h.shape[-1]
+            return nn.functional.fused_linear_cross_entropy(
+                h.reshape([-1, d]), model.head.weight, model.head.bias,
+                labels.reshape([-1]),
+            )
+
+        step = TrainStep(fl_model, lm_loss, opt)
+        batch = 4 * dp  # 4 per data-parallel shard
+        ids = fl_model.shard_input(
+            (np.arange(batch * seq) % 31000).reshape(batch, seq)
+            .astype(np.int32)
+        )
+        labels = fl_model.shard_input(
+            ((np.arange(batch * seq) + 1) % 31000).reshape(batch, seq)
+            .astype(np.int32)
+        )
+        _ = np.asarray(ids._data.ravel()[:1])
+
+        t0 = time.perf_counter()
+        loss = step(ids, labels)
+        _ = np.asarray(loss._data)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(ids, labels)
+        _ = np.asarray(loss._data)
+        dt = time.perf_counter() - t0
+        tok_s = steps * batch * seq / dt
+    finally:
+        if shard_before is None:
+            os.environ.pop("PADDLE_FLASH_SHARD", None)
+        else:
+            os.environ["PADDLE_FLASH_SHARD"] = shard_before
+        # drop the dp x mp fleet mesh: it is process-global routing
+        # state, and everything benched after this pair must not
+        # silently run as a fleet job (same lingering-mesh class as the
+        # dryrun phases, which null it after every section)
+        from paddle_tpu.distributed import comm as _comm
+
+        _comm._state.hybrid_mesh = None
+    tag = "_dense" if shard_off else ""
+    return {
+        f"gpt_medium_bf16_dp_mp{tag}_step_ms": round(dt / steps * 1e3, 2),
+        f"gpt_medium_bf16_dp_mp{tag}_tokens_per_sec": round(tok_s, 0),
+        f"gpt_medium_bf16_dp_mp{tag}_compile_s": round(compile_s, 1),
+    }
+
+
 def _bench_flash_attention(steps=500):
     """Long-context attention: the Pallas flash kernel vs XLA dense at
     S=2048 causal. The `steps` iterations run INSIDE one jitted lax.scan
@@ -498,6 +582,25 @@ def main():
                 dense_d[f"gpt_medium_bf16_{k}"]
         extra["gpt_medium_bf16_tokens_per_sec_dense_spread"] = dsp
     import jax
+
+    if len(jax.devices()) > 1 and len(jax.devices()) % 2 == 0:
+        # multi-device pair (ISSUE 6): sharded-flash dp x mp2 vs the
+        # PADDLE_FLASH_SHARD=0 dense fallback — the shard_map-seam win
+        # lands under the bench_continuity >10% gate
+        _, mc_d, mc_sp = _repeat(
+            lambda: (lambda d: (
+                d["gpt_medium_bf16_dp_mp_tokens_per_sec"], d))(
+                _bench_gpt_multichip())
+        )
+        extra.update(mc_d)
+        extra["gpt_medium_bf16_dp_mp_tokens_per_sec_spread"] = mc_sp
+        _, mcd_d, mcd_sp = _repeat(
+            lambda: (lambda d: (
+                d["gpt_medium_bf16_dp_mp_dense_tokens_per_sec"], d))(
+                _bench_gpt_multichip(shard_off=True))
+        )
+        extra.update(mcd_d)
+        extra["gpt_medium_bf16_dp_mp_dense_tokens_per_sec_spread"] = mcd_sp
 
     if jax.default_backend() == "tpu":  # compiled pallas is TPU-only
         # single-shot by design: 500 iterations already run inside ONE

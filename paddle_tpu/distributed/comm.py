@@ -34,16 +34,48 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
-def shard_map(f, mesh, in_specs, out_specs):
+# The mesh-axis classification every hot-path router shares (round 7):
+# these axes shard the BATCH/row dims of an operand ('dp' flat
+# data-parallel, or the hierarchical 'dcn' x 'ici' pair); 'mp' shards
+# heads/features; anything else ('pp' pipeline stages, 'sp' ring
+# attention's sequence axis) belongs to its own schedule and makes the
+# shard_map seams decline. One constant so the three routing policies
+# (attention.shard_factoring, norm._ln_row_factoring,
+# overlap.row_overlap_plan) cannot drift.
+DP_AXES = ("dp", "dcn", "ici")
+
+
+def partitioning_axes(mesh) -> tuple:
+    """The mesh axes that actually partition a program: every axis with
+    size > 1, in mesh order (size-1 axes partition nothing and must
+    never veto a routing decision)."""
+    return tuple(a for a in mesh.axis_names if int(mesh.shape[a]) > 1)
+
+
+def shard_map(f, mesh, in_specs, out_specs, auto=None):
+    """The repo-wide shard_map wrapper (replication checking off — bodies
+    use explicit collectives). `auto` names mesh axes left to GSPMD
+    inside the body (partial-manual regions: the async-dcn grad
+    reduction is manual over 'dcn', auto over ici/mp/...)."""
+    kw = {} if auto is None else {"auto": frozenset(auto)}
     try:
         return _shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
+            check_vma=False, **kw,
         )
-    except TypeError:  # pre-0.9 jax: the flag was called check_rep
+    except TypeError as e:
+        if kw and "auto" in str(e):
+            # distinct failure from the check_vma/check_rep rename: this
+            # jax's shard_map has no partial-auto support at all
+            raise NotImplementedError(
+                "this jax's shard_map does not accept `auto` (partial-"
+                "manual regions) — async_dcn_allreduce needs a jax with "
+                "partial-auto shard_map"
+            ) from e
+        # pre-0.9 jax: the flag was called check_rep
         return _shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
+            check_rep=False, **kw,
         )
 
 

@@ -68,6 +68,25 @@ _DEFAULTS = {
     # reduction per level instead of one flat ring across both fabrics.
     "hierarchical_allreduce": False,
     "hierarchical_allreduce_inter_nranks": 0,
+    # async cross-pod grad reduction (EQuARX lineage: the dcn hop is the
+    # slow, overlappable piece). The compiled TrainStep runs its
+    # value_and_grad manual over the 'dcn' mesh axis (GSPMD keeps the
+    # fast ici/mp collectives) with an explicit per-grad pmean at each
+    # grad's definition point in the backward dataflow — the inter-node
+    # reduction for layer N starts when layer N's backward finishes,
+    # behind the remaining layers' compute, instead of being combined
+    # into one tail collective. Requires hierarchical_allreduce (the
+    # dcn x ici mesh factoring). Numerically identical to the implicit
+    # form for deterministic steps whose loss is a fixed-divisor batch
+    # MEAN (an equal-group mean of means IS the global mean — but a
+    # reduction='sum' loss comes out scaled 1/dcn, and a masked mean
+    # with per-group denominators is biased: keep the default mean
+    # reduction under this flag); RNG-consuming models (dropout) draw
+    # decorrelated per-dcn-group masks — a valid but different sample. The
+    # Pallas/TP-overlap seams decline inside the manual-over-dcn
+    # backward region (nested shard_map over a manual axis is
+    # ill-formed): the model composes through its dense forms there.
+    "async_dcn_allreduce": False,
     "dgc": False,
     "a_sync": False,
     # parity-accepted, no-op on TPU (XLA owns comm fusion/scheduling)

@@ -43,6 +43,20 @@ def _shard_param(p, mesh, spec):
     return p
 
 
+def _overlap_plan(mesh, x):
+    """(mp, row_spec_elem) when PADDLE_TP_OVERLAP routes this layer's
+    matmul through the collective-matmul ring (distributed/overlap.py),
+    else None (the GSPMD sharding-propagation form)."""
+    from . import overlap as _ov
+
+    if not _ov.tp_overlap_enabled():
+        return None
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= int(s)
+    return _ov.row_overlap_plan(mesh, rows)
+
+
 class ColumnParallelLinear(Layer):
     """Weight column-partitioned linear (collective.py:492, axis=1 path).
 
@@ -79,6 +93,24 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        if self.gather_output:
+            plan = _overlap_plan(self.mesh, x)
+            if plan is not None:
+                # pipelined output gather: per-row-chunk local matmuls,
+                # each chunk's all-gather issued while the next computes
+                from . import overlap as _ov
+
+                mp, row_ax = plan
+                args = (x, self.weight) + (
+                    (self.bias,) if self.bias is not None else ()
+                )
+                return AG.apply(
+                    lambda xr, wr, *br: _ov.column_gather_overlap(
+                        xr, wr, br[0] if br else None, self.mesh, mp,
+                        row_ax,
+                    ),
+                    args, name="column_gather_overlap",
+                )
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             return _constrain(out, self.mesh, P())
@@ -124,6 +156,23 @@ class RowParallelLinear(Layer):
         if self.input_is_parallel:
             x = _constrain(
                 x, self.mesh, P(*([None] * (x.ndim - 1) + ["mp"]))
+            )
+        plan = _overlap_plan(self.mesh, x)
+        if plan is not None:
+            # the contraction's psum decomposed into per-chunk ppermute
+            # ring steps interleaved with the matmul chunks (collective
+            # matmul): each ppermute overlaps the next chunk's MXU work
+            from . import overlap as _ov
+
+            mp, row_ax = plan
+            args = (x, self.weight) + (
+                (self.bias,) if self.bias is not None else ()
+            )
+            return AG.apply(
+                lambda xr, wr, *br: _ov.row_parallel_overlap(
+                    xr, wr, br[0] if br else None, self.mesh, mp, row_ax
+                ),
+                args, name="row_parallel_overlap",
             )
         out = F.linear(x, self.weight, self.bias)
         return _constrain(out, self.mesh, P())
@@ -224,13 +273,32 @@ class ParallelMultiHeadAttention(Layer):
         from ..nn.functional import attention as attn_route
 
         route_flash = self.use_flash_attention
+        plan = None
         if route_flash is None:  # AUTO: the flash-by-default policy
-            route_flash = attn_route.flash_routable(
+            # self.mesh is the job-wide hybrid mesh — or, inside a
+            # pipeline stage, the rebound pp-free submesh — so the
+            # policy routes on the axes that partition THIS program
+            plan = attn_route.flash_plan(
                 T, T, causal=self.causal,
                 dropout_active=bool(self.dropout) and self.training,
+                mesh=self.mesh, batch=B, heads=H,
             )
+            route_flash = plan is not None
+        elif route_flash:
+            # FORCED flash still needs the shard plan: when the seam
+            # declines (PADDLE_FLASH_SHARD=0, a mesh the seam cannot
+            # cover, the async-dcn manual region) the dense form below
+            # composes — a bare pallas_call inside a multi-device GSPMD
+            # program has no partition rule and would fail to compile
+            p = attn_route._shard_plan(self.mesh, int(B), int(H))
+            if p is False:
+                route_flash = False
+            else:
+                plan = ("plain",) if p is None else ("sharded",) + p
         if route_flash:
-            ctx = attn_route.flash_core(q, k, v, causal=self.causal)
+            ctx = attn_route.flash_core_routed(
+                q, k, v, mesh=self.mesh, causal=self.causal, plan=plan
+            )
             ctx = ctx.transpose([0, 2, 1, 3]).reshape([B, T, H * dh])
             ctx = _constrain(ctx, self.mesh, P(None, None, "mp"))
             return self.out_proj(ctx)
@@ -270,6 +338,13 @@ class ParallelGPTBlock(Layer):
             use_flash_attention=use_flash_attention,
         )
         self.ln2 = LayerNorm(d_model)
+        # the block's program mesh, shared with its LN layers so the
+        # fused-LN routing targets the same device set as the attention
+        # routing — pipeline _Stage rebinds every Mesh-valued `.mesh`
+        # (this one, the LNs', the TP layers') to its pp-free submesh
+        self.mesh = self.attn.mesh
+        self.ln1.mesh = self.mesh
+        self.ln2.mesh = self.mesh
         self.fc1 = ColumnParallelLinear(d_model, ffn, gather_output=False)
         self.fc2 = RowParallelLinear(ffn, d_model, input_is_parallel=True)
         self.dropout = dropout
@@ -281,6 +356,7 @@ class ParallelGPTBlock(Layer):
         h, n2 = F.fused_residual_layer_norm(
             x, self.attn(self.ln1(x)), [self._d_model],
             self.ln2.weight, self.ln2.bias, self.ln2._epsilon,
+            mesh=self.mesh,
         )
         m = F.gelu(self.fc1(n2))
         if self.dropout:

@@ -97,6 +97,7 @@ class TrainStep:
         self._loss_scale_cfg = None   # fp16 dynamic loss scaling config
         self._scaler_state = ()       # (scale, good, bad) traced state
         self._recompute = False
+        self._async_dcn = False       # explicit per-grad dcn-hop pmean
         self._delegate = None         # localsgd routes to LocalSGDStep
         self._guard = None            # set below (delegate owns its own)
         self._guard_state = ()
@@ -107,6 +108,15 @@ class TrainStep:
                 if strategy.amp or strategy.recompute:
                     raise NotImplementedError(
                         "localsgd does not compose with amp/recompute yet"
+                    )
+                if strategy.async_dcn_allreduce:
+                    # LocalSGDStep has its own comm schedule (periodic
+                    # pmean) — silently dropping the flag would hand the
+                    # user the tail collective they explicitly disabled
+                    raise NotImplementedError(
+                        "localsgd does not compose with "
+                        "async_dcn_allreduce: LocalSGD replaces per-step "
+                        "grad reduction with periodic parameter averaging"
                     )
                 from ..distributed.fleet.localsgd import LocalSGDStep
 
@@ -141,6 +151,20 @@ class TrainStep:
                     )
             if strategy.recompute:
                 self._recompute = True
+            if strategy.async_dcn_allreduce:
+                if not strategy.hierarchical_allreduce:
+                    raise ValueError(
+                        "async_dcn_allreduce requires "
+                        "hierarchical_allreduce: the explicit async hop "
+                        "is the 'dcn' level of the dcn x ici mesh "
+                        "factoring"
+                    )
+                if self._loss_scale_cfg is not None:
+                    raise NotImplementedError(
+                        "async_dcn_allreduce does not compose with fp16 "
+                        "dynamic loss scaling yet (bf16 amp composes)"
+                    )
+                self._async_dcn = True
         self._p_objs = [p for p in optimizer._get_params() if p.trainable]
         b_named = dict(model.named_buffers())
         self._b_names = list(b_named)
@@ -155,6 +179,14 @@ class TrainStep:
         from jax.sharding import NamedSharding, PartitionSpec as _P
 
         mesh = _comm.hybrid_mesh()
+        if mesh is not None and mesh.size <= 1:
+            # a trivial (one-device) hybrid mesh is no mesh at all for
+            # placement purposes — normalizing onto it would COMMIT the
+            # step's state to device 0, which conflicts with params a
+            # DataParallel wrap already laid out on the multi-device
+            # default-group mesh ("incompatible devices" at dispatch;
+            # root cause of the order-dependent dp_matches failure)
+            mesh = None
         if mesh is not None:
             repl = NamedSharding(mesh, _P())
             for o in self._p_objs + self._b_objs:
@@ -162,6 +194,27 @@ class TrainStep:
                     getattr(o._data, "sharding", None), NamedSharding
                 ):
                     o._data = jax.device_put(o._data, repl)
+        if self._async_dcn:
+            if mesh is None or "dcn" not in mesh.axis_names \
+                    or int(mesh.shape["dcn"]) <= 1:
+                raise ValueError(
+                    "async_dcn_allreduce: the hybrid mesh has no dcn "
+                    "axis (> 1) — fleet.init with hierarchical_allreduce "
+                    "and a dp_degree that factors must run first"
+                )
+            if self._b_objs:
+                # batch-statistic buffers (BN running stats) would be
+                # updated per dcn group and diverge across groups
+                raise NotImplementedError(
+                    "async_dcn_allreduce does not support models with "
+                    "buffers (running batch statistics) yet"
+                )
+            if self._ret_out:
+                raise NotImplementedError(
+                    "async_dcn_allreduce does not compose with "
+                    "return_outputs"
+                )
+            self._dcn_mesh = mesh
         self._donate = donate and jax.default_backend() != "cpu"
         # -- numerical guardrails (utils/train_guard.py): the in-graph
         # sentinel + skip masking engage unless PADDLE_GUARD_MODE=off;
@@ -236,7 +289,19 @@ class TrainStep:
 
     def _step_fn(self, p_raws, opt_state, b_raws, key, lr, t, scaler_state,
                  guard_state, inject, in_raws, label_raws):
-        if self._loss_scale_cfg is None:
+        if self._async_dcn:
+            # manual over 'dcn', GSPMD-auto over every other axis: each
+            # grad's inter-node pmean sits at its definition point in
+            # the backward dataflow (schedulable behind the remaining
+            # backward compute) instead of a combined tail collective
+            from ..distributed.overlap import dcn_value_and_grad
+
+            loss, grads = dcn_value_and_grad(
+                self._loss_of, self._dcn_mesh, p_raws, key, in_raws,
+                label_raws,
+            )
+            new_b, outs = (), None
+        elif self._loss_scale_cfg is None:
             (loss, (new_b, outs)), grads = jax.value_and_grad(
                 lambda p: self._loss_of(p, b_raws, key, in_raws, label_raws),
                 has_aux=True,
@@ -373,7 +438,7 @@ class TrainStep:
         from ..distributed import comm as _comm
 
         mesh = _comm.hybrid_mesh()
-        if mesh is not None:
+        if mesh is not None and mesh.size > 1:  # trivial mesh = no mesh
             from jax.sharding import NamedSharding, PartitionSpec as _P
 
             gs = jax.device_put(gs, NamedSharding(mesh, _P()))

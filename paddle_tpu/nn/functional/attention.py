@@ -23,6 +23,18 @@ Escape hatch: `PADDLE_FLASH_DEFAULT=0` restores dense routing everywhere
 (set it when bisecting a numerics question back to the materialized-score
 path). `PADDLE_FLASH_DEFAULT=interpret` forces routing through the Pallas
 interpreter off-TPU — CPU CI uses it to exercise the routed code path.
+
+Round 7 (ISSUE 6): multi-device programs route too. The r6 policy
+declined ANY `device_count() > 1` process because a pallas_call inside a
+GSPMD program has no partition rule — even when the operands were fully
+replicated or every model axis had size 1. The router is now mesh-aware:
+`shard_factoring` maps the mesh axes that actually partition the
+operands onto the attention dims (dp/dcn/ici -> batch, mp -> heads), and
+eligible shapes run the kernel through the `shard_map` seam
+(ops/pallas/sharded.py) — each device executes the single-chip kernel on
+its shard. `PADDLE_FLASH_SHARD=0` is the loud escape hatch back to the
+r6 dense fallback for every multi-device program (it also gates the
+sharded fused-LN routing in functional.norm).
 """
 from __future__ import annotations
 
@@ -33,14 +45,123 @@ import jax
 from ...core import autograd as AG
 
 __all__ = [
-    "flash_default_enabled", "flash_routable", "flash_core",
-    "scaled_dot_product_attention",
+    "flash_default_enabled", "flash_shard_enabled", "shard_factoring",
+    "flash_plan", "flash_routable", "flash_core", "flash_core_sharded",
+    "flash_core_routed", "scaled_dot_product_attention",
 ]
 
 
 def flash_default_enabled() -> bool:
     v = os.environ.get("PADDLE_FLASH_DEFAULT", "1").strip().lower()
     return v not in ("0", "false", "off")
+
+
+def flash_shard_enabled() -> bool:
+    """May multi-device programs route Pallas kernels through the
+    shard_map seam? `PADDLE_FLASH_SHARD=0` restores the r6 policy
+    (dense fallback whenever the program spans >1 device)."""
+    v = os.environ.get("PADDLE_FLASH_SHARD", "1").strip().lower()
+    return v not in ("0", "false", "off")
+
+
+def _routing_mesh():
+    """The mesh a mesh-less caller's multi-device program runs on.
+
+    On TPU: the hybrid mesh when fleet/init_hybrid_mesh declared one,
+    else the default data-parallel group's mesh (plain DataParallel
+    jobs). Off-TPU (interpret-mode CI): ONLY an explicitly declared
+    hybrid mesh counts — the default group always spans every virtual
+    device of the test harness, and consulting it would veto the plain
+    single-device interpret tests that never shard anything."""
+    from ...distributed import comm
+
+    mesh = comm.hybrid_mesh()
+    if mesh is not None:
+        return mesh
+    if jax.default_backend() != "tpu":
+        return None
+    g = comm.get_group(0)
+    return g.mesh if g is not None else None
+
+
+def shard_factoring(mesh, batch, heads):
+    """Map the mesh axes that partition a multi-device program onto the
+    [B, H, S, D] attention operands: data-parallel axes ('dp', or the
+    hierarchical 'dcn' x 'ici' pair) shard the batch, 'mp' shards heads.
+
+    Returns (batch_axes, head_axes) — possibly empty tuples, meaning the
+    mesh partitions nothing (all axes size 1: the kernel runs as-is) —
+    or None when the operands cannot be covered: a dim not divisible by
+    its axes' product, or a size>1 axis this seam cannot map ('sp'
+    belongs to ring attention, 'pp' to the pipeline schedule; inside a
+    pipeline stage the rebound submesh has no pp axis).
+    """
+    from ...distributed import comm as _comm
+
+    if mesh is None:
+        return None
+    batch_axes, head_axes = [], []
+    for ax in _comm.partitioning_axes(mesh):
+        if ax in _comm.DP_AXES:
+            batch_axes.append(ax)
+        elif ax == "mp":
+            head_axes.append(ax)
+        else:
+            return None
+    bdeg = 1
+    for ax in batch_axes:
+        bdeg *= int(mesh.shape[ax])
+    hdeg = 1
+    for ax in head_axes:
+        hdeg *= int(mesh.shape[ax])
+    if bdeg > 1 and (batch is None or int(batch) % bdeg):
+        return None
+    if hdeg > 1 and (heads is None or int(heads) % hdeg):
+        return None
+    return tuple(batch_axes), tuple(head_axes)
+
+
+def _shard_plan(mesh, batch, heads):
+    """The multi-device routing decision, shared by `flash_routable` and
+    the kernel dispatchers so policy and execution cannot drift.
+
+    Returns one of:
+      None         — the program is single-device (or the mesh partitions
+                     nothing): run the plain kernel;
+      (mesh, fac)  — multi-device: run through the shard_map seam with
+                     `fac = (batch_axes, head_axes)`;
+      False        — decline (dense fallback): PADDLE_FLASH_SHARD=0, a
+                     mesh this seam cannot cover, a mesh-less
+                     multi-device TPU program (no axes to map), or a
+                     trace inside the async-dcn manual region (a nested
+                     shard_map over the already-manual 'dcn' axis would
+                     be ill-formed — the dense forms compose there).
+    """
+    from ...distributed import overlap as _ov
+
+    if _ov.in_manual_dcn():
+        return False
+    if mesh is None:
+        if jax.default_backend() == "tpu" and len(jax.devices()) == 1:
+            return None
+        mesh = _routing_mesh()
+        if mesh is None:
+            # off-TPU with no declared hybrid mesh: a plain interpret
+            # test, nothing is sharded — the single-device kernel is
+            # exact. On TPU this is a mesh-less multi-device program:
+            # decline below via shard_factoring(None).
+            if jax.default_backend() != "tpu":
+                return None
+    if mesh is not None and mesh.size <= 1:
+        return None
+    if not flash_shard_enabled():
+        return False
+    fac = shard_factoring(mesh, batch, heads)
+    if fac is None:
+        return False
+    if not (fac[0] or fac[1]):
+        return None  # every mapped axis has size 1: plain kernel
+    return mesh, fac
 
 
 def _interpret_forced() -> bool:
@@ -58,30 +179,56 @@ def _flash_block(s: int) -> int:
     return b
 
 
-def flash_routable(seq_q, seq_k, *, causal, has_mask=False,
-                   dropout_active=False, need_weights=False,
-                   has_cache=False) -> bool:
-    """Would the default router send this attention to the flash kernel?"""
+def flash_plan(seq_q, seq_k, *, causal, has_mask=False,
+               dropout_active=False, need_weights=False,
+               has_cache=False, mesh=None, batch=None, heads=None):
+    """The full routing decision, made ONCE: None = dense fallback,
+    `("plain",)` = single-device kernel, `("sharded", mesh, fac)` = the
+    shard_map seam. Callers thread the plan into `flash_core_routed` so
+    the route decision and the dispatch cannot drift (env vars and the
+    global mesh are read a single time).
+
+    `mesh`/`batch`/`heads` feed the multi-device decision: a program
+    spanning several devices routes iff the mesh axes that partition the
+    operands factor onto (batch, heads) — see `shard_factoring` — and
+    `PADDLE_FLASH_SHARD` is not 0. Callers that know their mesh (the
+    tensor-parallel layers) pass it; mesh-less callers fall back to the
+    hybrid/default-group mesh on TPU.
+    """
     if not flash_default_enabled():
-        return False
+        return None
     if not causal or has_mask or dropout_active or need_weights \
             or has_cache:
-        return False
+        return None
     # the kernel's causal mask compares ABSOLUTE positions from offset 0;
     # Sq != Sk (decode-append / cross shapes) needs the end-aligned dense
     # form — routing it would mask the wrong triangle
     if int(seq_q) != int(seq_k):
-        return False
-    if jax.default_backend() == "tpu":
-        # single-chip only, same guard as blockwise_attention: a
-        # pallas_call inside a multi-device GSPMD program has no
-        # partitioning rule — multichip jobs keep the dense form (whose
-        # einsums GSPMD shards) unless the caller opts in explicitly
-        if len(jax.devices()) != 1:
-            return False
-    elif not _interpret_forced():
-        return False
-    return _flash_block(int(seq_q)) >= 8 and _flash_block(int(seq_k)) >= 8
+        return None
+    if jax.default_backend() != "tpu" and not _interpret_forced():
+        return None
+    if _flash_block(int(seq_q)) < 8 or _flash_block(int(seq_k)) < 8:
+        return None
+    # multi-device: route on the axes that ACTUALLY partition the
+    # operands (r6 declined everything here) — the kernel runs per shard
+    # through the shard_map seam; `False` is the seam's decline
+    plan = _shard_plan(mesh, batch, heads)
+    if plan is False:
+        return None
+    return ("plain",) if plan is None else ("sharded",) + plan
+
+
+def flash_routable(seq_q, seq_k, *, causal, has_mask=False,
+                   dropout_active=False, need_weights=False,
+                   has_cache=False, mesh=None, batch=None,
+                   heads=None) -> bool:
+    """Would the default router send this attention to the flash kernel?
+    (The bool view of `flash_plan`.)"""
+    return flash_plan(
+        seq_q, seq_k, causal=causal, has_mask=has_mask,
+        dropout_active=dropout_active, need_weights=need_weights,
+        has_cache=has_cache, mesh=mesh, batch=batch, heads=heads,
+    ) is not None
 
 
 def flash_core(q, k, v, *, causal=True, scale=None):
@@ -100,6 +247,56 @@ def flash_core(q, k, v, *, causal=True, scale=None):
     )
 
 
+def flash_core_sharded(q, k, v, *, mesh, batch_axes, head_axes,
+                       causal=True, scale=None):
+    """Run the flash kernel through the shard_map seam
+    (ops/pallas/sharded.py) on [B, H, S, D] Tensors: B shards over
+    `batch_axes`, H over `head_axes`, each device executes the
+    single-chip kernel on its shard (tape-recorded)."""
+    from ...ops.pallas.sharded import sharded_flash_attention
+
+    bq = _flash_block(int(q.shape[2]))
+    bk = _flash_block(int(k.shape[2]))
+    interpret = jax.default_backend() != "tpu"
+    return AG.apply(
+        lambda a, b, c: sharded_flash_attention(
+            a, b, c, mesh, batch_axes, head_axes, causal, bq, bk,
+            scale, interpret
+        ),
+        (q, k, v), name="sharded_flash_attention",
+    )
+
+
+def flash_core_routed(q, k, v, *, mesh=None, causal=True, scale=None,
+                      plan=None):
+    """Dispatch the flash kernel per the shard plan: through the
+    shard_map seam when the mesh partitions the [B, H, S, D] operands,
+    the plain single-device kernel otherwise. Callers that already hold
+    a `flash_plan` result pass it so the decision is not re-derived;
+    otherwise it is computed here once — and a seam DECLINE raises
+    loudly (the caller must fall back to its dense form: a bare
+    pallas_call inside a multi-device GSPMD program has no partition
+    rule, and letting it through would surface as an opaque XLA
+    partitioning error instead)."""
+    if plan is None:
+        p = _shard_plan(mesh, int(q.shape[0]), int(q.shape[1]))
+        if p is False:
+            raise RuntimeError(
+                "flash_core_routed: the shard_map seam declined this "
+                "multi-device program (PADDLE_FLASH_SHARD=0, an "
+                "uncoverable mesh, or the async-dcn manual region) — "
+                "route through the dense attention form instead"
+            )
+        plan = ("plain",) if p is None else ("sharded",) + p
+    if plan[0] == "sharded":
+        _, m, (batch_axes, head_axes) = plan
+        return flash_core_sharded(
+            q, k, v, mesh=m, batch_axes=batch_axes, head_axes=head_axes,
+            causal=causal, scale=scale,
+        )
+    return flash_core(q, k, v, causal=causal, scale=scale)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, scale=None, name=None):
@@ -113,10 +310,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     import jax.numpy as jnp
 
     dropout_active = bool(dropout_p) and training
-    if flash_routable(query.shape[2], key.shape[2], causal=is_causal,
+    B, H = int(query.shape[0]), int(query.shape[1])
+    plan = flash_plan(query.shape[2], key.shape[2], causal=is_causal,
                       has_mask=attn_mask is not None,
-                      dropout_active=dropout_active):
-        return flash_core(query, key, value, causal=is_causal, scale=scale)
+                      dropout_active=dropout_active, batch=B, heads=H)
+    if plan is not None:
+        # multi-device programs run the kernel per shard through the
+        # shard_map seam (the plan carries the vetted factoring)
+        return flash_core_routed(
+            query, key, value, causal=is_causal, scale=scale, plan=plan
+        )
 
     sc = scale if scale is not None else int(query.shape[-1]) ** -0.5
     Sq, Sk = int(query.shape[2]), int(key.shape[2])

@@ -126,15 +126,54 @@ def batch_norm(
     return AG.apply(f, args, name="batch_norm")
 
 
-def _fused_ln_interpret(raw, normalized_shape, weight, bias):
-    """Route LayerNorm to the Pallas fused kernel? Returns the kernel's
-    `interpret` flag, or None for the dense XLA path.
+def _ln_row_factoring(mesh, rows, row_floor):
+    """Shard the flattened LN row dim over the mesh axes that partition
+    the program (a row op shards over any product of batch/model axes).
+    Returns the axis tuple, () for an all-trivial mesh, or None when
+    rows don't tile per shard — or when a size>1 axis is outside the
+    shared dp/dcn/ici/mp allowlist (comm.DP_AXES, the same policy as
+    attention.shard_factoring): 'pp' stages run stage-LOCAL programs on
+    pp-free submeshes (their activations differ per stage, so a
+    shard_map over the job-wide mesh would be both unsound and the
+    wrong device set — layers that thread a rebound submesh via the
+    `mesh=` kwarg route through it), and 'sp' sequence sharding belongs
+    to ring attention's schedule."""
+    from ...distributed import comm as _comm
+
+    if mesh is None:
+        return None
+    axes = _comm.partitioning_axes(mesh)
+    if any(a not in _comm.DP_AXES + ("mp",) for a in axes):
+        return None
+    deg = 1
+    for a in axes:
+        deg *= int(mesh.shape[a])
+    if rows % deg or (rows // deg) % row_floor:
+        return None
+    return axes
+
+
+def _fused_ln_route(raw, normalized_shape, weight, bias, mesh=None):
+    """Route LayerNorm to the Pallas fused kernel? Returns None for the
+    dense XLA path, or (interpret, mesh, row_axes) — mesh is None for the
+    single-device kernel, a Mesh for the shard_map seam
+    (ops/pallas/sharded.py) with rows sharded over `row_axes`.
 
     Eligibility: last-axis-only normalization with both affine params, a
     lane-tileable layout (D % 128 == 0, rows % 8 — the MXU/VPU tiling
-    floor), a float dtype, and a TPU backend. `PADDLE_FUSED_LN=0`
-    disables the kernel (dense escape hatch); `=interpret` forces the
-    routed path through the Pallas interpreter off-TPU (CPU CI)."""
+    floor), a float dtype, and a TPU backend. Multi-device programs
+    (round 7) route through the shard_map seam when the rows tile per
+    shard and `PADDLE_FLASH_SHARD` != 0 (the shared sharded-hot-path
+    escape hatch). `PADDLE_FUSED_LN=0` disables the kernel entirely
+    (dense escape hatch); `=interpret` forces the routed path through
+    the Pallas interpreter off-TPU (CPU CI).
+
+    `mesh` is the caller's program mesh when it knows one — a pipeline
+    stage's rebound pp-free submesh (ParallelGPTBlock threads it via
+    F.layer_norm/fused_residual_layer_norm's `mesh=` kwarg, mirroring
+    ParallelMultiHeadAttention's flash_plan(mesh=...)); mesh-less
+    callers resolve the hybrid/default-group mesh like attention does.
+    """
     import os
 
     mode = os.environ.get("PADDLE_FUSED_LN", "1").strip().lower()
@@ -149,28 +188,68 @@ def _fused_ln_interpret(raw, normalized_shape, weight, bias):
     row_floor = 16 if raw.dtype == jnp.bfloat16 else 8
     if D % 128 != 0 or rows == 0 or rows % row_floor != 0:
         return None
-    if jax.default_backend() == "tpu":
-        # single chip only (blockwise_attention's guard): a pallas_call
-        # inside a multi-device GSPMD program has no partitioning rule —
-        # multichip programs keep the dense form XLA can shard
-        return False if len(jax.devices()) == 1 else None
-    return True if mode == "interpret" else None
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu and mode != "interpret":
+        return None
+    interp = not on_tpu
+    if on_tpu and len(jax.devices()) == 1:
+        return (False, None, ())
+    from ...distributed import overlap as _ov
+    from .attention import _routing_mesh, flash_shard_enabled
+
+    if _ov.in_manual_dcn():
+        # inside the async-dcn manual region a nested shard_map over
+        # the already-manual 'dcn' axis is ill-formed — dense composes
+        return None
+    # multi-device program (or interpret-mode CI standing in for one): a
+    # bare pallas_call has no partitioning rule — route through the
+    # shard_map seam, rows sharded over the axes that partition the
+    # program. _routing_mesh is the SAME mesh resolution the attention
+    # policy uses (hybrid/default-group on TPU, declared-hybrid-only in
+    # interpret mode) so CPU CI exercises the seam the pod runs.
+    if mesh is None:
+        mesh = _routing_mesh()
+    if mesh is None or mesh.size <= 1:
+        if on_tpu:
+            # mesh-less multi-device TPU program: no axes to map — keep
+            # the dense form GSPMD can shard (the r6 decline); a trivial
+            # mesh runs the plain single-device kernel
+            return None if mesh is None else (False, None, ())
+        return (interp, None, ())
+    if not flash_shard_enabled():
+        return None
+    axes = _ln_row_factoring(mesh, rows, row_floor)
+    if axes is None:
+        return None
+    return (interp, mesh if axes else None, axes)
 
 
-def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None, mesh=None):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     normalized_shape = tuple(normalized_shape)
     nd = len(normalized_shape)
     axes = tuple(range(x._data.ndim - nd, x._data.ndim))
 
-    interp = _fused_ln_interpret(x._data, normalized_shape, weight, bias)
-    if interp is not None:
-        from ...ops.pallas.layer_norm import fused_layer_norm
-
+    route = _fused_ln_route(x._data, normalized_shape, weight, bias,
+                            mesh=mesh)
+    if route is not None:
+        interp, mesh, row_axes = route
         # dispatched OFF the amp black list on purpose: the kernel keeps
         # bf16 activations bf16 (f32 stats internally) instead of the
         # dense path's f32 HBM round trip (same move as r5 batch_norm)
+        if mesh is not None:
+            from ...ops.pallas.sharded import sharded_layer_norm
+
+            return AG.apply(
+                lambda a, w, b: sharded_layer_norm(
+                    a, w, b, epsilon, interp, mesh, row_axes
+                ),
+                (x, weight, bias), name="sharded_layer_norm",
+            )
+        from ...ops.pallas.layer_norm import fused_layer_norm
+
         return AG.apply(
             lambda a, w, b: fused_layer_norm(a, w, b, epsilon, interp),
             (x, weight, bias), name="fused_layer_norm",
@@ -193,7 +272,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
 
 def fused_residual_layer_norm(x, residual, normalized_shape, weight=None,
-                              bias=None, epsilon=1e-5, name=None):
+                              bias=None, epsilon=1e-5, name=None,
+                              mesh=None):
     """(x + residual, LayerNorm(x + residual)) — the pre-LN block seam.
 
     On TPU this is ONE Pallas kernel (ops/pallas/layer_norm.py
@@ -206,8 +286,20 @@ def fused_residual_layer_norm(x, residual, normalized_shape, weight=None,
         normalized_shape = (normalized_shape,)
     normalized_shape = tuple(normalized_shape)
 
-    interp = _fused_ln_interpret(x._data, normalized_shape, weight, bias)
-    if interp is not None and x._data.shape == residual._data.shape:
+    route = _fused_ln_route(x._data, normalized_shape, weight, bias,
+                            mesh=mesh)
+    if route is not None and x._data.shape == residual._data.shape:
+        interp, mesh, row_axes = route
+        if mesh is not None:
+            from ...ops.pallas.sharded import sharded_add_layer_norm
+
+            return AG.apply(
+                lambda a, r, w, b: sharded_add_layer_norm(
+                    a, r, w, b, epsilon, interp, mesh, row_axes
+                ),
+                (x, residual, weight, bias),
+                name="sharded_residual_layer_norm",
+            )
         from ...ops.pallas.layer_norm import fused_add_layer_norm
 
         return AG.apply(
@@ -217,7 +309,8 @@ def fused_residual_layer_norm(x, residual, normalized_shape, weight=None,
             (x, residual, weight, bias), name="fused_residual_layer_norm",
         )
     s = x + residual
-    return s, layer_norm(s, normalized_shape, weight, bias, epsilon)
+    return s, layer_norm(s, normalized_shape, weight, bias, epsilon,
+                         mesh=mesh)
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,

@@ -113,6 +113,11 @@ class LayerNorm(Layer):
             normalized_shape = [normalized_shape]
         self._normalized_shape = list(normalized_shape)
         self._epsilon = epsilon
+        # program mesh, when a parallel parent knows one: ParallelGPTBlock
+        # sets it so pipeline stages (which rebind every Mesh-valued
+        # `.mesh` to their pp-free submesh) route the fused-LN shard_map
+        # seam on the stage's own device set; None = resolve globally
+        self.mesh = None
         self.weight = self.create_parameter(
             shape=self._normalized_shape, attr=weight_attr,
             default_initializer=Constant(1.0),
@@ -123,7 +128,7 @@ class LayerNorm(Layer):
 
     def forward(self, input):
         return F.layer_norm(input, self._normalized_shape, self.weight,
-                            self.bias, self._epsilon)
+                            self.bias, self._epsilon, mesh=self.mesh)
 
     def extra_repr(self):
         return f"normalized_shape={self._normalized_shape}"

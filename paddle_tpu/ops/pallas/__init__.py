@@ -1,9 +1,19 @@
 """Pallas TPU kernels — hand-tiled hot ops (SURVEY.md §2.4 TPU mapping:
-'dense op layer collapses into XLA ops + Pallas kernels')."""
+'dense op layer collapses into XLA ops + Pallas kernels'), plus the
+shard_map seams that run them inside multi-device GSPMD programs."""
 from .flash_attention import flash_attention  # noqa: F401
 from .layer_norm import (  # noqa: F401
     fused_add_layer_norm,
     fused_layer_norm,
 )
+from .sharded import (  # noqa: F401
+    sharded_add_layer_norm,
+    sharded_flash_attention,
+    sharded_layer_norm,
+)
 
-__all__ = ["flash_attention", "fused_layer_norm", "fused_add_layer_norm"]
+__all__ = [
+    "flash_attention", "fused_layer_norm", "fused_add_layer_norm",
+    "sharded_flash_attention", "sharded_layer_norm",
+    "sharded_add_layer_norm",
+]

@@ -1,0 +1,232 @@
+"""shard_map seams for the Pallas hot-path kernels — flash attention and
+fused LayerNorm inside multi-device GSPMD programs (ISSUE 6 tentpole).
+
+A `pallas_call` has no GSPMD partitioning rule, so since round 6 every
+multi-device program fell back to the dense XLA forms — precisely the
+dp x mp x pp pod runs the north star cares about lost the kernels. The
+fix is the standard one (jax scaling playbook): wrap the kernel in a
+`shard_map` over the mesh axes that actually partition the operands, so
+each device runs the single-chip kernel on its shard and GSPMD never has
+to partition the pallas_call itself.
+
+Why the shards are independent:
+  * flash attention — the batch (dp/dcn/ici) and head (mp) dims are
+    embarrassingly parallel: the kernel's grid already iterates B*H
+    programs that never exchange data. The sequence dim is NOT sharded
+    here (that is ring attention's job over 'sp'), so every shard sees
+    the full Sq == Sk causal triangle and needs no cross-shard exchange
+    or position offset.
+  * fused LayerNorm — a pure row op; rows shard over ANY axis product.
+    The only cross-shard coupling is the dgamma/dbeta reduction, done
+    with an explicit `lax.psum` over the row axes inside the backward
+    body (the per-shard kernels emit per-row-block partials already, so
+    the psum is the same tiny [n, D] reduce the single-chip path does
+    across row blocks — just spread over the mesh).
+
+Autodiff: the flash seam differentiates straight through shard_map (the
+inner `flash_attention` custom_vjp transposes shard-locally; there is no
+cross-shard term). The LN seams carry an explicit outer custom_vjp so
+the weight/bias cotangent reduction is a visible psum in the body rather
+than a property of shard_map's transpose of replicated inputs.
+
+Escape hatch: `PADDLE_FLASH_SHARD=0` (read by the routing policy in
+nn/functional/attention.py and nn/functional/norm.py) restores the r6
+dense fallback for every multi-device program.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .layer_norm import _add_ln_forward, _ln_backward, _ln_forward
+
+
+def _axes_flat(axes):
+    """Flatten a PartitionSpec-element ('dp' or ('dcn','ici')) to a tuple
+    of axis names for lax.psum / size products."""
+    if axes is None:
+        return ()
+    if isinstance(axes, str):
+        return (axes,)
+    return tuple(axes)
+
+
+def _spec_elem(axes):
+    ax = _axes_flat(axes)
+    if not ax:
+        return None
+    return ax[0] if len(ax) == 1 else tuple(ax)
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    from ...distributed import comm
+
+    return comm.shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+# ---------------------------------------------------------------------------
+# flash attention over (batch, heads) mesh axes
+# ---------------------------------------------------------------------------
+
+
+def sharded_flash_attention(q, k, v, mesh, batch_axes, head_axes,
+                            causal=True, block_q=256, block_k=256,
+                            scale=None, interpret=False):
+    """Flash attention on [B, H, S, D] operands inside a multi-device
+    program: B shards over `batch_axes` (the dp axis or the hierarchical
+    dcn x ici pair), H over `head_axes` ('mp'); S/D stay whole. Each
+    shard runs the single-chip Pallas kernel; gradients flow through the
+    kernel's own custom VJP per shard (no cross-shard terms exist).
+    """
+    spec = P(_spec_elem(batch_axes), _spec_elem(head_axes), None, None)
+    body = functools.partial(
+        _sharded_flash_body, causal=causal, block_q=block_q,
+        block_k=block_k, scale=scale, interpret=interpret,
+    )
+    return _shard_map(
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def _sharded_flash_body(q, k, v, *, causal, block_q, block_k, scale,
+                        interpret):
+    from .flash_attention import flash_attention
+
+    # per-shard S is the full sequence; block sizes clamp inside
+    return flash_attention(q, k, v, causal, block_q, block_k, scale,
+                           interpret)
+
+
+# ---------------------------------------------------------------------------
+# fused LayerNorm / residual-add+LN over row axes
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def sharded_layer_norm(x, weight, bias, eps, interpret, mesh, row_axes):
+    """LayerNorm over the last axis of [..., D] with the flattened row dim
+    sharded over `row_axes` (any tuple of mesh axis names whose product
+    divides the row count). weight/bias are replicated; their gradients
+    are per-shard partials psum'd over the row axes in the backward body.
+    """
+    out, _, _ = _sharded_ln_fwd_impl(x, weight, bias, eps, interpret,
+                                     mesh, row_axes)
+    return out
+
+
+def _sharded_ln_fwd_impl(x, weight, bias, eps, interpret, mesh, row_axes):
+    D = x.shape[-1]
+    x2d = x.reshape(-1, D)
+    rows = _spec_elem(row_axes)
+    body = functools.partial(_ln_fwd_body, eps=eps, interpret=interpret)
+    out, mu, rs = _shard_map(
+        body, mesh,
+        in_specs=(P(rows, None), P(), P()),
+        out_specs=(P(rows, None), P(rows), P(rows)),
+    )(x2d, weight.reshape(1, -1), bias.reshape(1, -1))
+    return out.reshape(x.shape), mu, rs
+
+
+def _ln_fwd_body(x2d, w2d, b2d, *, eps, interpret):
+    return _ln_forward(x2d, w2d, b2d, eps, interpret)
+
+
+def _sharded_ln_fwd(x, weight, bias, eps, interpret, mesh, row_axes):
+    out, mu, rs = _sharded_ln_fwd_impl(x, weight, bias, eps, interpret,
+                                       mesh, row_axes)
+    return out, (x, weight, mu, rs)
+
+
+def _sharded_ln_bwd(eps, interpret, mesh, row_axes, res, g):
+    x, weight, mu, rs = res
+    D = x.shape[-1]
+    rows = _spec_elem(row_axes)
+    body = functools.partial(
+        _ln_bwd_body, interpret=interpret, axes=_axes_flat(row_axes)
+    )
+    dx, dw, db = _shard_map(
+        body, mesh,
+        in_specs=(P(rows, None), P(), P(rows), P(rows), P(rows, None)),
+        out_specs=(P(rows, None), P(), P()),
+    )(
+        x.reshape(-1, D), weight.reshape(1, -1), mu, rs,
+        g.reshape(-1, D).astype(x.dtype),
+    )
+    return (dx.reshape(x.shape), dw.astype(weight.dtype),
+            db.astype(weight.dtype))
+
+
+def _ln_bwd_body(x2d, w2d, mu, rs, g2d, *, interpret, axes):
+    dx, dw, db = _ln_backward(x2d, w2d, mu, rs, g2d, interpret)
+    # the cross-shard half of the per-row-block dgamma/dbeta reduction:
+    # explicit psum over the row axes (ISSUE 6 tentpole contract)
+    dw = jax.lax.psum(dw, axes)
+    db = jax.lax.psum(db, axes)
+    return dx, dw, db
+
+
+sharded_layer_norm.defvjp(_sharded_ln_fwd, _sharded_ln_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def sharded_add_layer_norm(x, y, weight, bias, eps, interpret, mesh,
+                           row_axes):
+    """(x + y, LayerNorm(x + y)) — the pre-LN residual seam — with rows
+    sharded over `row_axes`. Same psum contract as sharded_layer_norm."""
+    s, out, _, _ = _sharded_add_ln_impl(x, y, weight, bias, eps,
+                                        interpret, mesh, row_axes)
+    return s, out
+
+
+def _sharded_add_ln_impl(x, y, weight, bias, eps, interpret, mesh,
+                         row_axes):
+    rows = _spec_elem(row_axes)
+    body = functools.partial(_add_ln_fwd_body, eps=eps, interpret=interpret)
+    s, out, mu, rs = _shard_map(
+        body, mesh,
+        in_specs=(P(rows, None), P(rows, None), P(), P()),
+        out_specs=(P(rows, None), P(rows, None), P(rows), P(rows)),
+    )(
+        x.reshape(-1, x.shape[-1]), y.reshape(-1, x.shape[-1]),
+        weight, bias,
+    )
+    return (s.reshape(x.shape), out.reshape(x.shape), mu, rs)
+
+
+def _add_ln_fwd_body(x2d, y2d, w, b, *, eps, interpret):
+    s, out, mu, rs = _add_ln_forward(x2d, y2d, w, b, eps, interpret)
+    return s, out, mu, rs
+
+
+def _sharded_add_ln_fwd(x, y, weight, bias, eps, interpret, mesh,
+                        row_axes):
+    s, out, mu, rs = _sharded_add_ln_impl(x, y, weight, bias, eps,
+                                          interpret, mesh, row_axes)
+    return (s, out), (s, weight, mu, rs, x.shape)
+
+
+def _sharded_add_ln_bwd(eps, interpret, mesh, row_axes, res, g):
+    s, weight, mu, rs, shape = res
+    gs, go = g
+    D = s.shape[-1]
+    rows = _spec_elem(row_axes)
+    body = functools.partial(
+        _ln_bwd_body, interpret=interpret, axes=_axes_flat(row_axes)
+    )
+    ds, dw, db = _shard_map(
+        body, mesh,
+        in_specs=(P(rows, None), P(), P(rows), P(rows), P(rows, None)),
+        out_specs=(P(rows, None), P(), P()),
+    )(
+        s.reshape(-1, D), weight.reshape(1, -1), mu, rs,
+        go.reshape(-1, D).astype(s.dtype),
+    )
+    # both addends receive d(s) = dLN/ds + the direct s cotangent
+    dsum = (ds.reshape(shape) + gs.astype(ds.dtype)).astype(ds.dtype)
+    return (dsum, dsum, dw.astype(weight.dtype), db.astype(weight.dtype))
+
+
+sharded_add_layer_norm.defvjp(_sharded_add_ln_fwd, _sharded_add_ln_bwd)

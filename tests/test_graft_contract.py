@@ -38,3 +38,31 @@ def test_dryrun_multichip_8(capsys):
     from paddle_tpu.distributed import comm
 
     assert comm.hybrid_mesh() is None
+
+
+import pytest  # noqa: E402
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_32():
+    """Pod-scale factorings (ISSUE 6 / ROADMAP 3): dp8 x mp2 x pp2 and the
+    32-device sharded-flash dp16 x mp2 step, with per-phase compile_s
+    stamps for the bench_continuity report-only drift check. Subprocess:
+    the in-process harness is pinned to 8 virtual devices."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # dryrun forces its own device count
+    p = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, '/root/repo'); "
+         "import __graft_entry__ as g; g.dryrun_multichip(32)"],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd="/root/repo",
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "GPT dp8xpp2xmp2" in p.stdout
+    assert "sharded-flash dp16xmp2" in p.stdout
+    assert p.stdout.count("compile_s=") >= 2

@@ -126,12 +126,67 @@ def compare(prev: dict, cur: dict):
     return regressions, waived, improvements
 
 
+def multichip_compile_times(path: str) -> dict:
+    """Per-phase `compile_s=` values from a MULTICHIP_r*.json dryrun
+    tail, keyed by the phase label (the text between the prefix and the
+    loss). Older rounds without compile stamps return {}."""
+    try:
+        with open(path) as f:
+            tail = json.load(f).get("tail", "")
+    except (OSError, ValueError):
+        return {}
+    out = {}
+    for m in re.finditer(
+        r"dryrun_multichip\(\d+\): (.+?) loss=\S+ compile_s=([0-9.]+)",
+        tail,
+    ):
+        out[m.group(1).strip()] = float(m.group(2))
+    return out
+
+
+def multichip_compile_report(root: str):
+    """REPORT-ONLY compile-time drift between the two latest
+    MULTICHIP_r*.json dryruns (ISSUE 6 / ROADMAP 3: GSPMD partition
+    cliffs on the pod-scale CPU mesh show up as compile-time blowups
+    long before a chip run). Never gates — compile time on a shared
+    host is too noisy to fail on; the trend is what matters."""
+    paths = glob.glob(os.path.join(root, "MULTICHIP_r*.json"))
+    rounds = []
+    for p in paths:
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", p)
+        if m:
+            rounds.append((int(m.group(1)), p))
+    rounds.sort()
+    if len(rounds) < 2:
+        return []
+    (_, prev_p), (_, cur_p) = rounds[-2], rounds[-1]
+    prev, cur = (multichip_compile_times(prev_p),
+                 multichip_compile_times(cur_p))
+    lines = []
+    for name in sorted(set(prev) | set(cur)):
+        a, b = prev.get(name), cur.get(name)
+        if a is not None and b is not None and a > 0:
+            lines.append(
+                f"  report  compile_s[{name}]: {a:g} -> {b:g} "
+                f"({(b - a) / a:+.1%}, not gated)"
+            )
+        elif b is not None:
+            lines.append(f"  report  compile_s[{name}]: {b:g} (new)")
+    if lines:
+        lines.insert(0, (
+            f"multichip compile-time (report-only): "
+            f"{os.path.basename(prev_p)} -> {os.path.basename(cur_p)}"
+        ))
+    return lines
+
+
 def check(root: str):
     """-> (exit_code, report_lines)."""
     pair = load_latest_pair(root)
     lines = []
     if pair is None:
-        return 0, ["bench_continuity: fewer than two BENCH_r*.json — skip"]
+        return 0, (["bench_continuity: fewer than two BENCH_r*.json — skip"]
+                   + multichip_compile_report(root))
     (prev_p, prev), (cur_p, cur) = pair
     lines.append(
         f"bench_continuity: {os.path.basename(prev_p)} -> "
@@ -175,6 +230,7 @@ def check(root: str):
         else:
             lines.append(f"  warn    guard_overhead_pct: {gp:g}% > "
                          f"{GUARD_OVERHEAD_PCT:g}% (single-shot round)")
+    lines.extend(multichip_compile_report(root))
     if rc:
         lines.append(
             "FAIL: unannotated >10% regression(s) or guard-overhead "
