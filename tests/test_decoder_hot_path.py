@@ -23,6 +23,19 @@ from paddle_tpu.nn.functional import attention as attn_route
 rng = np.random.RandomState(3)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_trivial_mesh():
+    """ISSUE 7 satellite: _mesh() installs a trivial 1-device hybrid
+    mesh that used to OUTLIVE this module — an adjacent DataParallel
+    TrainStep then placed its guard state on that 1-device mesh while
+    params sat on the 8-device default group ("incompatible devices",
+    order-dependent outside the tier-1 ordering). Restore the prior
+    mesh when the module finishes."""
+    prev = comm._state.hybrid_mesh
+    yield
+    comm._state.hybrid_mesh = prev
+
+
 def _mesh():
     if comm.hybrid_mesh() is None:
         comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)

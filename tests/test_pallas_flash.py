@@ -11,6 +11,18 @@ from paddle_tpu.ops.pallas import flash_attention
 B, H, S, D = 2, 2, 128, 32
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_trivial_mesh():
+    """Same leak as test_decoder_hot_path (ISSUE 7 satellite): the
+    trivial 1-device hybrid mesh installed for the routing tests must
+    not outlive this module."""
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    yield
+    comm._state.hybrid_mesh = prev
+
+
 def _qkv(seed=0):
     r = np.random.RandomState(seed)
     return [

@@ -1,0 +1,799 @@
+"""tpulint (ISSUE 7 tentpole): every rule must flag a reconstructed
+PRE-FIX version of its PR-history exemplar and stay quiet on the shipped
+fix, suppressions and the baseline must round-trip, and the full sweep
+over `paddle_tpu/` + the verbatim reference scripts must be clean.
+
+The fixtures are deliberately written in the repo's own idiom (the same
+function/argument shapes as train_step.py / sharded.py / attention.py)
+so a rule that goes blind to the real tree fails here first.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools.tpulint import core as lint_core  # noqa: E402
+from tools.tpulint import rules as lint_rules  # noqa: F401,E402
+from tools.tpulint.rules import collectives as coll_rule  # noqa: E402
+
+
+def run_lint(tmp_path, sources: dict, rule=None, alias=False):
+    """Write fixture sources into tmp_path and lint them."""
+    paths = []
+    for name, src in sources.items():
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    findings, errors = lint_core.run(
+        paths, rules={rule} if rule else None, enable_alias=alias,
+        root=str(tmp_path),
+    )
+    assert not errors, errors
+    return findings
+
+
+def names(findings, rule):
+    return [f for f in findings if f.rule == rule and not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# rule exemplars: pre-fix flags, shipped fix stays quiet
+# ---------------------------------------------------------------------------
+
+
+class TestPallasInGspmd:
+    PRE_FIX = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2
+
+        def fused_op(x):
+            return pl.pallas_call(_kernel, out_shape=x)(x)
+
+        def step(params, x):
+            return fused_op(x) + params[0]
+
+        step_jit = jax.jit(step)
+    """
+    # the ISSUE-6 fix shape: kernel dispatch guarded by a mesh-routing
+    # decision, multi-device case through the shard_map seam
+    FIXED = """
+        import jax
+        from jax.experimental import pallas as pl
+        from jax.sharding import PartitionSpec as P
+        from somewhere import shard_map, hybrid_mesh
+
+        def _kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2
+
+        def fused_op(x):
+            return pl.pallas_call(_kernel, out_shape=x)(x)
+
+        def routed_op(x):
+            mesh = hybrid_mesh()
+            if mesh is None or mesh.size <= 1:
+                return fused_op(x)
+            return shard_map(
+                fused_op, mesh, in_specs=P("dp"), out_specs=P("dp"),
+            )(x)
+
+        def step(params, x):
+            return routed_op(x) + params[0]
+
+        step_jit = jax.jit(step)
+    """
+
+    def test_pre_fix_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX},
+                      rule="pallas-in-gspmd")
+        hits = names(fs, "pallas-in-gspmd")
+        assert len(hits) == 1
+        assert "fused_op" in hits[0].message
+
+    def test_shipped_fix_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED},
+                      rule="pallas-in-gspmd")
+        assert not names(fs, "pallas-in-gspmd")
+
+    def test_repo_kernels_quiet(self):
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "ops"),
+             os.path.join(REPO, "paddle_tpu", "nn")],
+            rules={"pallas-in-gspmd"}, root=REPO,
+        )
+        assert not errors
+        assert not names(findings, "pallas-in-gspmd")
+
+
+class TestHostSyncInStep:
+    # the pre-round-4 shape: per-step host reads inside the step body
+    PRE_FIX = """
+        import jax
+        import numpy as np
+
+        class TrainStep:
+            def _step_fn(self, p_raws, opt_state, x):
+                loss = (p_raws[0] * x).sum()
+                print("loss", loss)
+                scale = float(loss)
+                host = np.asarray(loss)
+                flag = loss.item()
+                got = jax.device_get(loss)
+                return loss * scale + host + flag + got
+
+            def __call__(self, x):
+                return jax.jit(self._step_fn)(self.p, self.s, x)
+    """
+    # the shipped fix: host policy reads on the RETURNED arrays
+    FIXED = """
+        import jax
+        import numpy as np
+
+        class TrainStep:
+            def _step_fn(self, p_raws, opt_state, x):
+                loss = (p_raws[0] * x).sum()
+                t = int(x.shape[0])  # static under trace: quiet
+                return loss / t
+
+            def __call__(self, x):
+                loss = jax.jit(self._step_fn)(self.p, self.s, x)
+                return float(np.asarray(loss))  # host side: quiet
+    """
+
+    def test_pre_fix_flags_every_sync(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX},
+                      rule="host-sync-in-step")
+        msgs = "\n".join(f.message for f in names(fs, "host-sync-in-step"))
+        for marker in ("print()", "float()", "np.asarray", ".item()",
+                       "device_get"):
+            assert marker in msgs, f"missing {marker}:\n{msgs}"
+
+    def test_shipped_fix_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED},
+                      rule="host-sync-in-step")
+        assert not names(fs, "host-sync-in-step")
+
+    def test_real_train_step_quiet(self):
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "jit", "train_step.py"),
+             os.path.join(REPO, "paddle_tpu", "distributed", "fleet",
+                          "localsgd.py")],
+            rules={"host-sync-in-step"}, root=REPO,
+        )
+        assert not errors
+        assert not names(findings, "host-sync-in-step")
+
+
+class TestDonationAlias:
+    # PR-5 pre-fix: the guard carry donated alongside params/opt state
+    PRE_FIX_CARRY = """
+        import jax
+
+        class TrainStep:
+            def _step_fn(self, p_raws, opt_state, b_raws, key, lr, t,
+                         scaler_state, guard_state, x):
+                return p_raws, opt_state, guard_state
+
+            def build(self):
+                donate = (0, 1, 2) if self._donate else ()
+                if self._donate:
+                    donate = donate + (6, 7)
+                self._jitted = jax.jit(
+                    self._step_fn, donate_argnums=donate
+                )
+    """
+    # the shipped fix: carry excluded from donation
+    FIXED_CARRY = """
+        import jax
+
+        class TrainStep:
+            def _step_fn(self, p_raws, opt_state, b_raws, key, lr, t,
+                         scaler_state, guard_state, x):
+                return p_raws, opt_state, guard_state
+
+            def build(self):
+                donate = (0, 1, 2) if self._donate else ()
+                if self._donate and self._scaling is not None:
+                    donate = donate + (6,)
+                self._jitted = jax.jit(
+                    self._step_fn, donate_argnums=donate
+                )
+    """
+    PRE_FIX_READ = """
+        import jax
+
+        def step(params, x):
+            return [p * x for p in params]
+
+        jf = jax.jit(step, donate_argnums=(0,))
+
+        def run(params, x):
+            new_p = jf(params, x)
+            stale = sum(p.sum() for p in params)
+            return new_p, stale
+    """
+    FIXED_READ = """
+        import jax
+
+        def step(params, x):
+            return [p * x for p in params]
+
+        jf = jax.jit(step, donate_argnums=(0,))
+
+        def run(params, x):
+            total = sum(p.sum() for p in params)  # read BEFORE dispatch
+            new_p = jf(params, x)
+            return new_p, total
+    """
+
+    def test_guard_carry_donation_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX_CARRY},
+                      rule="donation-alias")
+        hits = names(fs, "donation-alias")
+        assert len(hits) == 1
+        assert "guard_state" in hits[0].message
+
+    def test_shipped_donation_set_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED_CARRY},
+                      rule="donation-alias")
+        assert not names(fs, "donation-alias")
+
+    def test_read_after_donate_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX_READ},
+                      rule="donation-alias")
+        hits = names(fs, "donation-alias")
+        assert len(hits) == 1
+        assert "read after being donated" in hits[0].message
+
+    def test_read_before_donate_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED_READ},
+                      rule="donation-alias")
+        assert not names(fs, "donation-alias")
+
+    def test_real_train_step_quiet(self):
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "jit", "train_step.py")],
+            rules={"donation-alias"}, root=REPO,
+        )
+        assert not errors
+        assert not names(findings, "donation-alias")
+
+    def test_late_shallow_rebind_does_not_shadow_earlier_read(
+            self, tmp_path):
+        """ast.walk is breadth-first: a shallow rebind on a LATER line
+        used to be visited before a nested genuine read on an EARLIER
+        line, terminating the scan and hiding the use-after-donate."""
+        src = """
+            import jax
+
+            def step(params, x):
+                return [p * x for p in params]
+
+            jf = jax.jit(step, donate_argnums=(0,))
+
+            def run(params, x):
+                new_p = jf(params, x)
+                if x is not None:
+                    stale = sum(p.sum() for p in params)
+                params = new_p
+                return params, stale
+        """
+        fs = run_lint(tmp_path, {"mod.py": src}, rule="donation-alias")
+        hits = names(fs, "donation-alias")
+        assert len(hits) == 1
+        assert "read after being donated" in hits[0].message
+
+
+class TestDivergentCollective:
+    # PR-2 pre-fix class: a collective only rank 0 enters
+    PRE_FIX = """
+        import paddle_tpu.distributed as dist
+
+        def sync_stats(t):
+            if dist.get_rank() == 0:
+                dist.all_reduce(t)
+            return t
+    """
+    FIXED = """
+        import paddle_tpu.distributed as dist
+
+        def sync_stats(t):
+            dist.all_reduce(t)          # every rank, unconditionally
+            if dist.get_rank() == 0:
+                log(t)                  # rank-dependent NON-comm is fine
+            return t
+    """
+    TRACED = """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            gnorm = jnp.sqrt((x * x).sum())
+            if gnorm > 10.0:
+                x = jax.lax.pmean(x, "dp")
+            return x
+
+        jstep = jax.jit(step)
+    """
+
+    def test_rank_branch_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX},
+                      rule="divergent-collective")
+        hits = names(fs, "divergent-collective")
+        assert len(hits) == 1
+        assert "all_reduce" in hits[0].message
+
+    def test_unconditional_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED},
+                      rule="divergent-collective")
+        assert not names(fs, "divergent-collective")
+
+    def test_traced_branch_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.TRACED},
+                      rule="divergent-collective")
+        hits = names(fs, "divergent-collective")
+        assert len(hits) == 1
+        assert "pmean" in hits[0].message
+
+    def test_site_list_matches_comm_monitor(self):
+        """The rule's op set must cover every op the runtime monitor
+        records (collective.py's _watched/_record_spmd sites)."""
+        ops = coll_rule.monitored_ops(REPO)
+        assert "all_reduce" in ops  # scanner sanity
+        uncovered = ops - coll_rule.COLLECTIVES
+        assert not uncovered, (
+            f"comm-monitor records {sorted(uncovered)} but "
+            "divergent-collective does not watch them"
+        )
+
+    def test_repo_comm_layer_quiet(self):
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "distributed")],
+            rules={"divergent-collective"}, root=REPO,
+        )
+        assert not errors
+        assert not names(findings, "divergent-collective")
+
+
+class TestNumpyOnTracer:
+    PRE_FIX = """
+        import jax
+        import numpy as np
+
+        class LocalSGDStep:
+            def _step_fn(self, p, x):
+                h = np.tanh(x)
+                return (p * h).sum()
+    """
+    FIXED = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        TABLE = np.asarray([1.0, 2.0])   # module-level constant: quiet
+
+        class LocalSGDStep:
+            def _step_fn(self, p, x):
+                h = jnp.tanh(x)
+                lo = np.float32(0.5)     # dtype constructor: quiet
+                return (p * h).sum() * lo
+    """
+
+    def test_pre_fix_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX},
+                      rule="numpy-on-tracer")
+        hits = names(fs, "numpy-on-tracer")
+        assert len(hits) == 1
+        assert "np.tanh" in hits[0].message
+
+    def test_shipped_fix_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED},
+                      rule="numpy-on-tracer")
+        assert not names(fs, "numpy-on-tracer")
+
+
+class TestPsumInShardVjp:
+    # ISSUE-6 dgamma/dbeta pre-fix: backward body misses the psum
+    PRE_FIX = """
+        import functools
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from somewhere import shard_map, _ln_backward
+
+        @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+        def sharded_ln(x, w, b, mesh):
+            return x
+
+        def _fwd(x, w, b, mesh):
+            return x, (x, w)
+
+        def _bwd_body(x2d, w2d, g2d):
+            dx, dw, db = _ln_backward(x2d, w2d, g2d)
+            return dx, dw, db
+
+        def _bwd(mesh, res, g):
+            x, w = res
+            dx, dw, db = shard_map(
+                _bwd_body, mesh,
+                in_specs=(P("dp", None), P(), P("dp", None)),
+                out_specs=(P("dp", None), P(), P()),
+            )(x, w, g)
+            return dx, dw, db
+
+        sharded_ln.defvjp(_fwd, _bwd)
+    """
+
+    def test_pre_fix_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX},
+                      rule="psum-in-shard-vjp")
+        hits = names(fs, "psum-in-shard-vjp")
+        assert len(hits) == 1
+        assert "_bwd" in hits[0].message
+
+    def test_shipped_sharded_ln_quiet(self):
+        """ops/pallas/sharded.py IS the shipped fix — explicit psum in
+        the backward body."""
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "ops", "pallas",
+                          "sharded.py")],
+            rules={"psum-in-shard-vjp"}, root=REPO,
+        )
+        assert not errors
+        assert not names(findings, "psum-in-shard-vjp")
+
+    def test_sharded_outputs_need_no_psum(self, tmp_path):
+        src = self.PRE_FIX.replace(
+            'out_specs=(P("dp", None), P(), P()),',
+            'out_specs=(P("dp", None), P("dp"), P("dp")),',
+        )
+        fs = run_lint(tmp_path, {"mod.py": src},
+                      rule="psum-in-shard-vjp")
+        assert not names(fs, "psum-in-shard-vjp")
+
+
+# ---------------------------------------------------------------------------
+# framework: suppressions, baseline, env-knob rule, CLI
+# ---------------------------------------------------------------------------
+
+
+class TestSuppressions:
+    def test_trailing_and_line_above(self, tmp_path):
+        src = """
+            import paddle_tpu.distributed as dist
+
+            def a(t):
+                if dist.get_rank() == 0:
+                    dist.all_reduce(t)  # tpulint: disable=divergent-collective
+                return t
+
+            def b(t):
+                if dist.get_rank() == 0:
+                    # tpulint: disable=divergent-collective — src-only push
+                    dist.all_reduce(t)
+                return t
+
+            def c(t):
+                if dist.get_rank() == 0:
+                    dist.all_reduce(t)  # tpulint: disable=donation-alias
+                return t
+        """
+        fs = run_lint(tmp_path, {"mod.py": src},
+                      rule="divergent-collective")
+        all_f = [f for f in fs if f.rule == "divergent-collective"]
+        live = names(fs, "divergent-collective")
+        assert len(all_f) == 3          # findings still reported...
+        assert len(live) == 1           # ...two suppressed, wrong-rule
+        assert live[0].line > 14        # comment survives only in c()
+
+    def test_ascii_hyphen_reason_does_not_break_suppression(
+            self, tmp_path):
+        """A free-text reason after the rule name (README-documented
+        style, with a plain ASCII hyphen) must not swallow into the
+        rule name and silently void the suppression."""
+        src = """
+            import paddle_tpu.distributed as dist
+
+            def a(t):
+                if dist.get_rank() == 0:
+                    dist.all_reduce(t)  # tpulint: disable=divergent-collective - every rank re-enters via the retry loop
+                return t
+        """
+        fs = run_lint(tmp_path, {"mod.py": src},
+                      rule="divergent-collective")
+        assert not names(fs, "divergent-collective")
+
+    def test_disable_all(self, tmp_path):
+        src = """
+            import paddle_tpu.distributed as dist
+
+            def a(t):
+                if dist.get_rank() == 0:
+                    dist.all_reduce(t)  # tpulint: disable=all
+                return t
+        """
+        fs = run_lint(tmp_path, {"mod.py": src},
+                      rule="divergent-collective")
+        assert not names(fs, "divergent-collective")
+
+
+class TestBaseline:
+    SRC = """
+        import paddle_tpu.distributed as dist
+
+        def a(t):
+            if dist.get_rank() == 0:
+                dist.all_reduce(t)
+            return t
+    """
+
+    def _findings(self, tmp_path):
+        return run_lint(tmp_path, {"mod.py": self.SRC},
+                        rule="divergent-collective")
+
+    def test_round_trip(self, tmp_path):
+        fs = self._findings(tmp_path)
+        bl_path = str(tmp_path / "baseline.json")
+        bl = lint_core.write_baseline(bl_path, fs)
+        # written entries carry the TODO note and load back
+        loaded = lint_core.load_baseline(bl_path)
+        assert set(loaded) == set(bl)
+        fs2 = self._findings(tmp_path)
+        new, stale = lint_core.apply_baseline(fs2, loaded)
+        assert not new and not stale
+        assert all(f.baselined for f in fs2)
+
+    def test_new_finding_not_masked(self, tmp_path):
+        fs = self._findings(tmp_path)
+        bl_path = str(tmp_path / "baseline.json")
+        loaded = lint_core.write_baseline(bl_path, fs)
+        src2 = textwrap.dedent(self.SRC) + textwrap.dedent("""
+            def b(t):
+                if dist.get_rank() == 1:
+                    dist.broadcast(t)
+                return t
+        """)
+        fs2 = run_lint(tmp_path, {"mod.py": src2},
+                       rule="divergent-collective")
+        new, stale = lint_core.apply_baseline(fs2, loaded)
+        assert len(new) == 1 and "broadcast" in new[0].message
+        assert not stale
+
+    def test_stale_entry_reported(self, tmp_path):
+        fs = self._findings(tmp_path)
+        bl_path = str(tmp_path / "baseline.json")
+        loaded = lint_core.write_baseline(bl_path, fs)
+        fixed = self.SRC.replace("if dist.get_rank() == 0:\n", "if True:\n")
+        fs2 = run_lint(tmp_path, {"fixedmod.py": fixed},
+                       rule="divergent-collective")
+        new, stale = lint_core.apply_baseline(fs2, loaded)
+        assert not new
+        assert len(stale) == 1  # the parked finding no longer fires
+
+    def test_silent_entries_rejected(self, tmp_path):
+        bl_path = tmp_path / "baseline.json"
+        bl_path.write_text(json.dumps({
+            "version": 1,
+            "entries": [{"rule": "x", "path": "y.py",
+                         "fingerprint": "abc", "note": ""}],
+        }))
+        with pytest.raises(lint_core.BaselineError, match="note"):
+            lint_core.load_baseline(str(bl_path))
+
+    def test_checked_in_baseline_loads_with_notes(self):
+        bl = lint_core.load_baseline(lint_core.default_baseline_path())
+        for e in bl.values():
+            assert str(e.get("note", "")).strip()
+
+    def test_write_baseline_preserves_unswept_paths(self, tmp_path):
+        """A path-subset --write-baseline must carry over (not drop)
+        entries for files outside the sweep, note included, while
+        still regenerating — and thus stale-dropping — swept files."""
+        fs = self._findings(tmp_path)
+        bl_path = str(tmp_path / "baseline.json")
+        lint_core.write_baseline(bl_path, fs)
+        # hand-curate the other file's parked entry
+        other = {"rule": "host-sync-in-step", "path": "other.py",
+                 "line_hint": 3, "fingerprint": "deadbeef0000",
+                 "note": "tracked in ISSUE-X"}
+        data = json.loads(open(bl_path).read())
+        data["entries"].append(other)
+        open(bl_path, "w").write(json.dumps(data))
+        loaded = lint_core.load_baseline(bl_path)
+        # re-sweep ONLY mod.py, now fixed: its entry drops as stale,
+        # other.py's entry (not swept) survives verbatim
+        fixed = self.SRC.replace("if dist.get_rank() == 0:\n",
+                                 "if True:\n")
+        fs2 = run_lint(tmp_path, {"mod.py": fixed},
+                       rule="divergent-collective")
+        merged = lint_core.write_baseline(
+            bl_path, fs2, loaded, swept_paths={"mod.py"})
+        assert "deadbeef0000" in merged
+        assert merged["deadbeef0000"]["note"] == "tracked in ISSUE-X"
+        assert len(merged) == 1  # mod.py's stale entry dropped
+
+    def test_fingerprint_survives_line_moves(self, tmp_path):
+        fs = self._findings(tmp_path)
+        moved = "import os\n\n" + textwrap.dedent(self.SRC)
+        fs2 = run_lint(tmp_path, {"mod.py": moved},
+                       rule="divergent-collective")
+        assert [f.fingerprint for f in fs] == \
+            [f.fingerprint for f in fs2]
+
+
+class TestEnvKnobRule:
+    def test_undocumented_knob_flags(self, tmp_path):
+        (tmp_path / "README.md").write_text("# nothing documented\n")
+        pkg = tmp_path / "paddle_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            'import os\nV = os.environ.get("PADDLE_MADE_UP_KNOB", "")\n'
+        )
+        findings, errors = lint_core.run(
+            [str(pkg)], rules={"env-knob-docs"}, root=str(tmp_path),
+        )
+        assert not errors
+        hits = names(findings, "env-knob-docs")
+        assert len(hits) == 1 and "PADDLE_MADE_UP_KNOB" in hits[0].message
+
+    def test_documented_knob_quiet(self, tmp_path):
+        (tmp_path / "README.md").write_text(
+            "| `PADDLE_MADE_UP_KNOB` | does things |\n"
+        )
+        pkg = tmp_path / "paddle_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            'import os\nV = os.environ.get("PADDLE_MADE_UP_KNOB", "")\n'
+        )
+        findings, errors = lint_core.run(
+            [str(pkg)], rules={"env-knob-docs"}, root=str(tmp_path),
+        )
+        assert not errors
+        assert not names(findings, "env-knob-docs")
+
+    def test_project_rule_honors_line_above_suppression(self, tmp_path):
+        """Project-rule findings must honor BOTH documented suppression
+        forms — the comment-line-above variant used to be ignored on
+        this path (only trailing comments were checked)."""
+        (tmp_path / "README.md").write_text("# nothing documented\n")
+        pkg = tmp_path / "paddle_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "import os\n"
+            "# tpulint: disable=env-knob-docs — internal-only knob\n"
+            'V = os.environ.get("PADDLE_MADE_UP_KNOB", "")\n'
+        )
+        findings, errors = lint_core.run(
+            [str(pkg)], rules={"env-knob-docs"}, root=str(tmp_path),
+        )
+        assert not errors
+        assert not names(findings, "env-knob-docs")
+
+
+class TestCli:
+    def _run(self, *args, env_extra=None):
+        env = dict(os.environ)
+        env.pop("PADDLE_LINT_DISABLE", None)
+        env.pop("PADDLE_LINT_ALIAS", None)
+        if env_extra:
+            env.update(env_extra)
+        return subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", *args],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=120,
+        )
+
+    def test_acceptance_sweep_clean_and_fast(self):
+        """ISSUE 7 acceptance: the full sweep runs clean (zero
+        non-baselined findings) well inside the 10s budget."""
+        import time
+
+        t0 = time.monotonic()
+        r = self._run("paddle_tpu", "tests/reference_scripts")
+        dt = time.monotonic() - t0
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "0 new" in r.stdout
+        assert dt < 10.0, f"sweep took {dt:.1f}s (budget 10s)"
+
+    def test_new_finding_exits_nonzero(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import paddle_tpu.distributed as dist
+
+            def a(t):
+                if dist.get_rank() == 0:
+                    dist.all_reduce(t)
+                return t
+        """))
+        r = self._run(str(bad))
+        assert r.returncode == 1
+        assert "divergent-collective" in r.stdout
+
+    def test_json_output(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+
+            def step(p, x):
+                return p * x
+
+            jf = jax.jit(step, donate_argnums=(0,))
+
+            def run(p, x):
+                out = jf(p, x)
+                return out, p.sum()
+        """))
+        r = self._run(str(bad), "--json")
+        assert r.returncode == 1
+        data = json.loads(r.stdout)
+        assert data["new"]
+        assert any(f["rule"] == "donation-alias"
+                   for f in data["findings"])
+
+    def test_rule_filter_and_list(self):
+        r = self._run("--list-rules")
+        assert r.returncode == 0
+        for rule in ("pallas-in-gspmd", "host-sync-in-step",
+                     "donation-alias", "divergent-collective",
+                     "numpy-on-tracer", "psum-in-shard-vjp",
+                     "env-knob-docs", "alias-parity"):
+            assert rule in r.stdout
+
+    def test_write_baseline_refuses_filtered_runs(self, tmp_path):
+        """--write-baseline from a rule-filtered or baseline-blind run
+        would destroy the other rules' entries / curated notes."""
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1\n")
+        bl = str(tmp_path / "bl.json")
+        r = self._run(str(bad), "--baseline", bl, "--write-baseline",
+                      "--rule", "donation-alias")
+        assert r.returncode == 2
+        assert "refusing --write-baseline" in r.stderr
+        r = self._run(str(bad), "--baseline", bl, "--write-baseline",
+                      env_extra={"PADDLE_LINT_DISABLE":
+                                 "divergent-collective"})
+        assert r.returncode == 2
+        r = self._run(str(bad), "--baseline", bl, "--write-baseline",
+                      "--no-baseline")
+        assert r.returncode == 2
+        assert "contradicts" in r.stderr
+
+    def test_env_disable(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import paddle_tpu.distributed as dist
+
+            def a(t):
+                if dist.get_rank() == 0:
+                    dist.all_reduce(t)
+                return t
+        """))
+        r = self._run(
+            str(bad),
+            env_extra={"PADDLE_LINT_DISABLE": "divergent-collective"},
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+class TestReferenceScriptsAreFixtures:
+    """The verbatim reference scripts are lint fixtures: user training
+    scripts must come through the sweep clean (their host-side numpy /
+    print usage is OUTSIDE compiled bodies and must not false-positive).
+    """
+
+    def test_reference_scripts_clean(self):
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "tests", "reference_scripts")],
+            root=REPO,
+        )
+        assert not errors
+        live = [f for f in findings
+                if not f.suppressed and f.rule != "env-knob-docs"]
+        assert not live, [f.render() for f in live]

@@ -1,0 +1,35 @@
+"""tpulint — trace/shard/donation static analysis over the compiled-step
+surface (ISSUE 7 tentpole).
+
+One sub-second AST pass over `paddle_tpu/` and user training scripts
+encoding the hazard classes PRs 1-6 fixed by hand at runtime:
+
+=====================  ====================================================
+rule                   bug class (PR-history exemplar)
+=====================  ====================================================
+pallas-in-gspmd        pallas_call reachable from a jit region without a
+                       shard_map seam or mesh guard (PR 6 headline)
+host-sync-in-step      .item()/print/np.asarray/device_get/float on traced
+                       values inside TrainStep/LocalSGDStep bodies
+donation-alias         buffer read after donation; donation of the
+                       host-monitored guard carry (PR 5)
+divergent-collective   collective call under rank-/data-dependent control
+                       flow (the hang class PR 2's monitor attributes)
+numpy-on-tracer        np.* math on values dataflowing from jnp inside
+                       compiled regions
+psum-in-shard-vjp      custom_vjp backward under shard_map whose reduced
+                       partials lack an explicit lax.psum (dgamma/dbeta)
+env-knob-docs          PADDLE_* knob referenced but undocumented (migrated
+                       from test_hygiene's ad-hoc check)
+alias-parity           tools/check_alias.py folded in (--alias; imports)
+=====================  ====================================================
+
+Entry point: ``python -m tools.tpulint [paths...]``.  Suppress one
+finding with a trailing ``# tpulint: disable=<rule>`` comment; park
+pre-existing findings in ``tools/tpulint/baseline.json`` (every entry
+carries a mandatory tracking note; the gate fails only on NEW findings).
+"""
+from .core import (  # noqa: F401
+    Finding, ModuleSource, ProjectRule, REGISTRY, Rule, apply_baseline,
+    load_baseline, register, run, write_baseline,
+)

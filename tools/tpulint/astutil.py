@@ -1,0 +1,346 @@
+"""Shared AST machinery for tpulint rules.
+
+Three facilities every trace/shard rule needs:
+
+* **qualified names** — ``dotted(node)`` renders ``jax.lax.psum`` /
+  ``self._step_fn`` / ``np.asarray`` call targets as dotted strings so
+  rules can match on suffixes without resolving imports.
+* **compiled-region call graph** — which functions in a module execute
+  under ``jax.jit`` / ``shard_map`` / grad tracing?  Roots are functions
+  referenced by a jit/trace wrapper call (or decorator), plus step-body
+  methods of ``*Step`` classes; membership propagates through
+  module-local references (direct calls, names passed as arguments,
+  ``functools.partial`` targets, lambda bodies).
+* **taint** — a per-function fixpoint over assignments marking names
+  that (conservatively) dataflow from traced values: parameters and
+  anything derived from ``jnp``/``jax.lax`` results.  Shape/dtype reads
+  sanitize (``x.shape`` is static under trace).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+# wrappers whose function argument runs inside a compiled program
+JIT_WRAPPERS = {"jit", "pjit"}              # jax.jit, jax.pjit, bare jit
+TRACE_WRAPPERS = {
+    "grad", "value_and_grad", "checkpoint", "remat", "vmap", "pmap",
+    "make_jaxpr", "custom_vjp", "custom_jvp", "scan", "while_loop",
+    "fori_loop", "cond", "switch",
+}
+SHARD_WRAPPERS = {"shard_map"}              # any *.shard_map / _shard_map
+
+
+def dotted(node) -> str:
+    """Render a Name/Attribute chain as a dotted string ('' if not)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        # functools.partial(f, ...)(...) — render the inner target
+        inner = dotted(node.func)
+        if inner:
+            parts.append(f"{inner}(...)")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def terminal(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def is_wrapper_call(call: ast.Call, kinds: Set[str]) -> bool:
+    t = terminal(dotted(call.func))
+    if t in kinds:
+        return True
+    # local aliases like `_shard_map` wrapping comm.shard_map
+    return any(t.endswith(k) for k in kinds if k == "shard_map")
+
+
+def parent_map(tree) -> Dict[ast.AST, ast.AST]:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing(node, parents, types):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, types):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+class FuncInfo:
+    def __init__(self, node, class_name: Optional[str]):
+        self.node = node
+        self.class_name = class_name
+
+    @property
+    def key(self):
+        return (self.class_name, self.node.name)
+
+
+class ModuleGraph:
+    """Module-local function index + compiled-region membership."""
+
+    def __init__(self, tree: ast.AST):
+        self.tree = tree
+        self.parents = parent_map(tree)
+        # (class_name|None, func_name) -> FuncInfo ; module-level lambda
+        # bodies belong to their enclosing def.
+        self.funcs: Dict[tuple, FuncInfo] = {}
+        self._index()
+        self.compiled: Set[tuple] = set()
+        self._mark_compiled()
+
+    # -- indexing ----------------------------------------------------------
+    def _index(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls = enclosing(node, self.parents, (ast.ClassDef,))
+                cname = cls.name if cls is not None else None
+                self.funcs[(cname, node.name)] = FuncInfo(node, cname)
+
+    def owner_func(self, node):
+        """The FunctionDef whose body lexically contains `node`."""
+        return enclosing(
+            node, self.parents, (ast.FunctionDef, ast.AsyncFunctionDef)
+        )
+
+    def resolve(self, ref: str, from_class: Optional[str]):
+        """Resolve a dotted reference to a module-local FuncInfo."""
+        if not ref:
+            return None
+        if ref.startswith("self.") and from_class:
+            return self.funcs.get((from_class, ref[5:]))
+        t = terminal(ref)
+        # bare module-level function
+        if "." not in ref:
+            return self.funcs.get((None, ref))
+        # Class.method (rare) — try any class with that method
+        for (cname, fname), info in self.funcs.items():
+            if fname == t and cname is not None and ref.startswith(
+                    cname + "."):
+                return info
+        return None
+
+    # -- compiled-region marking ------------------------------------------
+    def _func_refs(self, func: ast.FunctionDef) -> List[str]:
+        """Dotted references loaded inside `func` (calls, args passed
+        to calls, partial targets) that might name local functions.
+        Lambda bodies count as part of the enclosing function."""
+        refs = []
+        for node in ast.walk(func):
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                d = dotted(node)
+                if d:
+                    refs.append(d)
+        return refs
+
+    def _wrapper_targets(self):
+        """Functions referenced as the traced argument of a jit/trace/
+        shard wrapper call anywhere in the module (including inside
+        lambdas) plus jit-decorated defs."""
+        targets = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and is_wrapper_call(
+                    node, JIT_WRAPPERS | TRACE_WRAPPERS | SHARD_WRAPPERS):
+                for arg in node.args[:1] or []:
+                    targets.extend(self._callable_refs(arg, node))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    t = terminal(dotted(d))
+                    if t in JIT_WRAPPERS | TRACE_WRAPPERS:
+                        cls = enclosing(node, self.parents,
+                                        (ast.ClassDef,))
+                        targets.append(
+                            ((cls.name if cls else None), node.name))
+                    # @functools.partial(jax.jit, ...) /
+                    # @functools.partial(jax.custom_vjp, ...)
+                    if isinstance(dec, ast.Call) and t == "partial" \
+                            and dec.args:
+                        t2 = terminal(dotted(dec.args[0]))
+                        if t2 in JIT_WRAPPERS | TRACE_WRAPPERS:
+                            cls = enclosing(node, self.parents,
+                                            (ast.ClassDef,))
+                            targets.append(
+                                ((cls.name if cls else None), node.name))
+        return targets
+
+    def _callable_refs(self, arg, call_node):
+        """Resolve a wrapper's traced argument to local function keys:
+        a Name/Attribute reference, a functools.partial target, or the
+        local functions a Lambda body references."""
+        out = []
+        ctx_fn = self.owner_func(call_node)
+        ctx_cls = None
+        if ctx_fn is not None:
+            cls = enclosing(ctx_fn, self.parents, (ast.ClassDef,))
+            ctx_cls = cls.name if cls else None
+        def resolve_ref(d):
+            info = self.resolve(d, ctx_cls)
+            if info is not None:
+                out.append(info.key)
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            resolve_ref(dotted(arg))
+        elif isinstance(arg, ast.Call) and terminal(
+                dotted(arg.func)) == "partial" and arg.args:
+            resolve_ref(dotted(arg.args[0]))
+        elif isinstance(arg, ast.Lambda):
+            for node in ast.walk(arg):
+                if isinstance(node, (ast.Name, ast.Attribute)) and \
+                        isinstance(getattr(node, "ctx", None), ast.Load):
+                    resolve_ref(dotted(node))
+        return out
+
+    def _mark_compiled(self):
+        roots = set(self._wrapper_targets())
+        # step-body methods of *Step classes are compiled by contract
+        # even when the jax.jit call lives in another module
+        for (cname, fname), info in self.funcs.items():
+            if cname and cname.endswith("Step") and fname in (
+                    "_step_fn", "step_fn", "_worker"):
+                roots.add((cname, fname))
+        work = [k for k in roots if k in self.funcs]
+        self.compiled = set(work)
+        while work:
+            key = work.pop()
+            info = self.funcs.get(key)
+            if info is None:
+                continue
+            for ref in self._func_refs(info.node):
+                tgt = self.resolve(ref, info.class_name)
+                if tgt is not None and tgt.key not in self.compiled:
+                    self.compiled.add(tgt.key)
+                    work.append(tgt.key)
+
+    def compiled_funcs(self):
+        return [self.funcs[k] for k in sorted(
+            self.compiled, key=lambda k: (k[0] or "", k[1])
+        ) if k in self.funcs]
+
+
+# --------------------------------------------------------------------------
+# taint
+# --------------------------------------------------------------------------
+
+_TRACED_MODULES = ("jnp", "lax", "jax")
+_SANITIZE_ATTRS = {"shape", "ndim", "dtype", "size", "__name__"}
+
+
+def _expr_names(expr) -> Set[str]:
+    out = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+class Taint:
+    """Conservative intra-function dataflow from traced values.
+
+    Seeds: function parameters (minus self/cls and ``*Spec``-ish config
+    names), plus anything assigned from an expression that calls
+    ``jnp.*`` / ``jax.lax.*`` or reads a tainted name.  ``x.shape`` /
+    ``x.dtype`` / ``len(...)`` reads are static under trace and do NOT
+    propagate."""
+
+    def __init__(self, func: ast.FunctionDef):
+        self.func = func
+        self.names: Set[str] = set()
+        args = func.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs +
+                  ([args.vararg] if args.vararg else []) +
+                  ([args.kwarg] if args.kwarg else [])):
+            if a.arg not in ("self", "cls"):
+                self.names.add(a.arg)
+        self._fixpoint()
+
+    def _fixpoint(self):
+        for _ in range(10):
+            grew = False
+            for node in ast.walk(self.func):
+                if isinstance(node, ast.Assign):
+                    if self.expr_tainted(node.value):
+                        for tgt in node.targets:
+                            for n in _expr_names(tgt):
+                                if n not in self.names:
+                                    self.names.add(n)
+                                    grew = True
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    val = getattr(node, "value", None)
+                    if val is not None and self.expr_tainted(val):
+                        for n in _expr_names(node.target):
+                            if n not in self.names:
+                                self.names.add(n)
+                                grew = True
+                elif isinstance(node, ast.For):
+                    if self.expr_tainted(node.iter):
+                        for n in _expr_names(node.target):
+                            if n not in self.names:
+                                self.names.add(n)
+                                grew = True
+            if not grew:
+                return
+
+    def expr_tainted(self, expr) -> bool:
+        if expr is None:
+            return False
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute):
+                if node.attr in _SANITIZE_ATTRS:
+                    # static metadata read — does not carry taint, and
+                    # shields its base from the Name check below
+                    continue
+                d = dotted(node)
+                root = d.split(".", 1)[0] if d else ""
+                if root in _TRACED_MODULES:
+                    return True
+            if isinstance(node, ast.Name) and node.id in self.names:
+                # bare-name taint; sanitized shapes like int(x.shape[i])
+                # are stripped by `call_arg_tainted` where it matters
+                return True
+        return False
+
+    def call_arg_tainted(self, call: ast.Call) -> bool:
+        """Is any argument of `call` tainted, AFTER stripping sanitized
+        sub-expressions (shape/dtype/len reads)?"""
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if self._tainted_sans_sanitizers(arg):
+                return True
+        return False
+
+    def _tainted_sans_sanitizers(self, expr) -> bool:
+        if isinstance(expr, ast.Attribute) and \
+                expr.attr in _SANITIZE_ATTRS:
+            return False
+        if isinstance(expr, ast.Subscript):
+            return self._tainted_sans_sanitizers(expr.value)
+        if isinstance(expr, ast.Call):
+            t = terminal(dotted(expr.func))
+            if t in ("len", "int", "range"):
+                return False
+            return any(self._tainted_sans_sanitizers(a)
+                       for a in expr.args)
+        if isinstance(expr, ast.Name):
+            return expr.id in self.names
+        if isinstance(expr, ast.BinOp):
+            return (self._tainted_sans_sanitizers(expr.left)
+                    or self._tainted_sans_sanitizers(expr.right))
+        if isinstance(expr, ast.UnaryOp):
+            return self._tainted_sans_sanitizers(expr.operand)
+        for node in ast.iter_child_nodes(expr):
+            if isinstance(node, ast.expr) and \
+                    self._tainted_sans_sanitizers(node):
+                return True
+        return False

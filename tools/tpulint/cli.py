@@ -1,0 +1,155 @@
+"""tpulint CLI.
+
+Usage::
+
+    python -m tools.tpulint [paths...] [options]
+
+With no paths: lints `paddle_tpu/` and `tests/reference_scripts/`.
+
+Exit codes: 0 = clean (every finding suppressed or baselined),
+1 = new findings (or stale baseline entries), 2 = usage/baseline error.
+
+Knobs: ``PADDLE_LINT_BASELINE`` overrides the baseline path,
+``PADDLE_LINT_DISABLE`` skips rules (comma-separated),
+``PADDLE_LINT_ALIAS=1`` enables the import-time alias-parity rule.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import rules  # noqa: F401  (registers every rule)
+from .core import (
+    BaselineError, REGISTRY, apply_baseline, collect_files,
+    default_baseline_path, disabled_rules, load_baseline, repo_root,
+    run, write_baseline,
+)
+
+
+def _parser():
+    ap = argparse.ArgumentParser(
+        prog="tools.tpulint",
+        description="trace/shard/donation static analysis over the "
+                    "compiled-step surface",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: paddle_tpu "
+                         "tests/reference_scripts)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default tools/tpulint/"
+                         "baseline.json; PADDLE_LINT_BASELINE wins)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="park current findings in the baseline "
+                         "(existing notes preserved; new entries get "
+                         "a TODO(triage) note you must replace)")
+    ap.add_argument("--alias", action="store_true",
+                    help="also run the alias-parity rule (imports "
+                         "paddle_tpu + jax: slow)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed/baselined findings")
+    return ap
+
+
+def main(argv=None):
+    args = _parser().parse_args(argv)
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            print(f"{name:22s} {REGISTRY[name].summary}")
+        return 0
+    root = repo_root()
+    paths = args.paths or [
+        os.path.join(root, "paddle_tpu"),
+        os.path.join(root, "tests", "reference_scripts"),
+    ]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return 2
+    alias_on = args.alias or os.environ.get(
+        "PADDLE_LINT_ALIAS", "").strip() in ("1", "true", "on")
+    if args.write_baseline:
+        # a filtered run sees only a slice of the findings; overwriting
+        # the baseline from it would silently drop every other entry
+        # (and its curated tracking note)
+        if args.rule or disabled_rules():
+            print("tpulint: refusing --write-baseline on a rule-"
+                  "filtered run (--rule / PADDLE_LINT_DISABLE) — the "
+                  "unfiltered rules' baseline entries would be "
+                  "dropped; run without the filter", file=sys.stderr)
+            return 2
+        if args.no_baseline:
+            print("tpulint: --no-baseline contradicts --write-baseline"
+                  " (existing tracking notes would be reset to "
+                  "TODO(triage))", file=sys.stderr)
+            return 2
+    t0 = time.monotonic()
+    findings, errors = run(
+        paths, rules=set(args.rule) if args.rule else None,
+        enable_alias=alias_on, root=root,
+    )
+    bl_path = args.baseline or default_baseline_path()
+    baseline = {}
+    if not args.no_baseline:
+        try:
+            baseline = load_baseline(bl_path)
+        except BaselineError as e:
+            print(f"tpulint: {e}", file=sys.stderr)
+            return 2
+    if args.write_baseline:
+        swept = {
+            os.path.relpath(os.path.abspath(fp), root).replace(
+                os.sep, "/")
+            for fp in collect_files(paths)
+        }
+        baseline = write_baseline(bl_path, findings, baseline,
+                                  swept_paths=swept)
+        print(f"tpulint: wrote {len(baseline)} entr"
+              f"{'y' if len(baseline) == 1 else 'ies'} to {bl_path}")
+    new, stale = apply_baseline(findings, baseline)
+    dt = time.monotonic() - t0
+
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "elapsed_s": round(dt, 3),
+            "findings": [f.as_dict() for f in findings],
+            "new": [f.fingerprint for f in new],
+            "stale_baseline": stale,
+            "errors": errors,
+        }, indent=2))
+    else:
+        shown = findings if args.show_suppressed else new
+        for f in shown:
+            print(f.render())
+        for e in errors:
+            print(f"ERROR {e}")
+        for e in stale:
+            print(f"STALE-BASELINE {e['rule']}@{e['path']} "
+                  f"({e['fingerprint']}): finding no longer fires — "
+                  f"drop the entry (note: {e['note']})")
+        n_sup = sum(f.suppressed for f in findings)
+        n_bl = sum(f.baselined for f in findings)
+        print(f"tpulint: {len(findings)} finding"
+              f"{'' if len(findings) == 1 else 's'} "
+              f"({len(new)} new, {n_bl} baselined, {n_sup} suppressed"
+              f"), {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}, "
+              f"{len(errors)} errors in {dt:.2f}s")
+    if errors or new or stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,404 @@
+"""tpulint core: findings, rule registry, suppressions, baseline.
+
+The framework is deliberately jax-free and import-light: every AST rule
+works on parsed source only, so the full `paddle_tpu/` sweep stays
+sub-second (a hung pod or a 13s GSPMD recompile is the alternative
+detector for these bug classes — see ISSUE 7).
+
+Vocabulary:
+
+* **AST rule** — subclass of :class:`Rule`; gets one
+  :class:`ModuleSource` per analyzed file and yields
+  :class:`Finding`s.
+* **Project rule** — subclass of :class:`ProjectRule`; runs once per
+  invocation over the whole path set (the env-knob documentation check,
+  the alias-parity linter).
+* **Suppression** — ``# tpulint: disable=<rule>[,<rule>...]`` trailing
+  the finding line or on the line directly above.  ``disable=all``
+  silences every rule for that line.
+* **Baseline** — a checked-in JSON file of fingerprints for
+  pre-existing findings; the gate fails only on findings NOT in the
+  baseline.  Every baseline entry must carry a non-empty ``note``
+  explaining why it is parked (no silent baseline entries).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Iterable, List, Optional
+
+
+# --------------------------------------------------------------------------
+# findings
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    fingerprint: str = ""
+    suppressed: bool = False
+    baselined: bool = False
+
+    def as_dict(self):
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message,
+            "fingerprint": self.fingerprint,
+            "suppressed": self.suppressed, "baselined": self.baselined,
+        }
+
+    def render(self):
+        tags = []
+        if self.suppressed:
+            tags.append("suppressed")
+        if self.baselined:
+            tags.append("baselined")
+        tag = f" [{','.join(tags)}]" if tags else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}: {self.message}{tag}")
+
+
+# --------------------------------------------------------------------------
+# per-file source container
+# --------------------------------------------------------------------------
+
+# rule names terminate at the first non-name token, so a trailing
+# free-text reason ("disable=rule - because ...") never swallows into
+# the name; commas separate multiple rules
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+)
+
+
+def _parse_suppressions(line_text: str) -> set:
+    m = _SUPPRESS_RE.search(line_text)
+    if not m:
+        return set()
+    return {s.strip() for s in m.group(1).split(",") if s.strip()}
+
+
+def suppressed_at(lines: List[str], rule: str, line: int) -> bool:
+    """True if `rule` is disabled at `line`: a trailing
+    ``# tpulint: disable=`` on the line itself, or on a comment-only
+    line directly above (a code line above belongs to its own finding).
+    """
+    for at in (line, line - 1):
+        if not (1 <= at <= len(lines)):
+            continue
+        names = _parse_suppressions(lines[at - 1])
+        if not names or not ("all" in names or rule in names):
+            continue
+        if at == line - 1 and not lines[at - 1].strip().startswith("#"):
+            continue
+        return True
+    return False
+
+
+class ModuleSource:
+    """One parsed file: source text, AST, and the suppression map."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self._graph = None
+
+    def graph(self):
+        """Memoized ModuleGraph — every AST rule shares one build."""
+        if self._graph is None:
+            from .astutil import ModuleGraph
+
+            self._graph = ModuleGraph(self.tree)
+        return self._graph
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        return suppressed_at(self.lines, rule, line)
+
+    def line_src(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+# --------------------------------------------------------------------------
+# rule registry
+# --------------------------------------------------------------------------
+
+
+class Rule:
+    """AST rule: ``check(mod)`` yields Findings for one file."""
+
+    name: str = ""
+    summary: str = ""
+
+    def check(self, mod: ModuleSource) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleSource, node, message: str) -> Finding:
+        return Finding(
+            rule=self.name, path=mod.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+class ProjectRule:
+    """Whole-invocation rule: ``check_project(paths, repo_root)``."""
+
+    name: str = ""
+    summary: str = ""
+    default_enabled: bool = True
+
+    def check_project(self, paths: List[str],
+                      repo_root: str) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+REGISTRY: dict[str, object] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate and register a rule by name."""
+    inst = rule_cls()
+    if not inst.name:
+        raise ValueError(f"rule {rule_cls.__name__} has no name")
+    if inst.name in REGISTRY:
+        raise ValueError(f"duplicate rule name {inst.name!r}")
+    REGISTRY[inst.name] = inst
+    return rule_cls
+
+
+def ast_rules():
+    return [r for r in REGISTRY.values() if isinstance(r, Rule)]
+
+
+def project_rules():
+    return [r for r in REGISTRY.values() if isinstance(r, ProjectRule)]
+
+
+# --------------------------------------------------------------------------
+# fingerprints + baseline
+# --------------------------------------------------------------------------
+
+
+def _normalized_line(mod_lines: List[str], line: int) -> str:
+    if 1 <= line <= len(mod_lines):
+        return re.sub(r"\s+", " ", mod_lines[line - 1].strip())
+    return ""
+
+
+def fingerprint_findings(findings: List[Finding],
+                         sources: dict) -> None:
+    """Stable fingerprints: rule + path + normalized source line +
+    occurrence index among identical lines — insensitive to unrelated
+    line insertions above the finding."""
+    seen: dict[tuple, int] = {}
+    for f in findings:
+        lines = sources.get(f.path)
+        norm = _normalized_line(lines, f.line) if lines else ""
+        key = (f.rule, f.path, norm)
+        k = seen.get(key, 0)
+        seen[key] = k + 1
+        h = hashlib.sha1(
+            f"{f.rule}:{f.path}:{norm}:{k}".encode()
+        ).hexdigest()[:12]
+        f.fingerprint = h
+
+
+class BaselineError(RuntimeError):
+    pass
+
+
+def load_baseline(path: str) -> dict:
+    """fingerprint -> entry dict.  Every entry must carry a non-empty
+    note (the tracking comment) — silent baseline entries are an error,
+    not a workflow."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    entries = data.get("entries", [])
+    silent = [e for e in entries if not str(e.get("note", "")).strip()]
+    if silent:
+        names = ", ".join(
+            f"{e.get('rule')}@{e.get('path')}:{e.get('fingerprint')}"
+            for e in silent
+        )
+        raise BaselineError(
+            f"baseline {path} has {len(silent)} entr"
+            f"{'y' if len(silent) == 1 else 'ies'} without a tracking "
+            f"note ({names}) — every parked finding needs one"
+        )
+    return {e["fingerprint"]: e for e in entries}
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   old: Optional[dict] = None,
+                   swept_paths: Optional[set] = None) -> dict:
+    """Write non-suppressed findings as the new baseline.  Notes of
+    surviving entries are preserved; NEW entries get a loud
+    ``TODO(triage)`` placeholder that load_baseline will accept but the
+    author is expected to replace with a real tracking comment.
+
+    With ``swept_paths`` (the repo-relative files this run actually
+    analyzed), old entries for files OUTSIDE the sweep are carried over
+    verbatim — a path-subset run must not silently drop (and lose the
+    notes of) every other file's parked findings.  Entries for swept
+    files are regenerated, so stale ones still drop."""
+    old = old or {}
+    merged: dict[str, dict] = {}
+    if swept_paths is not None:
+        for fp, e in old.items():
+            if e.get("path") not in swept_paths:
+                merged[fp] = dict(e)
+    for f in findings:
+        if f.suppressed:
+            continue
+        prev = old.get(f.fingerprint, {})
+        note = str(prev.get("note", "")).strip() or (
+            "TODO(triage): parked by --write-baseline, replace with a "
+            "tracking comment"
+        )
+        merged[f.fingerprint] = {
+            "rule": f.rule, "path": f.path, "line_hint": f.line,
+            "fingerprint": f.fingerprint, "note": note,
+        }
+    entries = sorted(merged.values(),
+                     key=lambda e: (e["path"], e["rule"],
+                                    e["line_hint"]))
+    data = {"version": 1, "entries": entries}
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return {e["fingerprint"]: e for e in entries}
+
+
+# --------------------------------------------------------------------------
+# runner
+# --------------------------------------------------------------------------
+
+
+def collect_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(root, fn))
+    return out
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    env = os.environ.get("PADDLE_LINT_BASELINE", "").strip()
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def disabled_rules() -> set:
+    env = os.environ.get("PADDLE_LINT_DISABLE", "").strip()
+    return {s.strip() for s in env.split(",") if s.strip()}
+
+
+def run(paths: List[str], *, rules: Optional[set] = None,
+        enable_project: bool = True,
+        enable_alias: bool = False,
+        root: Optional[str] = None):
+    """Run every registered rule over `paths`.
+
+    Returns ``(findings, errors)``: findings carry fingerprints but no
+    baseline marks (the CLI applies those); errors are per-file parse
+    failures rendered as strings.
+    """
+    root = root or repo_root()
+    skip = disabled_rules()
+    findings: List[Finding] = []
+    errors: List[str] = []
+    sources: dict[str, list] = {}
+    mods: List[ModuleSource] = []
+    for fp in collect_files(paths):
+        rel = os.path.relpath(os.path.abspath(fp), root)
+        try:
+            with open(fp, encoding="utf-8") as fh:
+                text = fh.read()
+            mod = ModuleSource(fp, rel, text)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{rel}: parse error: {e}")
+            continue
+        mods.append(mod)
+        sources[mod.relpath] = mod.lines
+    for rule in ast_rules():
+        if rule.name in skip or (rules is not None
+                                 and rule.name not in rules):
+            continue
+        for mod in mods:
+            try:
+                for f in rule.check(mod):
+                    f.suppressed = mod.is_suppressed(rule.name, f.line)
+                    findings.append(f)
+            except RecursionError:  # pathological nesting: skip file
+                errors.append(
+                    f"{mod.relpath}: {rule.name}: recursion limit"
+                )
+    if enable_project:
+        for rule in project_rules():
+            if rule.name in skip or (rules is not None
+                                     and rule.name not in rules):
+                continue
+            if not rule.default_enabled and not enable_alias:
+                continue
+            for f in rule.check_project(paths, root):
+                lines = sources.get(f.path)
+                if lines is None and f.path:
+                    ap = os.path.join(root, f.path)
+                    if os.path.exists(ap):
+                        try:
+                            with open(ap, encoding="utf-8") as fh:
+                                lines = fh.read().splitlines()
+                        except OSError:
+                            lines = []
+                        sources[f.path] = lines
+                if lines:
+                    f.suppressed = suppressed_at(lines, rule.name,
+                                                 f.line)
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    fingerprint_findings(findings, sources)
+    return findings, errors
+
+
+def apply_baseline(findings: List[Finding], baseline: dict):
+    """Mark baselined findings; return (new, stale_entries)."""
+    seen = set()
+    new = []
+    for f in findings:
+        if f.fingerprint in baseline:
+            f.baselined = True
+            seen.add(f.fingerprint)
+        elif not f.suppressed:
+            new.append(f)
+    stale = [e for fp, e in sorted(baseline.items()) if fp not in seen]
+    return new, stale

@@ -1,0 +1,15 @@
+"""tpulint rule set — importing this package registers every rule.
+
+Each module encodes ONE bug class this repo has actually shipped a fix
+for; the rule docstrings name the PR-history exemplar.
+"""
+from . import (  # noqa: F401  (import-for-registration)
+    pallas_in_gspmd,
+    host_sync,
+    donation,
+    collectives,
+    numpy_tracer,
+    shard_vjp,
+    env_knobs,
+    alias_parity,
+)

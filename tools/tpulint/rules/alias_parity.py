@@ -1,0 +1,48 @@
+"""alias-parity: the `import paddle` namespace-parity linter
+(tools/check_alias.py), folded into the tpulint entry point.
+
+Unlike every other rule this one IMPORTS the package under lint (it has
+to resolve names), which pulls in jax — seconds, not milliseconds.  It
+is therefore off by default and enabled with ``--alias`` (or
+``PADDLE_LINT_ALIAS=1``); test_hygiene runs it through its own
+TestAliasParity gate either way, so the coverage is tier-1 regardless.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+from ..core import Finding, ProjectRule, register
+
+
+def _load_check_alias(repo_root):
+    path = os.path.join(repo_root, "tools", "check_alias.py")
+    spec = importlib.util.spec_from_file_location("check_alias", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@register
+class AliasParityRule(ProjectRule):
+    name = "alias-parity"
+    summary = ("reference names missing from the paddle alias / stale "
+               "scope entries / unaliased paddle_tpu exports")
+    default_enabled = False  # imports paddle_tpu+jax: --alias opts in
+
+    def check_project(self, paths, repo_root):
+        ca = _load_check_alias(repo_root)
+        rows, missing, stale = ca.check_reference_coverage()
+        unaliased = ca.check_alias_completeness()
+        path = "tools/check_alias.py"
+        for n in missing:
+            yield Finding(rule=self.name, path=path, line=1, col=0,
+                          message=f"aliased-but-missing reference "
+                                  f"name: {n}")
+        for n in stale:
+            yield Finding(rule=self.name, path=path, line=1, col=0,
+                          message=f"stale out-of-scope entry: {n}")
+        for n in unaliased:
+            yield Finding(rule=self.name, path=path, line=1, col=0,
+                          message=f"paddle_tpu public name with no "
+                                  f"paddle alias: {n}")

@@ -1,0 +1,163 @@
+"""divergent-collective: a collective call site under rank-dependent
+(or traced-data-dependent) control flow.
+
+PR-history exemplar (PR 2): rank-divergent collective call sites are
+what the comm-monitor's flight recorder + desync detection exist to
+diagnose — AFTER the pod has already hung (one rank enters the
+collective, its peers took the other branch).  The static form moves
+that detection before dispatch: a call to any monitored collective
+lexically nested under an `if`/`while` whose test reads the process
+rank diverges by construction unless every rank takes the same branch.
+
+The op list is cross-checked against the comm-monitor site list
+(`distributed/collective.py` wraps exactly these in `_watched` /
+`_record_spmd`) by `monitored_ops()` + the test suite, so the rule and
+the runtime monitor cannot drift.  `jax.lax` SPMD collectives under
+TRACED-value conditionals are the in-graph variant of the same hazard
+(each shard resolves the branch independently).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..astutil import Taint, dotted, terminal
+from ..core import Rule, register
+
+# the eager/SPMD comm surface (comm-monitor site list) ...
+COLLECTIVES = {
+    "all_reduce", "reduce", "all_gather", "broadcast", "reduce_scatter",
+    "scatter", "alltoall", "barrier", "monitored_barrier",
+}
+# ... plus point-to-point and the lax SPMD primitives
+P2P = {"send", "recv", "isend", "irecv"}
+LAX_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "ppermute",
+                   "psum_scatter"}
+
+_RANK_RE = re.compile(
+    r"\b(?:get_rank|local_rank|trainer_id|process_index|"
+    r"PADDLE_TRAINER_ID|rank)\b"
+)
+
+
+def monitored_ops(repo_root: str = None):
+    """Op names the runtime comm monitor records — parsed from
+    distributed/collective.py so the static rule's site list cannot
+    drift from the runtime one."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    path = os.path.join(repo_root, "paddle_tpu", "distributed",
+                        "collective.py")
+    ops = set()
+    if os.path.exists(path):
+        with open(path) as fh:
+            src = fh.read()
+        ops |= set(re.findall(r'_watched\(\s*"(\w+)"', src))
+        ops |= set(re.findall(r'_record_spmd\(\s*"(\w+)"', src))
+    return ops
+
+
+def _test_src(test: ast.expr) -> str:
+    try:
+        return ast.unparse(test)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _rank_dependent(test: ast.expr) -> bool:
+    return bool(_RANK_RE.search(_test_src(test)))
+
+
+def _jnp_comparison(test: ast.expr, jnp_names) -> bool:
+    """A test that compares/reads values assigned from jnp/lax results
+    — each shard of an SPMD program resolves it independently."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in jnp_names:
+            return True
+        if isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d.startswith(("jnp.", "lax.", "jax.lax.")):
+                return True
+    return False
+
+
+@register
+class DivergentCollectiveRule(Rule):
+    name = "divergent-collective"
+    summary = ("collective call under rank-dependent or traced-data-"
+               "dependent control flow")
+
+    def check(self, mod):
+        graph = mod.graph()
+        parents = graph.parents
+        compiled_keys = graph.compiled
+        # names assigned from jnp per owning function (for the traced-
+        # branch variant); the Taint fixpoint is O(function body), so
+        # memoize per owner instead of rebuilding per collective call
+        jnp_memo: dict = {}
+
+        def owner_jnp_names(owner):
+            if owner in jnp_memo:
+                return jnp_memo[owner]
+            names = set()
+            key = None
+            for (cname, fname), info in graph.funcs.items():
+                if info.node is owner:
+                    key = (cname, fname)
+            if key in compiled_keys:
+                taint = Taint(owner)
+                for n in ast.walk(owner):
+                    if isinstance(n, ast.Assign) and \
+                            taint.expr_tainted(n.value):
+                        for tgt in n.targets:
+                            for nn in ast.walk(tgt):
+                                if isinstance(nn, ast.Name):
+                                    names.add(nn.id)
+            jnp_memo[owner] = names
+            return names
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = terminal(dotted(node.func))
+            is_comm = t in COLLECTIVES or t in P2P
+            is_lax = t in LAX_COLLECTIVES
+            if not (is_comm or is_lax):
+                continue
+            owner = graph.owner_func(node)
+            jnp_names = set()
+            if is_lax and owner is not None:
+                jnp_names = owner_jnp_names(owner)
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                test = None
+                if isinstance(cur, (ast.If, ast.While, ast.IfExp)):
+                    test = cur.test
+                if test is not None:
+                    if _rank_dependent(test):
+                        yield self.finding(
+                            mod, node,
+                            f"collective `{t}` under rank-dependent "
+                            f"control flow (`if {_test_src(test)}`) — "
+                            "ranks taking different branches deadlock "
+                            "in the collective (the comm monitor can "
+                            "only attribute this AFTER the hang); "
+                            "hoist the collective out of the branch",
+                        )
+                        break
+                    if is_lax and jnp_names and _jnp_comparison(
+                            test, jnp_names):
+                        yield self.finding(
+                            mod, node,
+                            f"lax collective `{t}` under traced-data-"
+                            f"dependent control flow "
+                            f"(`if {_test_src(test)}`) — shards "
+                            "resolve the branch independently; use "
+                            "jnp.where / lax.cond over the collective "
+                            "result instead",
+                        )
+                        break
+                cur = parents.get(cur)

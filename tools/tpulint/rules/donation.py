@@ -1,0 +1,208 @@
+"""donation-alias: reads of a buffer after it was donated, and donation
+of carries a host monitor still reads.
+
+PR-history exemplar (PR 5, the guard-carry rule): the guard-policy
+counters ride the compiled step as a small carry that the HOST monitor
+reads through a deferred async prefetch — donating that carry
+invalidates the buffer the moment it is re-passed, racing the in-flight
+read (`train_step.py` documents why the carry is excluded from
+`donate_argnums`).  The sibling hazard is the plain read-after-donate:
+touching an array after passing it in a donated position is a
+use-after-free on the device buffer.
+
+Statically: resolve `donate_argnums` on `jax.jit(...)` calls (literal
+tuples, simple local rebinds, conditional unions); map donated positions
+to the jitted callable's parameter names; flag
+
+* donated parameters whose names mark them as host-monitored carries
+  (`*guard*`, `*monitor*`) — the encoded PR 5 rule;
+* at call sites of the jitted binding, loads of a donated argument
+  name after the call statement (without an intervening rebind).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from ..astutil import dotted, enclosing, terminal
+from ..core import Rule, register
+
+_CARRY_HINTS = ("guard", "monitor")
+
+
+def _const_ints(node) -> Optional[Set[int]]:
+    """Literal donate_argnums value -> set of indices (None if not)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for el in node.elts:
+            s = _const_ints(el)
+            if s is None:
+                return None
+            out |= s
+        return out
+    return None
+
+
+def _resolve_argnums(expr, func: Optional[ast.FunctionDef],
+                     _seen: Optional[Set[str]] = None) -> Optional[Set[int]]:
+    """Resolve a donate_argnums expression to the UNION of indices it
+    can take: literals, `a if c else b`, `name` rebound from literals,
+    `name + (lit,)` growth (self-referential rebinds contribute their
+    other operand).  None = unresolvable (rule stays quiet)."""
+    _seen = _seen if _seen is not None else set()
+    s = _const_ints(expr)
+    if s is not None:
+        return s
+    if isinstance(expr, ast.IfExp):
+        a = _resolve_argnums(expr.body, func, _seen)
+        b = _resolve_argnums(expr.orelse, func, _seen)
+        if a is None or b is None:
+            return None
+        return a | b
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        a = _resolve_argnums(expr.left, func, _seen)
+        b = _resolve_argnums(expr.right, func, _seen)
+        if a is None or b is None:
+            return None
+        return a | b
+    if isinstance(expr, ast.Name) and func is not None:
+        if expr.id in _seen:
+            # cycle (`donate = donate + (6,)`): the recursive operand
+            # adds nothing beyond its other assignments
+            return set()
+        _seen = _seen | {expr.id}
+        out: Set[int] = set()
+        found = False
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                        v = _resolve_argnums(node.value, func, _seen)
+                        if v is None:
+                            return None
+                        out |= v
+                        found = True
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name) and node.target.id == expr.id:
+                v = _resolve_argnums(node.value, func, _seen)
+                if v is None:
+                    return None
+                out |= v
+                found = True
+        return out if found else None
+    return None
+
+
+def _param_names(fn: ast.FunctionDef):
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+@register
+class DonationAliasRule(Rule):
+    name = "donation-alias"
+    summary = ("buffer read after donation, or donation of a "
+               "host-monitored carry")
+
+    def check(self, mod):
+        if "donate_argnums" not in mod.text:
+            return
+        graph = mod.graph()
+        parents = graph.parents
+        # binding (dotted target or local name) -> donated index set
+        donated_bindings: dict[str, Set[int]] = {}
+
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and terminal(
+                    dotted(node.func)) in ("jit", "pjit")):
+                continue
+            dn = None
+            for kw in node.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames"):
+                    dn = kw
+            if dn is None or dn.arg == "donate_argnames":
+                continue
+            owner = graph.owner_func(node)
+            idxs = _resolve_argnums(dn.value, owner)
+            if not idxs:
+                continue
+
+            # --- carry-donation check on the jitted callable's params
+            ctx_cls = None
+            if owner is not None:
+                cls = enclosing(owner, parents, (ast.ClassDef,))
+                ctx_cls = cls.name if cls else None
+            target = None
+            if node.args:
+                if isinstance(node.args[0], ast.Lambda):
+                    names = [a.arg for a in node.args[0].args.args]
+                    target = None
+                else:
+                    target = graph.resolve(dotted(node.args[0]), ctx_cls)
+                    names = _param_names(target.node) if target else []
+            else:
+                names = []
+            for i in sorted(idxs):
+                if i < len(names) and any(
+                        h in names[i].lower() for h in _CARRY_HINTS):
+                    yield self.finding(
+                        mod, node,
+                        f"donate_argnums includes position {i} "
+                        f"(`{names[i]}`) — a host-monitored carry must "
+                        "NOT be donated: the monitor's deferred async "
+                        "read outlives the next dispatch and donation "
+                        "invalidates the buffer it is still reading "
+                        "(PR-5 guard-carry rule)",
+                    )
+
+            # --- read-after-donate at call sites of the binding
+            asn = enclosing(node, parents, (ast.Assign,))
+            if asn is None or asn.value is not node:
+                continue
+            for tgt in asn.targets:
+                d = dotted(tgt)
+                if d:
+                    donated_bindings[d] = idxs
+
+        for binding, idxs in donated_bindings.items():
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and dotted(node.func) == binding):
+                    continue
+                owner = graph.owner_func(node)
+                if owner is None:
+                    continue
+                stmt = enclosing(node, parents, (ast.stmt,))
+                end = getattr(stmt, "end_lineno", node.lineno)
+                for i in sorted(idxs):
+                    if i >= len(node.args):
+                        continue
+                    arg = node.args[i]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    # ast.walk is breadth-first, NOT source order — a
+                    # shallow late rebind must not shadow a deeper
+                    # earlier read, so sort by position first
+                    uses = sorted(
+                        (n for n in ast.walk(owner)
+                         if isinstance(n, ast.Name) and n.id == arg.id
+                         and n.lineno > end),
+                        key=lambda n: (n.lineno, n.col_offset),
+                    )
+                    for later in uses:
+                        if isinstance(later.ctx, ast.Store):
+                            break  # rebound: later reads are fresh
+                        yield self.finding(
+                            mod, later,
+                            f"`{arg.id}` is read after being "
+                            f"donated (position {i} of "
+                            f"`{binding}` at line {node.lineno}) "
+                            "— donation hands the buffer to XLA; "
+                            "this read races the in-place update",
+                        )
+                        break

@@ -1,0 +1,76 @@
+"""env-knob-docs: every `PADDLE_*` env knob the tree mentions must be
+documented in README.md.
+
+Migrated from test_hygiene.TestEnvKnobDocs (the ad-hoc check ISSUE 7
+folds into the one static-analysis entry point): undocumented knobs rot
+into magic the next operator can't discover.  The scan covers the
+`paddle_tpu/` package tree PLUS `tools/` (so the linter's own
+`PADDLE_LINT_*` knobs are policed too) and any analyzed paths outside
+those trees.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+from ..core import Finding, ProjectRule, register
+
+_KNOB_RE = re.compile(r"PADDLE_[A-Z0-9_]+")
+
+
+@register
+class EnvKnobDocsRule(ProjectRule):
+    name = "env-knob-docs"
+    summary = "PADDLE_* env knob referenced but not documented in README"
+
+    def _scan_roots(self, paths, repo_root):
+        roots = [os.path.join(repo_root, "paddle_tpu"),
+                 os.path.join(repo_root, "tools")]
+        for p in paths:
+            ap = os.path.abspath(p)
+            if not any(ap.startswith(os.path.abspath(r))
+                       for r in roots):
+                roots.append(ap)
+        return roots
+
+    def check_project(self, paths, repo_root):
+        readme_path = os.path.join(repo_root, "README.md")
+        try:
+            with open(readme_path, encoding="utf-8") as fh:
+                readme = fh.read()
+        except OSError:
+            yield Finding(rule=self.name, path="README.md", line=1,
+                          col=0, message="README.md is unreadable — "
+                          "knob documentation cannot be checked")
+            return
+        first_ref: dict[str, tuple] = {}
+        for root in self._scan_roots(paths, repo_root):
+            if os.path.isfile(root):
+                files = [root] if root.endswith(".py") else []
+            else:
+                files = []
+                for r, dirs, fns in os.walk(root):
+                    dirs[:] = [d for d in dirs
+                               if d not in ("__pycache__", ".git")]
+                    files += [os.path.join(r, fn) for fn in sorted(fns)
+                              if fn.endswith(".py")]
+            for fp in files:
+                try:
+                    with open(fp, encoding="utf-8") as fh:
+                        text = fh.read()
+                except OSError:
+                    continue
+                rel = os.path.relpath(fp, repo_root).replace(
+                    os.sep, "/")
+                for i, ln in enumerate(text.splitlines(), start=1):
+                    for knob in _KNOB_RE.findall(ln):
+                        first_ref.setdefault(knob, (rel, i))
+        for knob in sorted(first_ref):
+            if knob not in readme:
+                rel, line = first_ref[knob]
+                yield Finding(
+                    rule=self.name, path=rel, line=line, col=0,
+                    message=f"env knob {knob} is referenced here but "
+                            "not documented in README.md — add a row "
+                            "to the knob tables",
+                )

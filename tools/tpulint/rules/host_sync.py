@@ -1,0 +1,89 @@
+"""host-sync-in-step: a host synchronization inside a compiled step body.
+
+PR-history exemplars: the pre-round-4 fp16 scaler did a PER-PARAM host
+finite check inside the step (one device round-trip per parameter per
+step); the round-5 guard work moved every policy read to an interval-
+synced async prefetch precisely because an `.item()` / `np.asarray` /
+`print` / `device_get` on a traced value either fails under trace or —
+worse, on concrete values — silently serializes the pipeline.
+
+Statically: inside compiled-region functions (anything reachable from a
+`jax.jit` / trace-wrapper reference, plus `_step_fn`/`_worker` bodies of
+`*Step` classes), flag
+
+* ``print(...)`` — always (tracer reprs at best, a device sync at worst)
+* ``.item()`` / ``.numpy()`` / ``.tolist()`` method calls
+* ``jax.device_get(...)``
+* ``np.asarray(x)`` / ``np.array(x)`` with a traced argument
+* ``float(x)`` / ``int(x)`` / ``bool(x)`` with a traced argument
+  (``int(x.shape[i])`` is static under trace and stays quiet)
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import Taint, dotted, terminal
+from ..core import Rule, register
+
+_METHOD_SYNCS = {"item", "numpy", "tolist"}
+_CAST_SYNCS = {"float", "int", "bool"}
+
+
+@register
+class HostSyncInStepRule(Rule):
+    name = "host-sync-in-step"
+    summary = ("host synchronization (.item()/print/np.asarray/"
+               "device_get/float) inside a compiled step body")
+
+    def check(self, mod):
+        graph = mod.graph()
+        for info in graph.compiled_funcs():
+            func = info.node
+            taint = Taint(func)
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if graph.owner_func(node) is not func:
+                    continue  # belongs to a nested def (visited itself)
+                d = dotted(node.func)
+                t = terminal(d)
+                where = f"in compiled step body `{func.name}`"
+                if t == "print" and d == "print":
+                    yield self.finding(
+                        mod, node,
+                        f"print() {where} — runs at trace time (or "
+                        "syncs the device); use host-side logging on "
+                        "the step result or jax.debug.print",
+                    )
+                elif isinstance(node.func, ast.Attribute) and \
+                        t in _METHOD_SYNCS and not node.args:
+                    yield self.finding(
+                        mod, node,
+                        f".{t}() {where} — a device round-trip per "
+                        "step; read the value from the step's RETURNED "
+                        "arrays on the host instead",
+                    )
+                elif t == "device_get" and d.split(".")[0] in (
+                        "jax", "device_get"):
+                    yield self.finding(
+                        mod, node,
+                        f"jax.device_get {where} — host sync; return "
+                        "the value and read it outside the step",
+                    )
+                elif d in ("np.asarray", "np.array", "numpy.asarray",
+                           "numpy.array") and taint.call_arg_tainted(
+                               node):
+                    yield self.finding(
+                        mod, node,
+                        f"{d} on a traced value {where} — forces the "
+                        "tracer to a concrete host array; use jnp or "
+                        "move the read outside the compiled region",
+                    )
+                elif t in _CAST_SYNCS and d == t and node.args \
+                        and taint.call_arg_tainted(node):
+                    yield self.finding(
+                        mod, node,
+                        f"{t}() on a traced value {where} — a host "
+                        "sync under concrete execution and a trace "
+                        "error under jit; keep it an array",
+                    )

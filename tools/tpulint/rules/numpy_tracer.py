@@ -1,0 +1,66 @@
+"""numpy-on-tracer: host `np.*` math applied to traced values inside a
+compiled region.
+
+PR-history exemplar: the fluid-era reference scripts (and early ports of
+their op implementations) mix `np.sqrt`/`np.mean` into model math; under
+`jit.TrainStep` tracing that either raises a TracerArrayConversionError
+or — when the value happens to be concrete — constant-folds a stale
+value into the compiled program (the bug class behind the verbatim-
+script harness's jnp conversions).
+
+Statically: inside compiled-region functions, flag `np.<math>(x)` calls
+whose arguments dataflow from traced values (parameters, jnp results).
+`np.float32` / `np.pi` / shape reads stay quiet.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import Taint, dotted
+from ..core import Rule, register
+
+_NP_MATH = {
+    "exp", "log", "log2", "log10", "sqrt", "square", "power", "abs",
+    "sum", "mean", "var", "std", "prod", "max", "min", "argmax",
+    "argmin", "dot", "matmul", "einsum", "tanh", "sin", "cos", "sign",
+    "maximum", "minimum", "where", "clip", "floor", "ceil", "round",
+    "cumsum", "cumprod", "reshape", "transpose", "concatenate", "stack",
+    "split", "linalg", "add", "subtract", "multiply", "divide",
+    "true_divide", "isnan", "isinf", "isfinite", "allclose",
+    "array_equal",
+}
+
+
+@register
+class NumpyOnTracerRule(Rule):
+    name = "numpy-on-tracer"
+    summary = "np.* math applied to traced values inside a compiled region"
+
+    def check(self, mod):
+        if "np." not in mod.text and "numpy" not in mod.text:
+            return
+        graph = mod.graph()
+        for info in graph.compiled_funcs():
+            func = info.node
+            taint = Taint(func)
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if graph.owner_func(node) is not func:
+                    continue
+                d = dotted(node.func)
+                parts = d.split(".")
+                if len(parts) < 2 or parts[0] not in ("np", "numpy"):
+                    continue
+                if parts[-1] not in _NP_MATH:
+                    continue
+                if not taint.call_arg_tainted(node):
+                    continue
+                yield self.finding(
+                    mod, node,
+                    f"{d} on a traced value in compiled body "
+                    f"`{func.name}` — host numpy cannot consume "
+                    "tracers (TracerArrayConversionError under jit, "
+                    "or a stale constant folded into the program); "
+                    "use jnp." + parts[-1],
+                )

@@ -1,0 +1,165 @@
+"""pallas-in-gspmd: a `pallas_call` reachable from a jit region with no
+shard_map seam or mesh-routing guard.
+
+PR-history exemplar (ISSUE 6 tentpole): the round-6 attention router
+dispatched the Pallas flash kernel straight into multi-device programs —
+a `pallas_call` has no GSPMD partition rule, so the program either died
+with an opaque XLA partitioning error or fell back to dense everywhere.
+The shipped fix routes every kernel dispatch through a mesh-routing
+decision (`_shard_plan` / `shard_factoring` / device-count guards) and
+runs the multi-device case through the `shard_map` seam
+(ops/pallas/sharded.py).
+
+Statically: within a module, find functions whose bodies call
+`pl.pallas_call`; walk the local call graph from every jit/trace root;
+flag kernel call sites reached WITHOUT crossing a shard_map boundary
+and WITHOUT a mesh guard (an `if` testing device_count / mesh /
+shard-plan / routability) on the path or around the call site.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import (
+    JIT_WRAPPERS, dotted, enclosing, is_wrapper_call, terminal,
+)
+from ..core import Rule, register
+
+# substrings that make an `if` test a mesh-routing guard
+_GUARD_HINTS = (
+    "device_count", "devices(", "mesh", "shard_plan", "shard_factoring",
+    "routable", "flash_plan", "partitioning_axes", "interpret",
+    "backend", "plan",
+)
+
+
+def _is_mesh_guard(test: ast.expr) -> bool:
+    try:
+        src = ast.unparse(test)
+    except Exception:  # pragma: no cover
+        return False
+    low = src.lower()
+    return any(h in low for h in _GUARD_HINTS)
+
+
+def _has_mesh_guard(func: ast.FunctionDef) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, (ast.If, ast.IfExp)) and _is_mesh_guard(
+                node.test):
+            return True
+    return False
+
+
+def _guarded_at(node, parents) -> bool:
+    cur = parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if isinstance(cur, (ast.If, ast.IfExp)) and _is_mesh_guard(
+                cur.test):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class PallasInGspmdRule(Rule):
+    name = "pallas-in-gspmd"
+    summary = ("pallas_call reachable from a jit region without a "
+               "shard_map seam or mesh-routing guard")
+
+    def check(self, mod):
+        if "pallas_call" not in mod.text:
+            return
+        graph = mod.graph()
+        parents = graph.parents
+
+        # functions containing a direct pallas_call, with their call
+        # sites (skip sites lexically under a mesh guard)
+        kernel_sites = {}
+        for key, info in graph.funcs.items():
+            sites = []
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call) and terminal(
+                        dotted(node.func)) == "pallas_call":
+                    if graph.owner_func(node) is not info.node:
+                        continue
+                    if not _guarded_at(node, parents):
+                        sites.append(node)
+            if sites:
+                kernel_sites[key] = sites
+        if not kernel_sites:
+            return
+
+        # jit roots only (a trace wrapper like value_and_grad does not
+        # by itself make a GSPMD program; jit does)
+        roots = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and is_wrapper_call(
+                    node, JIT_WRAPPERS):
+                for key in graph._callable_refs(
+                        node.args[0] if node.args else None, node):
+                    roots.add(key)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    if terminal(dotted(d)) in JIT_WRAPPERS:
+                        cls = enclosing(node, parents, (ast.ClassDef,))
+                        roots.add((cls.name if cls else None, node.name))
+        roots = {k for k in roots if k in graph.funcs}
+        if not roots:
+            return
+
+        # BFS with a 'sanitized' bit: crossing a shard_map boundary or
+        # a mesh-guarded reference site, or passing through a function
+        # that itself routes on the mesh, stops the hazard
+        reached_unguarded = set()
+        work = list(roots)
+        while work:
+            key = work.pop()
+            if key in reached_unguarded:
+                continue
+            reached_unguarded.add(key)
+            info = graph.funcs[key]
+            if _has_mesh_guard(info.node):
+                continue  # this function routes on the mesh: sanitized
+            for node in ast.walk(info.node):
+                if not (isinstance(node, (ast.Name, ast.Attribute))
+                        and isinstance(getattr(node, "ctx", None),
+                                       ast.Load)):
+                    continue
+                tgt = graph.resolve(dotted(node), info.class_name)
+                if tgt is None or graph.owner_func(node) is None:
+                    continue
+                # reference passed into a shard_map call: the target
+                # runs per shard — a pallas_call there is the FIX shape
+                call = enclosing(node, parents, (ast.Call,))
+                crossed_seam = False
+                cur = call
+                while cur is not None:
+                    if isinstance(cur, ast.Call) and is_wrapper_call(
+                            cur, {"shard_map"}):
+                        crossed_seam = True
+                        break
+                    cur = enclosing(cur, parents, (ast.Call,))
+                if crossed_seam or _guarded_at(node, parents):
+                    continue
+                if tgt.key not in reached_unguarded:
+                    work.append(tgt.key)
+
+        for key, sites in sorted(kernel_sites.items(),
+                                 key=lambda kv: (kv[0][0] or "",
+                                                 kv[0][1])):
+            if key not in reached_unguarded:
+                continue
+            info = graph.funcs[key]
+            if _has_mesh_guard(info.node):
+                continue
+            for site in sites:
+                yield self.finding(
+                    mod, site,
+                    f"pallas_call in `{key[1]}` is reachable from a "
+                    "jit region with no shard_map seam or mesh-routing "
+                    "guard — a pallas_call has no GSPMD partition rule "
+                    "(route through ops/pallas/sharded.py or guard on "
+                    "the mesh)",
+                )

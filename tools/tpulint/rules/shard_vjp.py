@@ -1,0 +1,108 @@
+"""psum-in-shard-vjp: custom_vjp backward bodies under shard_map whose
+replicated (reduced-partial) outputs lack an explicit `lax.psum`.
+
+PR-history exemplar (ISSUE 6, the dgamma/dbeta class): the sharded
+fused-LayerNorm seam carries an outer custom_vjp; each shard's kernel
+emits per-row-block dgamma/dbeta PARTIALS, and the backward body must
+reduce them across shards with an explicit `lax.psum` over the row axes
+before declaring the output replicated (`out_specs=P()`).  Without the
+psum the program either trips shard_map's replication check or — with
+the check off — silently returns one shard's partial as the full
+gradient.
+
+Statically: for every `X.defvjp(fwd, bwd)`, walk the functions reachable
+from `bwd` (direct references and functools.partial targets).  If that
+set issues a `shard_map` call whose `out_specs` contain a bare
+replicated `P()` entry, a `psum` call must also be reachable; flag the
+backward otherwise.  Backwards whose outputs are all sharded (no `P()`
+in out_specs) have no cross-shard partials and stay quiet, as do
+custom_vjps with no shard_map at all (the single-chip kernels).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted, is_wrapper_call, terminal
+from ..core import Rule, register
+
+
+def _bare_pspec_in(expr) -> bool:
+    """Does `expr` (an out_specs value) contain a no-arg P() /
+    PartitionSpec() — i.e. a fully-replicated output?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and terminal(
+                dotted(node.func)) in ("P", "PartitionSpec"):
+            if not node.args and not node.keywords:
+                return True
+    return False
+
+
+def _reachable(graph, start_key):
+    seen = set()
+    work = [start_key]
+    while work:
+        key = work.pop()
+        if key in seen or key not in graph.funcs:
+            continue
+        seen.add(key)
+        info = graph.funcs[key]
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                tgt = graph.resolve(dotted(node), info.class_name)
+                if tgt is not None:
+                    work.append(tgt.key)
+    return seen
+
+
+@register
+class PsumInShardVjpRule(Rule):
+    name = "psum-in-shard-vjp"
+    summary = ("custom_vjp backward under shard_map with replicated "
+               "outputs but no explicit lax.psum")
+
+    def check(self, mod):
+        if "defvjp" not in mod.text:
+            return
+        graph = mod.graph()
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and terminal(
+                    dotted(node.func)) == "defvjp"):
+                continue
+            if len(node.args) < 2:
+                continue
+            bwd_ref = dotted(node.args[1])
+            bwd = graph.resolve(bwd_ref, None)
+            if bwd is None:
+                continue
+            reach = _reachable(graph, bwd.key)
+            needs_psum = False
+            has_psum = False
+            for key in reach:
+                info = graph.funcs[key]
+                for n in ast.walk(info.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    t = terminal(dotted(n.func))
+                    if t in ("psum", "psum_scatter", "all_gather"):
+                        has_psum = True
+                    if is_wrapper_call(n, {"shard_map"}):
+                        out_specs = None
+                        for kw in n.keywords:
+                            if kw.arg == "out_specs":
+                                out_specs = kw.value
+                        if out_specs is None and len(n.args) >= 4:
+                            out_specs = n.args[3]
+                        if out_specs is None or _bare_pspec_in(out_specs):
+                            # unresolvable out_specs: conservatively
+                            # treat as carrying a replicated partial
+                            needs_psum = True
+            if needs_psum and not has_psum:
+                yield self.finding(
+                    mod, bwd.node,
+                    f"custom_vjp backward `{bwd.node.name}` runs under "
+                    "shard_map and declares a replicated output "
+                    "(out_specs P()) but no lax.psum is reachable — "
+                    "per-shard reduced partials (dgamma/dbeta class) "
+                    "need an explicit cross-shard psum",
+                )
