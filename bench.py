@@ -117,10 +117,17 @@ def _bench_train(model_fn, opt_fn, x_shape, y_classes, batch, steps, label,
         prof.stop_profiler()
 
     step_ms = dt / steps * 1e3
-    return steps * batch / dt, {
+    bd = {
         f"{label}_step_ms": round(step_ms, 2),
         f"{label}_compile_s": round(compile_s, 1),
     }
+    # achieved-FLOPs accounting (ISSUE 8): XLA-cost-model FLOPs of the
+    # exact compiled step vs the device-kind peak table — None on CPU CI
+    # without a PADDLE_OBS_PEAK_FLOPS override, recorded when known
+    mfu = step.mfu_pct(step_ms / 1e3)
+    if mfu is not None:
+        bd[f"{label}_mfu_pct"] = mfu
+    return steps * batch / dt, bd
 
 
 def _bert_base():
@@ -309,11 +316,15 @@ def _bench_gpt(steps=10, batch=4, seq=1024, dense=False, guard=None):
     _ = np.asarray(loss._data)
     dt = time.perf_counter() - t0
     tok_s = steps * batch * seq / dt
-    return {
+    out = {
         "gpt_medium_bf16_step_ms": round(dt / steps * 1e3, 2),
         "gpt_medium_bf16_tokens_per_sec": round(tok_s, 0),
         "gpt_medium_bf16_compile_s": round(compile_s, 1),
     }
+    mfu = step.mfu_pct(dt / steps)
+    if mfu is not None:
+        out["gpt_medium_bf16_mfu_pct"] = mfu
+    return out
 
 
 def _bench_gpt_multichip(steps=10, seq=1024, shard_off=False):
@@ -609,6 +620,13 @@ def main():
     # r04 measured the same model/optimizer at batch 64 with two-pass
     # f32-blacklisted batch norm: 41.78 ms / 64 imgs = 1531.7 imgs/sec
     extra["vs_r04_resnet50_bf16"] = round(r50_bf16_ips / 1531.7, 2)
+    # recompile-ledger totals (ISSUE 8): jit cache misses this process
+    # observed across every benched step object — compile-count drift is
+    # reported (never gated) by tools/bench_continuity.py next to the
+    # compile-time table
+    from paddle_tpu.observability import ledger as _ledger
+
+    extra["compile_count"] = _ledger.compile_count()
     extra["incomparable_to_prev"] = (
         f"r06 methodology change: every metric is now the MEDIAN of "
         f"{REPEATS} repeats with min/max spread recorded per metric "

@@ -161,6 +161,22 @@ def _consume_desync_flag() -> bool:
     return fi.consume_flag("desync")
 
 
+def _bus():
+    """The telemetry bus (observability/bus.py) when importable; None
+    when this module was loaded standalone outside the package (no-jax
+    launcher children) — events then fall back to the legacy-only
+    inline write, preserving the stdlib-pure contract."""
+    mod = sys.modules.get("paddle_tpu.observability.bus")
+    if mod is not None:
+        return mod
+    try:
+        from ..observability import bus as mod  # type: ignore
+
+        return mod
+    except ImportError:
+        return None
+
+
 class CommMonitor:
     """Per-process collective monitor (one per rank process).
 
@@ -301,15 +317,23 @@ class CommMonitor:
 
     def _write_event(self, kind: str, rec: Optional[_Record],
                      extra: Optional[dict] = None) -> None:
+        payload: dict = {}
+        if rec is not None:
+            payload.update(rec.to_json())
+            payload["describe"] = rec.describe()
+        if extra:
+            payload.update(extra)
+        bus = _bus()
+        if bus is not None:
+            # unified-schema row on the per-rank bus stream + the legacy
+            # flat row on PADDLE_COLL_EVENT_FILE (kill-attribution reader)
+            bus.emit(kind, payload, rank=self.rank, legacy_env=_EVENT_ENV)
+            return
         path = os.environ.get(_EVENT_ENV)
         if not path:
             return
         row = {"event": kind, "rank": self.rank, "time": time.time()}
-        if rec is not None:
-            row.update(rec.to_json())
-            row["describe"] = rec.describe()
-        if extra:
-            row.update(extra)
+        row.update(payload)
         try:
             with open(path, "a") as f:
                 f.write(json.dumps(row) + "\n")

@@ -40,6 +40,19 @@ from typing import Callable, Dict, List, Optional
 
 from . import comm_monitor  # stdlib-pure: safe for the launcher process
 
+try:  # telemetry bus (stdlib-pure too); tolerate exotic standalone loads
+    from ..observability import bus as _obs_bus
+except ImportError:  # pragma: no cover - package always carries it
+    _obs_bus = None
+
+
+def _emit(kind: str, **payload) -> None:
+    """Launcher-side bus event (rank -1). Lands only when the operator
+    exported PADDLE_OBS_DIR/PADDLE_OBS_BUS_FILE for the manager process;
+    the per-rank child streams are provisioned independently in _spawn."""
+    if _obs_bus is not None:
+        _obs_bus.emit(kind, payload, rank=-1)
+
 __all__ = ["ElasticManager", "RankProc", "heartbeat",
            "install_preempt_notice", "restore_preempt_notice", "HUNG_RC"]
 
@@ -176,6 +189,13 @@ class ElasticManager:
         sync_dir = os.path.join(self._run_dir, f"collsync.{attempt}")
         os.makedirs(sync_dir, exist_ok=True)
         debug_dir = self.log_dir or self._run_dir
+        # telemetry-bus home for the children (observability/bus.py):
+        # next to the workerlogs so tools/timeline.py finds every rank's
+        # stream beside the flight-recorder dumps. Only a durable
+        # destination qualifies — the tmp run dir is removed at manager
+        # exit, so without --log_dir (or an operator-exported
+        # PADDLE_OBS_DIR riding in via the env dicts) the bus stays off.
+        obs_dir = os.environ.get("PADDLE_OBS_DIR") or self.log_dir
         self._procs = []
         for env in self.envs:
             env = dict(env)
@@ -207,6 +227,8 @@ class ElasticManager:
             env["PADDLE_GUARD_EVENT_FILE"] = gev
             env["PADDLE_COLL_SYNC_DIR"] = sync_dir
             env.setdefault("PADDLE_COLL_DEBUG_DIR", debug_dir)
+            if obs_dir:
+                env.setdefault("PADDLE_OBS_DIR", obs_dir)
             if self.coll_timeout is not None:
                 env["PADDLE_COLL_TIMEOUT"] = str(self.coll_timeout)
             log_path = log_file = None
@@ -220,6 +242,10 @@ class ElasticManager:
                 env=env, stdout=log_file, stderr=log_file)
             self._procs.append(RankProc(p, rank, hb, log_path, log_file,
                                         ev_path=ev, guard_ev_path=gev))
+        _emit("elastic_spawn", attempt=attempt,
+              ranks=[rp.rank for rp in self._procs],
+              pids=[rp.proc.pid for rp in self._procs],
+              obs_dir=obs_dir)
 
     # -- teardown ---------------------------------------------------------
     def _kill_rank(self, rp: RankProc, why: str) -> None:
@@ -280,6 +306,8 @@ class ElasticManager:
         ev = max(events, key=lambda e: e.get("time", 0.0))
         what = (ev.get("detail") or ev.get("describe")
                 or ev.get("event", "?"))
+        _emit("elastic_attribution", rank=rp.rank, why=why,
+              cause=ev.get("event", "?"), detail=what)
         print(
             f"paddle_tpu.elastic: rank {rp.rank} {why} attributed to "
             f"{ev.get('event', '?')}: {what}",
@@ -312,6 +340,9 @@ class ElasticManager:
                     except OSError:
                         continue  # heartbeat file raced away; skip a beat
                     if age > self.watchdog_timeout:
+                        _emit("elastic_watchdog_kill", rank=rp.rank,
+                              stale_s=round(age, 1),
+                              timeout_s=self.watchdog_timeout)
                         self._kill_rank(
                             rp, f"rank {rp.rank} heartbeat stale "
                                 f"{age:.1f}s > {self.watchdog_timeout}s")
@@ -368,6 +399,7 @@ class ElasticManager:
                 self._spawn(attempt)
                 rc = self._watch()
                 if self._preempted:
+                    _emit("elastic_preempt", attempt=attempt, rc=rc)
                     # the notice wins even over a clean rank exit: the
                     # host is going away, so report "interrupted" (143)
                     # and let the next incarnation's restore() decide
@@ -384,6 +416,9 @@ class ElasticManager:
                     return rc
                 self._restarts.append(time.monotonic())
                 delay = self._backoff_delay(len(self._restarts))
+                _emit("elastic_relaunch", attempt=attempt, rc=rc,
+                      delay_s=round(delay, 2),
+                      restarts_left=self.max_restarts - len(self._restarts))
                 print(
                     f"paddle_tpu.elastic: attempt {attempt} failed rc={rc}; "
                     f"relaunching in {delay:.2f}s "

@@ -101,8 +101,16 @@ class LocalSGDStep:
                 _TG.init_guard_state(), NamedSharding(self.mesh, P()))
         # sync is STATIC (host-known): two cached compilations, and the
         # non-sync program contains NO collective at all — the whole point
-        # of LocalSGD's reduced communication
-        self._jitted = jax.jit(self._step_fn, static_argnums=8)
+        # of LocalSGD's reduced communication. The recompile ledger
+        # (observability/ledger.py) records both expected compiles —
+        # anything past two is a real miss worth a bus row.
+        from ...observability import ledger as _ledger
+
+        self._jitted = _ledger.instrument(
+            jax.jit(self._step_fn, static_argnums=8),
+            label="LocalSGDStep",
+        )
+        self._n_steps = 0
         self._dirty = False
         # checkpoint consumers must see averaged weights: state_dict pulls
         # the replicas back into the Layer first
@@ -235,6 +243,12 @@ class LocalSGDStep:
         sync = t >= self.begin_step and t % self.k_steps == 0
         if self._guard is not None:
             self._guard.capture(None, in_raws, label_raws)
+        from ... import profiler as _prof
+        from ...observability import bus as _bus
+
+        self._n_steps += 1
+        _bus.set_step(self._n_steps)
+        _prof.step_boundary(self._n_steps)
         (loss, self._stk_p, self._stk_state, self._stk_b,
          self._guard_state) = self._jitted(
             self._stk_p, self._stk_state, self._stk_b,
@@ -250,6 +264,12 @@ class LocalSGDStep:
             # restacks the replicas and re-seeds the guard carry
             self._guard.observe(self._guard_state)
         return Tensor._wrap(loss, stop_gradient=True)
+
+    def flops_per_step(self):
+        """Cost-analysis FLOPs are not derived for the LocalSGD program
+        (two cached compilations, stacked-replica operands) — report
+        None rather than a wrong number."""
+        return None
 
     def _after_rollback(self):
         """Guard rollback hook: the checkpoint restored the LAYER's

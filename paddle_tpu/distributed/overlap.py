@@ -171,25 +171,28 @@ def row_parallel_overlap(x, w, b, mesh, mp, row_ax, axis="mp"):
     replicated, shard_map slices it), w [in, out] row-sharded, b [out]
     replicated (added once after the reduction). Output replicated over
     mp, rows sharded over `row_ax` when the shapes tile."""
+    from .. import profiler as _prof
+
     shape = x.shape[:-1] + (w.shape[-1],)
     x2d = x.reshape(-1, x.shape[-1])
-    if b is None:
-        body = functools.partial(
-            lambda xl, wl, **kw: _row_ring_body(xl, wl, None, **kw),
-            n=mp, axis=axis,
-        )
-        out = comm.shard_map(
-            body, mesh,
-            in_specs=(P(row_ax, axis), P(axis, None)),
-            out_specs=P(row_ax, None),
-        )(x2d, w)
-    else:
-        body = functools.partial(_row_ring_body, n=mp, axis=axis)
-        out = comm.shard_map(
-            body, mesh,
-            in_specs=(P(row_ax, axis), P(axis, None), P()),
-            out_specs=P(row_ax, None),
-        )(x2d, w, b)
+    with _prof.device_annotation("tp_overlap::row_ring"):
+        if b is None:
+            body = functools.partial(
+                lambda xl, wl, **kw: _row_ring_body(xl, wl, None, **kw),
+                n=mp, axis=axis,
+            )
+            out = comm.shard_map(
+                body, mesh,
+                in_specs=(P(row_ax, axis), P(axis, None)),
+                out_specs=P(row_ax, None),
+            )(x2d, w)
+        else:
+            body = functools.partial(_row_ring_body, n=mp, axis=axis)
+            out = comm.shard_map(
+                body, mesh,
+                in_specs=(P(row_ax, axis), P(axis, None), P()),
+                out_specs=P(row_ax, None),
+            )(x2d, w, b)
     return out.reshape(shape)
 
 
@@ -218,25 +221,28 @@ def column_gather_overlap(x, w, b, mesh, mp, row_ax, axis="mp"):
     """ColumnParallelLinear (gather_output=True) forward with the output
     all-gather pipelined behind per-chunk matmuls. w [in, out]
     column-sharded, b [out] sharded over mp."""
+    from .. import profiler as _prof
+
     shape = x.shape[:-1] + (w.shape[-1],)
     x2d = x.reshape(-1, x.shape[-1])
-    if b is None:
-        body = functools.partial(
-            lambda xl, wl, **kw: _col_pipeline_body(xl, wl, None, **kw),
-            n=mp, axis=axis,
-        )
-        out = comm.shard_map(
-            body, mesh,
-            in_specs=(P(row_ax, None), P(None, axis)),
-            out_specs=P(row_ax, None),
-        )(x2d, w)
-    else:
-        body = functools.partial(_col_pipeline_body, n=mp, axis=axis)
-        out = comm.shard_map(
-            body, mesh,
-            in_specs=(P(row_ax, None), P(None, axis), P(axis)),
-            out_specs=P(row_ax, None),
-        )(x2d, w, b)
+    with _prof.device_annotation("tp_overlap::column_gather"):
+        if b is None:
+            body = functools.partial(
+                lambda xl, wl, **kw: _col_pipeline_body(xl, wl, None, **kw),
+                n=mp, axis=axis,
+            )
+            out = comm.shard_map(
+                body, mesh,
+                in_specs=(P(row_ax, None), P(None, axis)),
+                out_specs=P(row_ax, None),
+            )(x2d, w)
+        else:
+            body = functools.partial(_col_pipeline_body, n=mp, axis=axis)
+            out = comm.shard_map(
+                body, mesh,
+                in_specs=(P(row_ax, None), P(None, axis), P(axis)),
+                out_specs=P(row_ax, None),
+            )(x2d, w, b)
     return out.reshape(shape)
 
 
@@ -294,22 +300,26 @@ def dcn_value_and_grad(loss_of, mesh, p_raws, key, in_raws, label_raws):
         )
         return jax.lax.pmean(loss, "dcn"), grads
 
+    from .. import profiler as _prof
+
     p_specs = jax.tree_util.tree_map(lambda _: P(), tuple(p_raws))
     in_specs_ins = tuple(P("dcn") for _ in in_raws)
     in_specs_lbls = tuple(P("dcn") for _ in label_raws)
-    if key is None:
+    with _prof.device_annotation("TrainStep::async_dcn"):
+        if key is None:
+            f = comm.shard_map(
+                lambda p, ins, lbls: body(p, None, ins, lbls), mesh,
+                in_specs=(p_specs, in_specs_ins, in_specs_lbls),
+                out_specs=(P(), p_specs),
+                auto=auto,
+            )
+            return f(tuple(p_raws), tuple(in_raws), tuple(label_raws))
         f = comm.shard_map(
-            lambda p, ins, lbls: body(p, None, ins, lbls), mesh,
-            in_specs=(p_specs, in_specs_ins, in_specs_lbls),
+            body, mesh,
+            in_specs=(p_specs, P(), in_specs_ins, in_specs_lbls),
             out_specs=(P(), p_specs),
             auto=auto,
         )
-        return f(tuple(p_raws), tuple(in_raws), tuple(label_raws))
-    f = comm.shard_map(
-        body, mesh,
-        in_specs=(p_specs, P(), in_specs_ins, in_specs_lbls),
-        out_specs=(P(), p_specs),
-        auto=auto,
-    )
-    loss, grads = f(tuple(p_raws), key, tuple(in_raws), tuple(label_raws))
-    return loss, grads
+        loss, grads = f(tuple(p_raws), key, tuple(in_raws),
+                        tuple(label_raws))
+        return loss, grads

@@ -325,7 +325,10 @@ class GuardCallback(Callback):
       snapshot (`Model.load`) when one exists, else stops training —
       emitting a `guard_rollback` / `guard_stop` JSONL event either way
       (`PADDLE_GUARD_EVENT_FILE`, the stream the ElasticManager reads
-      for kill attribution).
+      for kill attribution; since round 9 every emit also lands on the
+      unified telemetry bus — README "Observability" — so hapi guard
+      events merge into the same `tools/timeline.py` view as the
+      in-graph guard's).
     """
 
     def __init__(self, max_skips=None, save_dir=None, spike_factor=None,

@@ -19,6 +19,10 @@ from typing import List, Optional
 import numpy as np
 
 import jax
+# the grafted jax's lazy `jax.__getattr__` table does not expose `export`
+# as an attribute (AttributeError on `jax.export.…`), but the submodule
+# itself imports fine — bind it explicitly
+import jax.export as jax_export
 import jax.numpy as jnp
 
 from ..core import autograd as AG
@@ -78,7 +82,7 @@ def save(layer, path, input_spec=None, **configs):
         return outs
 
     jitted = jax.jit(infer_fn)
-    exported = jax.export.export(jitted)(param_raws, buffer_raws, example_raws)
+    exported = jax_export.export(jitted)(param_raws, buffer_raws, example_raws)
 
     d = os.path.dirname(path)
     if d:
@@ -148,7 +152,7 @@ class TranslatedLayer(Layer):
 def load(path, **configs) -> TranslatedLayer:
     """paddle.jit.load(path) -> TranslatedLayer."""
     with open(path + MODEL_SUFFIX, "rb") as f:
-        exported = jax.export.deserialize(f.read())
+        exported = jax_export.deserialize(f.read())
     data = np.load(path + PARAMS_SUFFIX)
     with open(path + ".pdmeta") as f:
         meta = json.load(f)

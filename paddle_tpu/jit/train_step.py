@@ -244,6 +244,16 @@ class TrainStep:
         # input signature, and the whole step re-traces and re-compiles
         # once — tens of seconds on a large model.
         self._jitted = None
+        # observability (ISSUE 8): monotonic step index for the bus, arg
+        # avals kept for the cost-analysis lowering, cached per-step
+        # FLOPs; the jitted program is wrapped by the recompile ledger
+        self._n_steps = 0
+        self._lower_avals = None
+        self._flops = None
+        from ..observability import bus as _bus, ledger as _ledger
+
+        if _bus.enabled():
+            _ledger.install_backend_listener()
 
     # -- the pure program ----------------------------------------------------
     def _amp_guard(self):
@@ -257,8 +267,11 @@ class TrainStep:
         """Model forward as a pure pytree function — the jax.checkpoint
         (remat) boundary when strategy.recompute is on (RecomputeOptimizer
         analog, fluid/optimizer.py:4549)."""
+        from .. import profiler as _prof
+
         p_objs, b_objs = self._p_objs, self._b_objs
         with AG.trace_mode(), _trace_rng(key), self._amp_guard(), \
+                _prof.device_annotation("TrainStep::forward"), \
                 _swapped(p_objs + b_objs, list(p_tuple) + list(b_raws)):
             outs = self.model(*[Tensor._wrap(r) for r in in_raws])
             out_raw = jax.tree_util.tree_map(
@@ -340,17 +353,21 @@ class TrainStep:
             # attempted steps (the eager scaler skips optimizer.step()
             # entirely on overflow) — it rides in the scaler state
             t = (scaler_state[3] + 1).astype(t.dtype)
-        new_p, new_state = self.opt._functional_update(
-            self._p_objs, list(p_raws), grads, opt_state, lr, t
-        )
+        from .. import profiler as _prof
+
+        with _prof.device_annotation("TrainStep::opt_update"):
+            new_p, new_state = self.opt._functional_update(
+                self._p_objs, list(p_raws), grads, opt_state, lr, t
+            )
         if self._guard is not None:
             # the sentinel: one fused grad reduction + scalar flags;
             # the policy update folds in spike detection and returns the
             # apply verdict (nonfinite OR exploded-gnorm steps mask)
-            ok, bits, gnorm = _TG.grad_health(loss, grads, new_p)
-            guard_state, ok_apply = _TG.update_guard_state(
-                guard_state, ok, bits, gnorm, loss
-            )
+            with _prof.device_annotation("TrainStep::guard"):
+                ok, bits, gnorm = _TG.grad_health(loss, grads, new_p)
+                guard_state, ok_apply = _TG.update_guard_state(
+                    guard_state, ok, bits, gnorm, loss
+                )
             if self._loss_scale_cfg is not None:
                 # the scaler's skip masking doubles as the guard's, and
                 # a guard trip counts as a bad step -> scale backoff
@@ -452,6 +469,40 @@ class TrainStep:
         if self._guard is not None:
             self._guard_state = self._place_guard_state(
                 self._guard.restored_device_state())
+
+    # -- achieved-FLOPs accounting (observability/mfu.py) ------------------
+    def flops_per_step(self):
+        """Per-device FLOPs of ONE compiled step — forward + backward +
+        optimizer update, priced by XLA's own cost model over the exact
+        program this step dispatches (re-lowered from the stored arg
+        avals: one re-trace, no compile, no device work). None before
+        the first call or when the backend has no cost model."""
+        if self._delegate is not None:
+            return self._delegate.flops_per_step()
+        if self._flops is not None:
+            return self._flops
+        if self._jitted is None or self._lower_avals is None:
+            return None
+        from ..observability import mfu as _mfu
+
+        try:
+            lowered = self._jitted.lower(*self._lower_avals)
+        except Exception:  # noqa: BLE001 — accounting stays best-effort
+            return None
+        self._flops = _mfu.flops_of_lowered(lowered)
+        return self._flops
+
+    def mfu_pct(self, step_seconds: float):
+        """Model-FLOPs utilization of a measured step time, percent of
+        this device kind's peak (None off-TPU without the
+        ``PADDLE_OBS_PEAK_FLOPS`` override). The peak check runs FIRST:
+        without a denominator the cost-analysis re-trace would be paid
+        only to discard its result (bench.py asks per benched model)."""
+        from ..observability import mfu as _mfu
+
+        if _mfu.peak_flops() is None:
+            return None
+        return _mfu.mfu_pct(self.flops_per_step(), step_seconds)
 
     # -- persisted step state (the auto_checkpoint `extras` contract) -----
     def state_dict(self):
@@ -556,10 +607,18 @@ class TrainStep:
             donate = (0, 1, 2) if self._donate else ()
             if self._donate and self._loss_scale_cfg is not None:
                 donate = donate + (6,)
-            self._jitted = jax.jit(
-                self._step_fn,
-                donate_argnums=donate,
-                out_shardings=out_sh,
+            from ..observability import ledger as _ledger
+
+            # the ledger wrapper turns every jit cache miss into a
+            # `recompile` bus record (arg fingerprint + compile seconds)
+            # — one integer compare per call on the hit path
+            self._jitted = _ledger.instrument(
+                jax.jit(
+                    self._step_fn,
+                    donate_argnums=donate,
+                    out_shardings=out_sh,
+                ),
+                label="TrainStep", donate=donate,
             )
         opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -567,12 +626,32 @@ class TrainStep:
         inject = (_FI.consume_grad_action() if self._inject_enabled else 0)
         if self._guard is not None:
             self._guard.capture(key, in_raws, label_raws)
-        (loss, new_p, new_state, new_b, outs, self._scaler_state,
-         self._guard_state) = self._jitted(
+        # observability per-step hooks (one int assign + one None check
+        # when nothing is armed): the bus step index events inherit, and
+        # the capture-on-anomaly trace window opens BEFORE the dispatch
+        # it is meant to cover
+        from .. import profiler as _prof
+        from ..observability import bus as _bus
+
+        self._n_steps += 1
+        _bus.set_step(self._n_steps)
+        _prof.step_boundary(self._n_steps)
+        call_args = (
             p_raws, opt_state, b_raws, key, lr, t, self._scaler_state,
             self._guard_state, jnp.asarray(inject, jnp.int32),
-            in_raws, label_raws
+            in_raws, label_raws,
         )
+        if self._lower_avals is None:
+            # shape/dtype skeleton of the call signature, kept for the
+            # cost-analysis lowering (flops_per_step): donated buffers
+            # are invalidated after dispatch, avals hold no storage
+            self._lower_avals = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                if hasattr(x, "shape") and hasattr(x, "dtype") else x,
+                call_args,
+            )
+        (loss, new_p, new_state, new_b, outs, self._scaler_state,
+         self._guard_state) = self._jitted(*call_args)
         for p, raw in zip(self._p_objs, new_p):
             p._data = raw
             p._node = None

@@ -239,12 +239,15 @@ def flash_core(q, k, v, *, causal=True, scale=None):
     bq = _flash_block(int(q.shape[2]))
     bk = _flash_block(int(k.shape[2]))
     interpret = jax.default_backend() != "tpu"
-    return AG.apply(
-        lambda a, b, c: flash_attention(
-            a, b, c, causal, bq, bk, scale, interpret
-        ),
-        (q, k, v), name="flash_attention",
-    )
+    from ... import profiler as _prof
+
+    with _prof.device_annotation("attention::flash"):
+        return AG.apply(
+            lambda a, b, c: flash_attention(
+                a, b, c, causal, bq, bk, scale, interpret
+            ),
+            (q, k, v), name="flash_attention",
+        )
 
 
 def flash_core_sharded(q, k, v, *, mesh, batch_axes, head_axes,
@@ -258,13 +261,16 @@ def flash_core_sharded(q, k, v, *, mesh, batch_axes, head_axes,
     bq = _flash_block(int(q.shape[2]))
     bk = _flash_block(int(k.shape[2]))
     interpret = jax.default_backend() != "tpu"
-    return AG.apply(
-        lambda a, b, c: sharded_flash_attention(
-            a, b, c, mesh, batch_axes, head_axes, causal, bq, bk,
-            scale, interpret
-        ),
-        (q, k, v), name="sharded_flash_attention",
-    )
+    from ... import profiler as _prof
+
+    with _prof.device_annotation("attention::sharded_flash"):
+        return AG.apply(
+            lambda a, b, c: sharded_flash_attention(
+                a, b, c, mesh, batch_axes, head_axes, causal, bq, bk,
+                scale, interpret
+            ),
+            (q, k, v), name="sharded_flash_attention",
+        )
 
 
 def flash_core_routed(q, k, v, *, mesh=None, causal=True, scale=None,
@@ -334,13 +340,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             s = jnp.where(kpos[None, :] > qpos[:, None], -1e9, s)
         return jax.nn.softmax(s, axis=-1)
 
-    args = (query, key) + ((attn_mask,) if attn_mask is not None else ())
-    weights = AG.apply(score_fn, args, name="attention_scores")
-    if dropout_active:
-        from .common import dropout as _dropout
+    from ... import profiler as _prof
 
-        weights = _dropout(weights, dropout_p, training=True)
-    return AG.apply(
-        lambda w, vr: jnp.einsum("bhqk,bhkd->bhqd", w, vr),
-        (weights, value), name="attention_context",
-    )
+    args = (query, key) + ((attn_mask,) if attn_mask is not None else ())
+    with _prof.device_annotation("attention::dense"):
+        weights = AG.apply(score_fn, args, name="attention_scores")
+        if dropout_active:
+            from .common import dropout as _dropout
+
+            weights = _dropout(weights, dropout_p, training=True)
+        return AG.apply(
+            lambda w, vr: jnp.einsum("bhqk,bhkd->bhqd", w, vr),
+            (weights, value), name="attention_context",
+        )

@@ -246,8 +246,11 @@ def fused_linear_cross_entropy(input, weight, bias=None, label=None,
             return jnp.sum(rows) / jnp.maximum(jnp.sum(valid), 1)
         return _reduce(rows, reduction)
 
+    from ... import profiler as _prof
+
     args = (input, weight, label) + ((bias,) if bias is not None else ())
-    return AG.apply(f, args, name="fused_linear_cross_entropy")
+    with _prof.device_annotation("loss::fused_linear_ce"):
+        return AG.apply(f, args, name="fused_linear_cross_entropy")
 
 
 def square_error_cost(input, label):

@@ -235,6 +235,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     route = _fused_ln_route(x._data, normalized_shape, weight, bias,
                             mesh=mesh)
     if route is not None:
+        from ... import profiler as _prof
+
         interp, mesh, row_axes = route
         # dispatched OFF the amp black list on purpose: the kernel keeps
         # bf16 activations bf16 (f32 stats internally) instead of the
@@ -242,18 +244,20 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         if mesh is not None:
             from ...ops.pallas.sharded import sharded_layer_norm
 
-            return AG.apply(
-                lambda a, w, b: sharded_layer_norm(
-                    a, w, b, epsilon, interp, mesh, row_axes
-                ),
-                (x, weight, bias), name="sharded_layer_norm",
-            )
+            with _prof.device_annotation("layer_norm::sharded_fused"):
+                return AG.apply(
+                    lambda a, w, b: sharded_layer_norm(
+                        a, w, b, epsilon, interp, mesh, row_axes
+                    ),
+                    (x, weight, bias), name="sharded_layer_norm",
+                )
         from ...ops.pallas.layer_norm import fused_layer_norm
 
-        return AG.apply(
-            lambda a, w, b: fused_layer_norm(a, w, b, epsilon, interp),
-            (x, weight, bias), name="fused_layer_norm",
-        )
+        with _prof.device_annotation("layer_norm::fused"):
+            return AG.apply(
+                lambda a, w, b: fused_layer_norm(a, w, b, epsilon, interp),
+                (x, weight, bias), name="fused_layer_norm",
+            )
 
     def f(a, *wb):
         mean = jnp.mean(a, axis=axes, keepdims=True)
@@ -289,25 +293,30 @@ def fused_residual_layer_norm(x, residual, normalized_shape, weight=None,
     route = _fused_ln_route(x._data, normalized_shape, weight, bias,
                             mesh=mesh)
     if route is not None and x._data.shape == residual._data.shape:
+        from ... import profiler as _prof
+
         interp, mesh, row_axes = route
         if mesh is not None:
             from ...ops.pallas.sharded import sharded_add_layer_norm
 
-            return AG.apply(
-                lambda a, r, w, b: sharded_add_layer_norm(
-                    a, r, w, b, epsilon, interp, mesh, row_axes
-                ),
-                (x, residual, weight, bias),
-                name="sharded_residual_layer_norm",
-            )
+            with _prof.device_annotation("layer_norm::sharded_residual"):
+                return AG.apply(
+                    lambda a, r, w, b: sharded_add_layer_norm(
+                        a, r, w, b, epsilon, interp, mesh, row_axes
+                    ),
+                    (x, residual, weight, bias),
+                    name="sharded_residual_layer_norm",
+                )
         from ...ops.pallas.layer_norm import fused_add_layer_norm
 
-        return AG.apply(
-            lambda a, r, w, b: fused_add_layer_norm(
-                a, r, w, b, epsilon, interp
-            ),
-            (x, residual, weight, bias), name="fused_residual_layer_norm",
-        )
+        with _prof.device_annotation("layer_norm::fused_residual"):
+            return AG.apply(
+                lambda a, r, w, b: fused_add_layer_norm(
+                    a, r, w, b, epsilon, interp
+                ),
+                (x, residual, weight, bias),
+                name="fused_residual_layer_norm",
+            )
     s = x + residual
     return s, layer_norm(s, normalized_shape, weight, bias, epsilon,
                          mesh=mesh)

@@ -1,0 +1,30 @@
+"""Observability plane (ISSUE 8): unified telemetry bus, step/MFU
+metrics, recompile ledger.
+
+- :mod:`.bus` — the one per-rank JSONL event schema every runtime
+  emitter (guard, comm monitor, ElasticManager, metrics, ledger,
+  profiler) writes through; legacy ``PADDLE_*_EVENT_FILE`` streams stay
+  as compat aliases. Stdlib-pure.
+- :mod:`.metrics` — periodic ``step_metrics`` records riding the
+  guard's ``PADDLE_GUARD_SYNC_EVERY`` async host read (zero new
+  per-step syncs).
+- :mod:`.ledger` — jit cache misses as ``recompile`` records with arg
+  shape/dtype/donation fingerprints, compile seconds, and a
+  recompile-storm detector naming the changing fingerprint field.
+- :mod:`.mfu` — achieved-FLOPs from ``lowered.cost_analysis()`` against
+  a per-device peak table (the PERF.md attribution protocol,
+  mechanized).
+
+Capture-on-anomaly device tracing lives in :mod:`paddle_tpu.profiler`
+(it owns the ``jax.profiler`` surface); ``tools/timeline.py`` merges
+the per-rank streams into a chrome trace + summary.
+"""
+from __future__ import annotations
+
+from . import bus, ledger, metrics, mfu
+from .bus import current_step, emit, read_stream, set_step
+
+__all__ = [
+    "bus", "metrics", "ledger", "mfu",
+    "emit", "set_step", "current_step", "read_stream",
+]

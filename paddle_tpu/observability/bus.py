@@ -1,0 +1,189 @@
+"""Unified telemetry bus — ONE per-rank JSONL event schema (ISSUE 8).
+
+PRs 1–5 left three *disjoint* per-rank JSONL streams (guard events to
+``PADDLE_GUARD_EVENT_FILE``, collective events to
+``PADDLE_COLL_EVENT_FILE``, elastic workerlogs) with three slightly
+different row shapes, so no tool could correlate "guard tripped on rank
+3" with "rank 2 stalled in all_reduce" on one timeline. The bus is the
+single schema every emitter now writes::
+
+    {"v": 1, "kind": "...", "step": N|null, "time": <wall>, "rank": R,
+     "payload": {...}}
+
+- ``v``     — schema version (bump on incompatible change).
+- ``kind``  — event name: ``guard_*`` (train_guard), ``coll_*`` /
+  ``barrier_*`` (comm_monitor), ``elastic_*`` (ElasticManager, rank -1),
+  ``step_metrics`` (metrics.py), ``recompile`` / ``recompile_storm`` /
+  ``backend_compile`` (ledger.py), ``trace_armed`` / ``trace_captured``
+  (profiler).
+- ``step``  — the MONOTONIC per-process step index (set by the compiled
+  step objects via :func:`set_step`); ``null`` for events outside a
+  training loop (launcher, rendezvous).
+- ``rank``  — ``PADDLE_TRAINER_ID`` (−1 for the launcher process).
+
+Destination: ``PADDLE_OBS_BUS_FILE`` (explicit file, tests) or
+``PADDLE_OBS_DIR/telemetry.rank{R}.jsonl`` (the launcher provisions
+``PADDLE_OBS_DIR`` next to the workerlogs so ``tools/timeline.py`` can
+merge every rank). Neither set → the bus is off and :func:`emit` is a
+dict-build + early return.
+
+Compat: the legacy single-purpose streams KEEP their exact old flat
+format — :func:`emit` takes ``legacy_env`` and writes the old
+``{"event": kind, "time": ..., "rank": ..., **payload}`` row to that
+path too, so the ElasticManager's kill-attribution reader and every
+existing consumer of ``PADDLE_GUARD_EVENT_FILE`` /
+``PADDLE_COLL_EVENT_FILE`` are untouched.
+
+Stdlib-pure on purpose (no jax, no package-relative imports): the
+comm monitor loads standalone in no-jax launcher children and routes
+through this module only when it is importable.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "SCHEMA_VERSION", "enabled", "bus_path", "emit", "set_step",
+    "current_step", "read_stream", "rank_streams", "reset",
+]
+
+SCHEMA_VERSION = 1
+
+_DIR_ENV = "PADDLE_OBS_DIR"
+_FILE_ENV = "PADDLE_OBS_BUS_FILE"
+
+_lock = threading.Lock()
+_step: Optional[int] = None   # monotonic step index, set by the step objects
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def bus_path(rank: Optional[int] = None) -> Optional[str]:
+    """This process's bus file, or None when the bus is off."""
+    explicit = os.environ.get(_FILE_ENV)
+    if explicit:
+        return explicit
+    d = os.environ.get(_DIR_ENV)
+    if not d:
+        return None
+    r = _rank() if rank is None else rank
+    name = "telemetry.launcher.jsonl" if r < 0 \
+        else f"telemetry.rank{r}.jsonl"
+    return os.path.join(d, name)
+
+
+def enabled() -> bool:
+    return bus_path() is not None
+
+
+def set_step(step: int) -> None:
+    """Advance the process-global monotonic step index (called by the
+    compiled step objects once per step; emitters that don't know their
+    step inherit the current one)."""
+    global _step
+    _step = int(step)
+
+
+def current_step() -> Optional[int]:
+    return _step
+
+
+def reset() -> None:
+    """Tests: forget the step counter between cases."""
+    global _step
+    _step = None
+
+
+def emit(kind: str, payload: Optional[Dict] = None, *,
+         step: Optional[int] = None, rank: Optional[int] = None,
+         legacy_env: Optional[str] = None) -> None:
+    """Append one bus row (and, via ``legacy_env``, the old-format row
+    to that env's path). Diagnostics must never take the trainer down:
+    every I/O failure is swallowed."""
+    payload = dict(payload or {})
+    r = _rank() if rank is None else int(rank)
+    now = time.time()
+    if legacy_env:
+        legacy_path = os.environ.get(legacy_env)
+        if legacy_path:
+            legacy_row = {"event": kind, "time": now, "rank": r}
+            legacy_row.update(payload)
+            try:
+                with _lock, open(legacy_path, "a") as f:
+                    f.write(json.dumps(legacy_row, default=str) + "\n")
+            except (OSError, TypeError, ValueError):
+                pass
+    path = bus_path(rank=r)
+    if not path:
+        return
+    row = {
+        "v": SCHEMA_VERSION,
+        "kind": kind,
+        "step": _step if step is None else int(step),
+        "time": now,
+        "rank": r,
+        "payload": payload,
+    }
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with _lock, open(path, "a") as f:
+            f.write(json.dumps(row, default=str) + "\n")
+    except (OSError, TypeError, ValueError):
+        pass
+
+
+def read_stream(path: str) -> List[dict]:
+    """Parse one bus JSONL file — tolerant of torn last lines (a rank
+    killed mid-write must not corrupt the merge)."""
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict) and "kind" in row:
+                    out.append(row)
+    except OSError:
+        pass
+    return out
+
+
+def rank_streams(obs_dir: str) -> Dict[int, List[dict]]:
+    """Every per-rank stream in an observability dir, keyed by rank
+    (launcher file keys as -1). Rows sorted by time within each rank."""
+    out: Dict[int, List[dict]] = {}
+    try:
+        names = sorted(os.listdir(obs_dir))
+    except OSError:
+        return out
+    for name in names:
+        if name == "telemetry.launcher.jsonl":
+            r = -1
+        elif name.startswith("telemetry.rank") and name.endswith(".jsonl"):
+            try:
+                r = int(name[len("telemetry.rank"):-len(".jsonl")])
+            except ValueError:
+                continue
+        else:
+            continue
+        rows = read_stream(os.path.join(obs_dir, name))
+        rows.sort(key=lambda e: e.get("time", 0.0))
+        if rows:
+            out[r] = rows
+    return out

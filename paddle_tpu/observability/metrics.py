@@ -1,0 +1,131 @@
+"""Periodic step-metrics records on the guard's async-host-read cadence.
+
+The numerical guard (utils/train_guard.py) already pulls a tiny device
+state vector to the host every ``PADDLE_GUARD_SYNC_EVERY`` steps through
+a one-interval async prefetch — the ONLY recurring device→host read the
+training loop makes. Step metrics piggyback on exactly that read: when
+the guard's deferred host copy lands, the sampler combines
+
+- the already-hosted guard floats (last loss, loss/gnorm EWMAs, skip
+  totals — no new device read),
+- host wall-clock deltas between sync points (dispatch-side step time:
+  with the pipeline full this converges to true device step time),
+- per-step example/token counts taken from STATIC input shapes at
+  capture time (host ints, no sync),
+- best-effort device memory stats from the runtime allocator
+  (``Device.memory_stats()`` — a host query of the allocator's
+  counters, not a device program sync; None off-TPU),
+
+into one ``step_metrics`` bus row. Zero new per-step host syncs by
+construction — the cadence test asserts the device-read count is
+bitwise unchanged vs a guard-only run.
+
+``PADDLE_OBS_STEP_METRICS=0`` disables the records (the guard cadence
+itself is untouched). With the guard off (``PADDLE_GUARD_MODE=off``)
+there is no host-read cadence to ride, so no records are produced —
+turn the guard on to get step metrics; that is the design contract, not
+a limitation (a metrics-only cadence would ADD the sync the guard
+already paid for).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from . import bus
+
+__all__ = ["StepMetricsSampler", "step_metrics_enabled", "device_memory"]
+
+_ENABLE_ENV = "PADDLE_OBS_STEP_METRICS"
+
+
+def step_metrics_enabled() -> bool:
+    v = os.environ.get(_ENABLE_ENV, "1").strip().lower()
+    return v not in ("0", "false", "off")
+
+
+def device_memory() -> Optional[dict]:
+    """Allocator counters of the first local device (bytes_in_use /
+    peak_bytes_in_use), or None when the backend doesn't report them
+    (CPU) or jax isn't up. A runtime bookkeeping query — no dispatch,
+    no device sync."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — metrics stay best-effort
+        return None
+    if not stats:
+        return None
+    return {
+        k: int(stats[k])
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+        if k in stats
+    }
+
+
+class StepMetricsSampler:
+    """Owned by a TrainGuard; fed per-step counters at capture time and
+    flushed at each completed host read.
+
+    ``tick`` is on the per-step path: integer adds on static shape
+    attributes only. ``sample`` runs once per sync interval with the
+    guard state ALREADY on the host.
+    """
+
+    def __init__(self):
+        self.enabled = step_metrics_enabled()
+        self._t_last: Optional[float] = None
+        self._step_last = 0
+        self._examples = 0
+        self._tokens = 0
+
+    def tick(self, inputs) -> None:
+        """Per-step accounting from static input shapes (host ints)."""
+        if not self.enabled:
+            return
+        x = inputs[0] if inputs else None
+        shape = getattr(x, "shape", None)
+        if not shape:
+            return
+        n = int(shape[0])
+        self._examples += n
+        if len(shape) >= 2:
+            self._tokens += n * int(shape[1])
+
+    def sample(self, step: int, guard_last) -> None:
+        """Emit one ``step_metrics`` row for the window ending at
+        ``step`` (the guard's newest host-read state vector rides in
+        ``guard_last`` as plain floats)."""
+        if not self.enabled or not bus.enabled():
+            return
+        now = time.perf_counter()
+        t0, s0 = self._t_last, self._step_last
+        self._t_last, self._step_last = now, step
+        examples, tokens = self._examples, self._tokens
+        self._examples = self._tokens = 0
+        if t0 is None or step <= s0:
+            return  # first window: no baseline to difference against
+        dt = now - t0
+        nsteps = step - s0
+        payload = {
+            "steps": nsteps,
+            "step_ms": round(dt / nsteps * 1e3, 3),
+            "loss": float(guard_last[7]),
+            "loss_ewma": float(guard_last[3]),
+            "gnorm": float(guard_last[4]),
+            "gnorm_ewma": float(guard_last[8]),
+            "consec_bad": int(guard_last[0]),
+            "total_skips": int(guard_last[1]),
+            "total_spikes": int(guard_last[2]),
+        }
+        if dt > 0:
+            if examples:
+                payload["examples_per_sec"] = round(examples / dt, 2)
+            if tokens:
+                payload["tokens_per_sec"] = round(tokens / dt, 1)
+        mem = device_memory()
+        if mem:
+            payload["device_memory"] = mem
+        bus.emit("step_metrics", payload, step=step)

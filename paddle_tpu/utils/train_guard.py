@@ -59,7 +59,6 @@ Knobs (all documented in the README "Training guardrails" table)::
 """
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time
@@ -261,20 +260,16 @@ def mask_step(ok, new_tree, old_tree):
 
 
 def emit_event(kind: str, **fields) -> None:
-    """Append one JSON line to PADDLE_GUARD_EVENT_FILE (no-op unless the
-    launcher — or a test — pointed it somewhere). Same shape contract as
-    the comm-monitor event stream: ``event`` + ``time`` + detail."""
-    path = os.environ.get(_EVENT_ENV)
-    if not path:
-        return
-    row = {"event": kind, "time": time.time(),
-           "rank": int(os.environ.get("PADDLE_TRAINER_ID", "0"))}
-    row.update(fields)
-    try:
-        with open(path, "a") as f:
-            f.write(json.dumps(row) + "\n")
-    except OSError:
-        pass  # diagnostics must never take the trainer down
+    """Emit one guard event through the telemetry bus
+    (observability/bus.py). The legacy flat-format line still lands on
+    ``PADDLE_GUARD_EVENT_FILE`` when the launcher set it (the
+    ElasticManager's kill-attribution reader is unchanged); the unified
+    schema row additionally lands on the per-rank bus stream when
+    ``PADDLE_OBS_DIR``/``PADDLE_OBS_BUS_FILE`` is configured."""
+    from ..observability import bus as _bus
+
+    _bus.emit(kind, fields, step=fields.get("step"),
+              legacy_env=_EVENT_ENV)
 
 
 # ---------------------------------------------------------------------------
@@ -371,6 +366,12 @@ class TrainGuard:
             sync_every if sync_every is not None else _envi(_SYNC_ENV, 4),
             1)
         self._model_ref = weakref.ref(model) if model is not None else None
+        # step-metrics sampler (observability/metrics.py): rides THIS
+        # guard's sync cadence — its records cost no device reads beyond
+        # the async prefetch the guard already pays for
+        from ..observability.metrics import StepMetricsSampler
+
+        self._sampler = StepMetricsSampler()
         self._step = 0
         self._ring: deque = deque(maxlen=2 * self.sync_every + 4)
         self._pending = None     # (step, state_array) async-prefetched
@@ -429,6 +430,7 @@ class TrainGuard:
         """Ring-buffer this step's replay seed (device refs; nothing is
         copied to host unless a bundle is actually dumped)."""
         self._step += 1
+        self._sampler.tick(inputs)   # host ints off static shapes
         if os.environ.get(_DUMP_ENV):
             self._ring.append(
                 _StepRecord(self._step, key, tuple(inputs), tuple(labels)))
@@ -453,6 +455,9 @@ class TrainGuard:
 
         self._last = [float(v) for v in np.asarray(arr)]
         self._last_step = step
+        # the host read just landed: the step-metrics record reuses its
+        # floats (plus wall-clock deltas) — no additional device access
+        self._sampler.sample(step, self._last)
         return self._policy(step)
 
     def _sync_pending(self) -> None:
@@ -493,6 +498,16 @@ class TrainGuard:
             )
             print(f"paddle_tpu.train_guard: {self._describe(step)}",
                   file=sys.stderr, flush=True)
+            # capture-on-anomaly: the first observed bad step arms a
+            # bounded device-trace window over the NEXT steps (no-op
+            # without a configured trace destination; at most
+            # PADDLE_OBS_TRACE_MAX windows per process)
+            if os.environ.get("PADDLE_OBS_TRACE_ON_TRIP",
+                              "1").strip().lower() not in ("0", "false",
+                                                           "off"):
+                from .. import profiler as _prof
+
+                _prof.arm_trace(reason="guard_trip")
         if consec < self.max_skips:
             return None
         # budget exhausted: rescue

@@ -171,6 +171,71 @@ class TestHostSyncInStep:
         assert not errors
         assert not names(findings, "host-sync-in-step")
 
+    # ISSUE 8 satellite: the telemetry emit API is host-side by contract
+    # — an emit reachable from a compiled-region body fires at trace
+    # time (one ghost row per compile) with tracer reprs in the payload.
+    EMIT_PRE_FIX = """
+        import jax
+        from paddle_tpu.observability import bus
+        from paddle_tpu.utils.train_guard import emit_event
+
+        class TrainStep:
+            def _step_fn(self, p_raws, x):
+                loss = (p_raws[0] * x).sum()
+                bus.emit("step_metrics", {"loss": loss})
+                emit_event("guard_skip", loss=loss)
+                return loss
+
+            def __call__(self, x):
+                return jax.jit(self._step_fn)(self.p, x)
+    """
+    # the shipped shape: the step RETURNS its state; the host monitor
+    # emits on the interval-synced read (train_guard.observe)
+    EMIT_FIXED = """
+        import jax
+        from paddle_tpu.observability import bus
+
+        class TrainStep:
+            def _step_fn(self, p_raws, x):
+                loss = (p_raws[0] * x).sum()
+                return loss
+
+            def __call__(self, x):
+                loss = jax.jit(self._step_fn)(self.p, x)
+                bus.emit("step_metrics", {"loss": float(loss)})
+                return loss
+    """
+
+    def test_bus_emit_in_step_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.EMIT_PRE_FIX},
+                      rule="host-sync-in-step")
+        msgs = [f.message for f in names(fs, "host-sync-in-step")]
+        assert any("bus.emit" in m for m in msgs), msgs
+        assert any("emit_event" in m for m in msgs), msgs
+
+    def test_bus_emit_on_host_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.EMIT_FIXED},
+                      rule="host-sync-in-step")
+        assert not names(fs, "host-sync-in-step")
+
+    def test_real_observability_emitters_quiet(self):
+        """The shipped emitters (guard monitor, comm monitor, metrics
+        sampler) emit from host-side code only — the full-module sweep
+        of the new surface stays clean."""
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "observability", "bus.py"),
+             os.path.join(REPO, "paddle_tpu", "observability",
+                          "metrics.py"),
+             os.path.join(REPO, "paddle_tpu", "observability",
+                          "ledger.py"),
+             os.path.join(REPO, "paddle_tpu", "utils", "train_guard.py"),
+             os.path.join(REPO, "paddle_tpu", "distributed",
+                          "comm_monitor.py")],
+            rules={"host-sync-in-step"}, root=REPO,
+        )
+        assert not errors
+        assert not names(findings, "host-sync-in-step")
+
 
 class TestDonationAlias:
     # PR-5 pre-fix: the guard carry donated alongside params/opt state

@@ -67,6 +67,8 @@ def metric_direction(name: str):
         return None
     if name.endswith("_compile_s"):
         return None  # warm-cache artifact, not a perf metric
+    if name.endswith("_mfu_pct") or name == "compile_count":
+        return None  # observability trend lines (mfu_report), never gated
     if "per_sec" in name:
         return 1
     if name.endswith("_ms") or name.endswith("_s"):
@@ -180,6 +182,29 @@ def multichip_compile_report(root: str):
     return lines
 
 
+def mfu_report(prev: dict, cur: dict):
+    """REPORT-ONLY drift of the ISSUE-8 observability keys between two
+    bench rounds: per-model ``*_mfu_pct`` (achieved-FLOPs utilization —
+    moves with every legitimate model change, so a trend line, not a
+    gate) and ``compile_count`` (recompile-ledger total: a jump means a
+    new recompile source landed in the benched path)."""
+    pe, ce = (prev.get("extra") or {}), (cur.get("extra") or {})
+    keys = sorted(
+        k for k in set(pe) | set(ce)
+        if k.endswith("_mfu_pct") or k == "compile_count"
+    )
+    lines = []
+    for k in keys:
+        a, b = pe.get(k), ce.get(k)
+        if not isinstance(b, (int, float)):
+            continue
+        if isinstance(a, (int, float)):
+            lines.append(f"  report  {k}: {a:g} -> {b:g} (not gated)")
+        else:
+            lines.append(f"  report  {k}: {b:g} (new)")
+    return lines
+
+
 def check(root: str):
     """-> (exit_code, report_lines)."""
     pair = load_latest_pair(root)
@@ -230,6 +255,7 @@ def check(root: str):
         else:
             lines.append(f"  warn    guard_overhead_pct: {gp:g}% > "
                          f"{GUARD_OVERHEAD_PCT:g}% (single-shot round)")
+    lines.extend(mfu_report(prev, cur))
     lines.extend(multichip_compile_report(root))
     if rc:
         lines.append(

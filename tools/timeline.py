@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Merge per-rank telemetry-bus streams (+ collective flight-recorder
+dumps) into ONE chrome-trace JSON and a human summary table (ISSUE 8
+tentpole e).
+
+Input: an observability dir — what the elastic launcher provisions as
+``PADDLE_OBS_DIR`` (next to the workerlogs, where
+``PADDLE_COLL_DEBUG_DIR`` drops ``comm_dump.rank*.json``):
+
+    telemetry.rank0.jsonl       per-rank unified bus streams
+    telemetry.rank1.jsonl       (observability/bus.py schema)
+    telemetry.launcher.jsonl    manager events (rank -1), when present
+    comm_dump.rank*.json        flight-recorder dumps, when present
+
+Output:
+
+* ``--out trace.json`` — chrome://tracing / Perfetto-loadable JSON:
+  one process per rank; ``step_metrics`` rows become counter tracks
+  (loss, step_ms, tokens/sec), ``recompile`` rows duration slices of
+  their compile seconds, flight-recorder records duration slices on a
+  ``collectives`` track, everything else instant events.
+* stdout — the summary table: per-rank step timing percentiles,
+  throughput, guard trips, recompiles (+ seconds), an EXPOSED-COMM
+  estimate (eager-collective wall time from the flight recorder over
+  the covered window — a lower bound: in-graph collectives don't pass
+  through the eager monitor), and the slowest-ranks ranking that
+  pod-scale debugging starts from (MLPerf-on-pods, PAPERS.md).
+
+Stdlib-pure: loads the bus parser standalone, no jax import, safe on a
+login node against a dir rsync'd off the pod.
+
+Usage:
+    python tools/timeline.py <obs_dir> [--out trace.json] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _load_bus():
+    """The bus module, standalone (no paddle_tpu package import — that
+    would pull jax into a tool meant for login nodes)."""
+    mod = sys.modules.get("paddle_tpu.observability.bus")
+    if mod is not None:
+        return mod
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(here), "paddle_tpu",
+                        "observability", "bus.py")
+    spec = importlib.util.spec_from_file_location("_pdtpu_obs_bus", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def read_flight_dumps(obs_dir: str) -> Dict[int, List[dict]]:
+    """comm_dump.rank*.json records keyed by rank (comm_monitor
+    flight-recorder format: op/seq/t_start/t_done/status)."""
+    out: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(obs_dir,
+                                              "comm_dump.rank*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rank = int(d.get("rank", -1))
+        recs = [r for r in d.get("records", []) if isinstance(r, dict)]
+        if recs:
+            out[rank] = recs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# chrome trace
+# ---------------------------------------------------------------------------
+
+#: counter tracks extracted from step_metrics payloads
+_COUNTERS = ("loss", "step_ms", "tokens_per_sec", "examples_per_sec",
+             "gnorm")
+
+
+def chrome_trace(streams: Dict[int, List[dict]],
+                 dumps: Dict[int, List[dict]]) -> dict:
+    """Merge bus streams + flight-recorder dumps into a chrome-trace
+    dict ({"traceEvents": [...]}, ts in microseconds, one pid per
+    rank)."""
+    events: List[dict] = []
+    t0 = None
+    for rows in streams.values():
+        for r in rows:
+            t = r.get("time")
+            if isinstance(t, (int, float)):
+                t0 = t if t0 is None else min(t0, t)
+    for recs in dumps.values():
+        for r in recs:
+            t = r.get("t_start")
+            if isinstance(t, (int, float)):
+                t0 = t if t0 is None else min(t0, t)
+    if t0 is None:
+        t0 = 0.0
+
+    def us(t) -> float:
+        return max((float(t) - t0) * 1e6, 0.0)
+
+    for rank, rows in sorted(streams.items()):
+        pname = "launcher" if rank < 0 else f"rank {rank}"
+        events.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "args": {"name": pname}})
+        for r in rows:
+            kind = r.get("kind", "?")
+            t = r.get("time", t0)
+            payload = r.get("payload") or {}
+            if kind == "step_metrics":
+                args = {k: payload[k] for k in _COUNTERS if k in payload}
+                if args:
+                    events.append({"ph": "C", "name": "step_metrics",
+                                   "pid": rank, "ts": us(t),
+                                   "args": args})
+                continue
+            if kind == "recompile":
+                dur = float(payload.get("compile_wall_s", 0.0)) * 1e6
+                events.append({
+                    "ph": "X", "name": f"compile:{payload.get('label')}",
+                    "pid": rank, "tid": "compiles",
+                    "ts": max(us(t) - dur, 0.0), "dur": dur,
+                    "args": {"ordinal": payload.get("ordinal"),
+                             "changed": payload.get("changed")},
+                })
+                continue
+            events.append({
+                "ph": "i", "name": kind, "pid": rank, "tid": kind.split(
+                    "_")[0], "ts": us(t), "s": "p",
+                "args": {"step": r.get("step"), **{
+                    k: v for k, v in payload.items()
+                    if isinstance(v, (str, int, float, bool))
+                }},
+            })
+    for rank, recs in sorted(dumps.items()):
+        if rank not in streams:
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": rank, "args": {"name": f"rank {rank}"}})
+        for rec in recs:
+            ts, td = rec.get("t_start"), rec.get("t_done")
+            if not isinstance(ts, (int, float)):
+                continue
+            dur = ((td - ts) if isinstance(td, (int, float)) else 0.0) * 1e6
+            events.append({
+                "ph": "X", "name": rec.get("op", "?"), "pid": rank,
+                "tid": "collectives", "ts": us(ts), "dur": max(dur, 0.0),
+                "args": {k: rec.get(k) for k in
+                         ("seq", "group", "shape", "dtype", "status",
+                          "site")},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# human summary
+# ---------------------------------------------------------------------------
+
+
+def _median(vals: List[float]) -> Optional[float]:
+    if not vals:
+        return None
+    sv = sorted(vals)
+    return sv[len(sv) // 2]
+
+
+def _rank_stats(rows: List[dict], coll: List[dict]) -> dict:
+    metrics = [r["payload"] for r in rows if r.get("kind") == "step_metrics"]
+    step_ms = [m["step_ms"] for m in metrics
+               if isinstance(m.get("step_ms"), (int, float))]
+    toks = [m["tokens_per_sec"] for m in metrics
+            if isinstance(m.get("tokens_per_sec"), (int, float))]
+    steps = [r.get("step") for r in rows if isinstance(r.get("step"), int)]
+    recompiles = [r["payload"] for r in rows if r.get("kind") == "recompile"]
+    storms = [r for r in rows if r.get("kind") == "recompile_storm"]
+    guard = [r for r in rows if str(r.get("kind", "")).startswith("guard_")]
+    coll_s = 0.0
+    coll_n = 0
+    window: Tuple[Optional[float], Optional[float]] = (None, None)
+    for rec in coll:
+        ts, td = rec.get("t_start"), rec.get("t_done")
+        if isinstance(ts, (int, float)) and isinstance(td, (int, float)):
+            coll_s += max(td - ts, 0.0)
+            coll_n += 1
+            lo, hi = window
+            window = (ts if lo is None else min(lo, ts),
+                      td if hi is None else max(hi, td))
+    times = [r.get("time") for r in rows
+             if isinstance(r.get("time"), (int, float))]
+    lo, hi = window
+    for t in times:
+        lo = t if lo is None else min(lo, t)
+        hi = t if hi is None else max(hi, t)
+    span = (hi - lo) if (lo is not None and hi is not None) else 0.0
+    return {
+        "events": len(rows),
+        "last_step": max(steps) if steps else None,
+        "median_step_ms": _median(step_ms),
+        "tokens_per_sec": _median(toks),
+        "guard_trips": len(guard),
+        "recompiles": len(recompiles),
+        "compile_s": round(sum(
+            float(p.get("compile_wall_s", 0.0)) for p in recompiles), 2),
+        "storms": [r["payload"].get("detail", "") for r in storms],
+        "coll_n": coll_n,
+        "coll_s": round(coll_s, 3),
+        "exposed_comm_pct": (round(coll_s / span * 100.0, 1)
+                             if span > 0 and coll_s else None),
+    }
+
+
+def summarize(streams: Dict[int, List[dict]],
+              dumps: Dict[int, List[dict]]) -> List[str]:
+    lines: List[str] = []
+    ranks = sorted(r for r in set(streams) | set(dumps) if r >= 0)
+    if not ranks and -1 not in streams:
+        return ["timeline: no telemetry streams found"]
+    stats = {r: _rank_stats(streams.get(r, []), dumps.get(r, []))
+             for r in ranks}
+    lines.append(
+        f"{'rank':>4}  {'steps':>6}  {'med step_ms':>11}  "
+        f"{'tok/s':>9}  {'guard':>5}  {'recompiles':>10}  "
+        f"{'compile_s':>9}  {'coll_s':>7}  {'exposed%':>8}")
+    for r in ranks:
+        s = stats[r]
+        fmt = lambda v, nd=2: ("-" if v is None else
+                               f"{v:.{nd}f}" if isinstance(v, float) else
+                               str(v))
+        lines.append(
+            f"{r:>4}  {fmt(s['last_step']):>6}  "
+            f"{fmt(s['median_step_ms']):>11}  "
+            f"{fmt(s['tokens_per_sec'], 0):>9}  {s['guard_trips']:>5}  "
+            f"{s['recompiles']:>10}  {fmt(s['compile_s']):>9}  "
+            f"{fmt(s['coll_s'], 3):>7}  "
+            f"{fmt(s['exposed_comm_pct'], 1):>8}")
+    timed = [(s["median_step_ms"], r) for r, s in stats.items()
+             if s["median_step_ms"] is not None]
+    if len(timed) > 1:
+        timed.sort(reverse=True)
+        worst = ", ".join(f"rank {r} ({ms:.2f}ms)" for ms, r in timed[:3])
+        lines.append(f"slowest ranks: {worst}")
+    for r in ranks:
+        for detail in stats[r]["storms"]:
+            lines.append(f"RECOMPILE STORM rank {r}: {detail}")
+    trips = sum(s["guard_trips"] for s in stats.values())
+    if trips:
+        lines.append(f"guard events: {trips} across "
+                     f"{sum(1 for s in stats.values() if s['guard_trips'])}"
+                     f" rank(s) — see guard_* rows / replay bundles")
+    launcher = streams.get(-1, [])
+    if launcher:
+        kinds = {}
+        for r in launcher:
+            kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
+        lines.append("launcher: " + ", ".join(
+            f"{k} x{n}" for k, n in sorted(kinds.items())))
+    return lines
+
+
+def merge(obs_dir: str):
+    """(streams, dumps, chrome_trace_dict, summary_lines) for a dir."""
+    bus = _load_bus()
+    streams = bus.rank_streams(obs_dir)
+    dumps = read_flight_dumps(obs_dir)
+    return streams, dumps, chrome_trace(streams, dumps), summarize(
+        streams, dumps)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("obs_dir", help="PADDLE_OBS_DIR of the run")
+    ap.add_argument("--out", default=None,
+                    help="write chrome-trace JSON here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.obs_dir):
+        print(f"timeline: {args.obs_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    streams, dumps, trace, lines = merge(args.obs_dir)
+    if not streams and not dumps:
+        print(f"timeline: no telemetry.rank*.jsonl / comm_dump.rank*.json "
+              f"in {args.obs_dir}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+        n = len(trace["traceEvents"])
+        print(f"chrome trace: {args.out} ({n} events; load in "
+              f"chrome://tracing or https://ui.perfetto.dev)")
+    if args.json:
+        ranks = sorted(r for r in set(streams) | set(dumps) if r >= 0)
+        print(json.dumps({
+            str(r): _rank_stats(streams.get(r, []), dumps.get(r, []))
+            for r in ranks}))
+    else:
+        print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

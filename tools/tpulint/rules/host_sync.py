@@ -17,6 +17,13 @@ Statically: inside compiled-region functions (anything reachable from a
 * ``np.asarray(x)`` / ``np.array(x)`` with a traced argument
 * ``float(x)`` / ``int(x)`` / ``bool(x)`` with a traced argument
   (``int(x.shape[i])`` is static under trace and stays quiet)
+* telemetry-bus emits (ISSUE 8): ``emit_event(...)`` (train_guard) and
+  ``bus.emit(...)`` / ``emit(...)`` — emits are host-side BY CONTRACT
+  (a wall-clock read + file append); inside a compiled body they run at
+  trace time (one ghost row per compile, none per step) and any traced
+  value in the payload dies a tracer repr. Emit from the host loop on
+  the step's RETURNED state instead — that is exactly what the guard's
+  interval-synced monitor does.
 """
 from __future__ import annotations
 
@@ -27,6 +34,23 @@ from ..core import Rule, register
 
 _METHOD_SYNCS = {"item", "numpy", "tolist"}
 _CAST_SYNCS = {"float", "int", "bool"}
+#: dotted qualifiers that identify an `emit(...)` call as the telemetry
+#: bus API (the bare `emit_event` name is the guard's and always counts)
+_EMIT_QUALIFIERS = {"bus", "_bus", "_obs_bus", "telemetry", "_telemetry",
+                    "obs", "_obs", "observability"}
+
+
+def _telemetry_emit(d: str) -> bool:
+    parts = d.split(".")
+    t = parts[-1]
+    if t == "emit_event":
+        return True
+    if t != "emit":
+        return False
+    quals = parts[:-1]
+    return not quals or any(
+        q in _EMIT_QUALIFIERS or q.endswith("bus") for q in quals
+    )
 
 
 @register
@@ -86,4 +110,15 @@ class HostSyncInStepRule(Rule):
                         f"{t}() on a traced value {where} — a host "
                         "sync under concrete execution and a trace "
                         "error under jit; keep it an array",
+                    )
+                elif t in ("emit", "emit_event") and _telemetry_emit(d):
+                    yield self.finding(
+                        mod, node,
+                        f"telemetry emit `{d}(...)` {where} — bus emits "
+                        "are host-side by contract (wall clock + file "
+                        "append): under trace this fires once per "
+                        "COMPILE, not per step, and traced payload "
+                        "values log as tracer reprs; emit from the host "
+                        "loop on the step's returned state (the guard's "
+                        "interval-synced monitor is the pattern)",
                     )
