@@ -411,6 +411,81 @@ def _bench_gpt_multichip(steps=10, seq=1024, shard_off=False):
     }
 
 
+def _bench_decode(batch_sizes=(1, 8, 64), prompt_len=128, new_tokens=64):
+    """Serving bench (ISSUE 9): the compiled prefill/decode pair over
+    the GPT-medium-shaped TransformerLM (same decoder the training
+    bench prices).
+
+    Throughput: `generate()` at batch 1/8/64 — the loop state stays on
+    device and the host syncs ONCE at the end, so the number is the
+    device's steady decode rate (`serve_gpt_medium_tokens_per_sec_bN`).
+
+    Latency: batch 1 with a host sync after EVERY token — the per-token
+    time a single-stream client observes (`serve_gpt_medium_token_p50_ms`
+    / `_p99_ms`), plus the bucketed prefill cost
+    (`serve_gpt_medium_prefill_ms`). All keys land under the
+    tools/bench_continuity.py >10% gate (per_sec higher-better, _ms
+    lower-better)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import DecodeState, DecodeStep, PrefillStep
+    from paddle_tpu.serving import generate
+    from paddle_tpu.serving.model import TransformerLM
+
+    paddle.seed(0)
+    cap = prompt_len + new_tokens
+    model = TransformerLM(32000, d_model=1024, num_heads=16,
+                          num_layers=24, max_position=cap)
+    model.eval()
+    pre = PrefillStep(model)
+    dec = DecodeStep(model)
+    out = {}
+    for B in batch_sizes:
+        prompts = (np.arange(B * prompt_len) % 31000).reshape(
+            B, prompt_len).astype(np.int32)
+        # warm (compiles prefill for this B + the decode step once)
+        _ = generate(model, prompts, 2, max_length=cap, prefill=pre,
+                     decode=dec)
+        t0 = time.perf_counter()
+        toks = generate(model, prompts, new_tokens, max_length=cap,
+                        prefill=pre, decode=dec)
+        assert toks.shape == (B, new_tokens)
+        dt = time.perf_counter() - t0
+        out[f"serve_gpt_medium_tokens_per_sec_b{B}"] = round(
+            B * new_tokens / dt, 1)
+
+    # batch-1 per-token latency: sync every step (client view). The
+    # prompt pads to the SAME bucket the warm generate() used, so
+    # prefill_ms prices the warm compiled program, not a fresh compile.
+    from paddle_tpu.serving.engine import bucket_for
+
+    bucket = bucket_for(prompt_len, cap)
+    prompts = np.zeros((1, bucket), np.int32)
+    prompts[0, :prompt_len] = np.arange(prompt_len) % 31000
+    t0 = time.perf_counter()
+    last, cache_raws, pos = pre(
+        model.gen_cache(1, cap), prompts,
+        np.full((1,), prompt_len, np.int32))
+    first = jnp.argmax(last, -1).astype(jnp.int32)
+    _ = np.asarray(first)
+    out["serve_gpt_medium_prefill_ms"] = round(
+        (time.perf_counter() - t0) * 1e3, 2)
+    state = DecodeState.make(cache_raws, first, pos)
+    lat = []
+    for _ in range(new_tokens - 1):
+        t0 = time.perf_counter()
+        emit, _, state = dec(state)
+        _ = np.asarray(emit)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+    out["serve_gpt_medium_token_p50_ms"] = round(
+        lat[len(lat) // 2], 2)
+    out["serve_gpt_medium_token_p99_ms"] = round(
+        lat[min(int(len(lat) * 0.99), len(lat) - 1)], 2)
+    return out
+
+
 def _bench_flash_attention(steps=500):
     """Long-context attention: the Pallas flash kernel vs XLA dense at
     S=2048 causal. The `steps` iterations run INSIDE one jitted lax.scan
@@ -617,6 +692,21 @@ def main():
         # single-shot by design: 500 iterations already run inside ONE
         # dispatched lax.scan, so the device time is self-averaged
         extra.update(_bench_flash_attention())
+
+    # serving bench (ISSUE 9): decode tokens/sec at batch 1/8/64 +
+    # batch-1 per-token p50/p99 and prefill cost over the compiled
+    # PrefillStep/DecodeStep pair. Median-of-REPEATS like every other
+    # metric; the throughput/latency keys land under the continuity
+    # gate. PADDLE_BENCH_SERVE=0 skips (the decode sweep adds minutes
+    # on a CPU smoke run).
+    if os.environ.get("PADDLE_BENCH_SERVE", "1") not in ("0", "false"):
+        serve_tok, serve_bd, serve_sp = _repeat(
+            lambda: (lambda d: (
+                d["serve_gpt_medium_tokens_per_sec_b8"], d))(
+                _bench_decode())
+        )
+        extra.update(serve_bd)
+        extra["serve_gpt_medium_tokens_per_sec_b8_spread"] = serve_sp
     # r04 measured the same model/optimizer at batch 64 with two-pass
     # f32-blacklisted batch norm: 41.78 ms / 64 imgs = 1531.7 imgs/sec
     extra["vs_r04_resnet50_bf16"] = round(r50_bf16_ips / 1531.7, 2)

@@ -260,7 +260,47 @@ class ParallelMultiHeadAttention(Layer):
             bias_attr=bias_attr, input_is_parallel=True,
         )
 
-    def forward(self, x):
+    def gen_cache(self, batch_size, max_length, dtype=None):
+        """Static-capacity decode cache (ISSUE 9): zero [B, H, cap, Dh]
+        K/V buffers in the same MultiHeadAttention.Cache namedtuple the
+        single-chip layer uses, laid out with heads sharded over 'mp'
+        (matching the attention compute) when the mesh is real — the
+        compiled DecodeStep then updates each shard's slice in place."""
+        import jax.numpy as jnp
+
+        from ..nn.layers.transformer import MultiHeadAttention
+
+        H, dh = self.num_heads, self.head_dim
+        dt = dtype or self._dtype  # follow the layer dtype (bf16 models
+        #                            get bf16 caches, like the 1-chip MHA)
+        shape = (int(batch_size), H, int(max_length), dh)
+        mp = int(self.mesh.shape["mp"])
+        # batch shards over the data-parallel axes when divisible (dp
+        # slots each store/decode only their shard — dp actually scales
+        # serving memory + throughput), heads over mp; indivisible dims
+        # stay replicated, which is correct but redundant
+        bax = comm.dp_axes(self.mesh)
+        baxes = (bax,) if isinstance(bax, str) else tuple(bax)
+        bdeg = 1
+        for a in baxes:
+            if a in self.mesh.shape:
+                bdeg *= int(self.mesh.shape[a])
+        bspec = None
+        if bdeg > 1 and int(batch_size) % bdeg == 0:
+            bspec = baxes[0] if len(baxes) == 1 else tuple(baxes)
+        spec = P(bspec, "mp" if (mp > 1 and H % mp == 0) else None,
+                 None, None)
+        out = []
+        for _ in range(2):
+            z = jnp.zeros(shape, dt)
+            if self.mesh.size > 1:
+                z = jax.device_put(z, NamedSharding(self.mesh, spec))
+            # _wrap, not Tensor(): the ctor's dtype inference would
+            # np.asarray the buffer — a device read per cache allocation
+            out.append(Tensor._wrap(z))
+        return MultiHeadAttention.Cache(out[0], out[1])
+
+    def forward(self, x, cache=None, pos=None):
         from .. import ops
 
         B, T = x.shape[0], x.shape[1]
@@ -271,6 +311,30 @@ class ParallelMultiHeadAttention(Layer):
         qkv = _constrain(qkv, self.mesh, P(None, None, "mp", None, None))
         q, k, v = qkv[0], qkv[1], qkv[2]  # [B, H, T, dh]
         from ..nn.functional import attention as attn_route
+
+        if cache is not None:
+            # static-capacity decode-append: write this step's K/V rows
+            # at per-slot `pos`, attend position-masked over the full
+            # capacity. Plain XLA ops throughout, so GSPMD partitions
+            # them over (dp -> batch, mp -> heads) exactly like the
+            # training path — no shard_map seam needed (a traced pos
+            # cannot feed the flash kernel's static q_offset anyway).
+            if pos is None:
+                raise ValueError(
+                    "cache decoding needs `pos` (per-slot write "
+                    "positions [B] int32)"
+                )
+            from ..nn.layers.transformer import MultiHeadAttention
+
+            k = attn_route.cache_update(cache.k, k, pos)
+            v = attn_route.cache_update(cache.v, v, pos)
+            new_cache = MultiHeadAttention.Cache(k, v)
+            ctx = attn_route.cached_attention(
+                q, k, v, pos, scale=dh ** -0.5
+            )
+            ctx = ctx.transpose([0, 2, 1, 3]).reshape([B, T, H * dh])
+            ctx = _constrain(ctx, self.mesh, P(None, None, "mp"))
+            return self.out_proj(ctx), new_cache
 
         route_flash = self.use_flash_attention
         plan = None
@@ -349,19 +413,27 @@ class ParallelGPTBlock(Layer):
         self.fc2 = RowParallelLinear(ffn, d_model, input_is_parallel=True)
         self.dropout = dropout
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), cache=cache, pos=pos)
+        else:
+            a, new_cache = self.attn(self.ln1(x)), None
         # residual-add + LN fused in one Pallas pass on TPU (the sum is
         # formed once; both the residual stream and its normalization
         # come back) — dense x+LN fallback elsewhere
         h, n2 = F.fused_residual_layer_norm(
-            x, self.attn(self.ln1(x)), [self._d_model],
+            x, a, [self._d_model],
             self.ln2.weight, self.ln2.bias, self.ln2._epsilon,
             mesh=self.mesh,
         )
         m = F.gelu(self.fc1(n2))
         if self.dropout:
             m = F.dropout(m, p=self.dropout, training=self.training)
-        return h + self.fc2(m)
+        out = h + self.fc2(m)
+        return out if new_cache is None else (out, new_cache)
+
+    def gen_cache(self, batch_size, max_length, dtype=None):
+        return self.attn.gen_cache(batch_size, max_length, dtype)
 
 
 def split(x, size, operation: str, axis: int = 0, num_partitions: Optional[int] = None,

@@ -1,0 +1,356 @@
+"""Compiled single-token decode step + bucketed prefill (ISSUE 9).
+
+`jit.DecodeStep` mirrors `jit.TrainStep`'s mechanics for the OTHER hot
+loop: model forward (with the static-capacity KV-cache seam) + in-graph
+sampling compiled as ONE XLA program per token, with
+
+- **donated cache buffers** — the [B, H, cap, Dh] K/V caches are
+  replaced every step (written in place at per-slot positions), so XLA
+  updates them in HBM instead of copying; like TrainStep, donation is
+  skipped on the CPU backend;
+- **recompile-ledger instrumentation** — the jitted step dispatches
+  through `observability.ledger.instrument` (labels ``DecodeStep`` /
+  ``PrefillStep``), so a shape wobble in the serving loop lands on the
+  bus as a named `recompile` row and the "compiles once per bucket"
+  contract is assertable;
+- **mesh-aware routing** — params/caches are placement-normalized onto
+  the hybrid mesh exactly like TrainStep (mixed placements re-trace the
+  program once on the second call) and state outputs are pinned to
+  their input shardings; the decode attention itself is plain XLA, so
+  GSPMD partitions it over (dp -> batch, mp -> heads) with no seam.
+
+The decode loop's state (`DecodeState`) is DEVICE-RESIDENT: tokens,
+positions, done flags and the RNG key never visit the host between
+steps — zero per-token host syncs by construction (the counted-transfer
+test in tests/test_serving.py asserts it). Stop conditions are folded
+into the graph: a slot whose sampled token hits its per-slot ``eos`` id
+flips its ``done`` flag and emits the sentinel ``-1`` from then on; the
+host reads tokens in one transfer at the end (or on the scheduler's
+readback cadence).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as AG
+from ..core.tensor import Tensor
+from .functional_call import _swapped
+
+__all__ = ["DecodeState", "DecodeStep", "PrefillStep"]
+
+
+def _raw_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, Tensor) else v, tree,
+        is_leaf=lambda v: isinstance(v, Tensor),
+    )
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(Tensor._wrap, tree)
+
+
+def _commit_tree(tree):
+    """Commit every eager-built (uncommitted) array in `tree` to a
+    concrete placement — mesh-replicated on a real hybrid mesh, its
+    current device otherwise. Loop-carried jit OUTPUTS are committed;
+    without this the second call's input signature differs from the
+    first and the whole step silently compiles twice (the TrainStep
+    placement-churn lesson, decode edition — caught by the
+    recompile-ledger 'compiles once' assert)."""
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from ..distributed import comm as _comm
+
+    mesh = _comm.hybrid_mesh()
+    # replicate on the hybrid mesh even when TRIVIAL (size 1): GSPMD
+    # normalizes the step's outputs onto that mesh's NamedSharding, so
+    # SingleDeviceSharding inputs would still flip the signature once
+    # (serving always runs under a declared mesh — the model ctor
+    # installs one — so the TrainStep trivial-mesh/DataParallel-group
+    # conflict does not arise here)
+    target = NamedSharding(mesh, _P()) if mesh is not None else None
+
+    def c(x):
+        if not isinstance(x, jax.Array) or getattr(x, "_committed", True):
+            return x
+        return jax.device_put(x, target if target is not None
+                              else x.sharding)
+
+    return jax.tree_util.tree_map(c, tree)
+
+
+def _pin(tree):
+    """out_shardings pin: NamedSharding leaves keep their input layout
+    (same contract as TrainStep — GSPMD-normalized outputs would change
+    the second call's signature and re-trace the whole step)."""
+    from jax.sharding import NamedSharding as _NS
+
+    return jax.tree_util.tree_map(
+        lambda r: r.sharding
+        if isinstance(getattr(r, "sharding", None), _NS) else None,
+        tree,
+    )
+
+
+#: effectively-unbounded per-slot step budget (the host loop bounds it)
+NO_BUDGET = 1 << 30
+
+
+class DecodeState:
+    """Device-resident decode loop state. Every field is a jax array;
+    the host holds only this container between steps.
+
+    caches  : model KV-cache pytree (raw arrays, static shapes)
+    pos     : [B] int32 — next write position per slot
+    tok     : [B] int32 — token to feed the model this step
+    done    : [B] bool  — slot finished (eos / budget / host-marked)
+    key     : PRNG key threaded through the sampling ops
+    temperature/top_k/top_p : [B] per-slot sampling params
+    eos     : [B] int32 — stop token id per slot (-1 = none)
+    budget  : [B] int32 — remaining decode STEPS per slot; like eos it
+              folds into the in-graph done mask, so heterogeneous
+              max_new_tokens never force the host loop below its sync
+              cadence (NO_BUDGET = bounded by the host loop only)
+    """
+
+    FIELDS = ("caches", "pos", "tok", "done", "key", "temperature",
+              "top_k", "top_p", "eos", "budget")
+    __slots__ = FIELDS
+
+    def __init__(self, caches, pos, tok, done, key, temperature, top_k,
+                 top_p, eos, budget):
+        self.caches = caches
+        self.pos = pos
+        self.tok = tok
+        self.done = done
+        self.key = key
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos = eos
+        self.budget = budget
+
+    def astuple(self):
+        return tuple(getattr(self, f) for f in self.FIELDS)
+
+    @classmethod
+    def make(cls, caches, first_tokens, pos, *, seed=0, temperature=0.0,
+             top_k=0, top_p=1.0, eos_id=None, budget=None):
+        """Build a fresh state from host values (one-time transfer).
+        Scalars broadcast to per-slot [B] vectors. ``budget`` is the
+        remaining step count per slot AFTER the first token (None =
+        unbounded, the host loop terminates the decode)."""
+        tok = jnp.asarray(first_tokens, jnp.int32)
+        B = int(tok.shape[0])
+
+        def vec(v, dtype):
+            return jnp.broadcast_to(jnp.asarray(v, dtype), (B,))
+
+        eos = -1 if eos_id is None else eos_id
+        return cls(
+            caches=_raw_tree(caches),
+            pos=jnp.asarray(pos, jnp.int32),
+            tok=tok,
+            done=jnp.zeros((B,), bool),
+            key=jax.random.PRNGKey(seed),
+            temperature=vec(temperature, jnp.float32),
+            top_k=vec(top_k, jnp.int32),
+            top_p=vec(top_p, jnp.float32),
+            eos=vec(eos, jnp.int32),
+            budget=vec(NO_BUDGET if budget is None else budget,
+                       jnp.int32),
+        )
+
+
+class _CompiledDecodeBase:
+    """Shared TrainStep-style mechanics: placement normalization on the
+    hybrid mesh, the pure model-forward segment, ledger-instrumented
+    lazy jit."""
+
+    _label = "DecodeStep"
+
+    def __init__(self, model, *, donate: bool = True):
+        self.model = model
+        self._p_objs = list(model.parameters())
+        self._b_objs = list(dict(model.named_buffers()).values())
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        from ..distributed import comm as _comm
+
+        mesh = _comm.hybrid_mesh()
+        if mesh is not None and mesh.size <= 1:
+            mesh = None  # trivial mesh = no mesh for placement purposes
+        if mesh is not None:
+            repl = NamedSharding(mesh, _P())
+            for o in self._p_objs + self._b_objs:
+                if not isinstance(
+                    getattr(o._data, "sharding", None), NamedSharding
+                ):
+                    o._data = jax.device_put(o._data, repl)
+        self._donate = donate and jax.default_backend() != "cpu"
+        self._jitted = None
+        self._n_steps = 0
+        from ..observability import bus as _bus, ledger as _ledger
+
+        if _bus.enabled():
+            _ledger.install_backend_listener()
+
+    # -- the pure forward segment -----------------------------------------
+    def _fwd(self, p_raws, b_raws, ids, cache_raws, pos):
+        """Model forward with the KV-cache seam as a pure function of
+        (params, buffers, ids, caches, pos) -> (logits, new caches)."""
+        from .. import profiler as _prof
+
+        objs = self._p_objs + self._b_objs
+        caches = _wrap_tree(cache_raws)
+        with AG.trace_mode(), \
+                _prof.device_annotation(f"{self._label}::forward"), \
+                _swapped(objs, list(p_raws) + list(b_raws)):
+            out, new_caches = self.model(
+                Tensor._wrap(ids), cache=caches, pos=Tensor._wrap(pos)
+            )
+            logits = out._data if isinstance(out, Tensor) else out
+            new_raws = _raw_tree(new_caches)
+        return logits, new_raws
+
+    def _instrumented(self, donate, out_shardings):
+        from ..observability import ledger as _ledger
+
+        return _ledger.instrument(
+            jax.jit(self._step_fn, donate_argnums=donate,
+                    out_shardings=out_shardings),
+            label=self._label, donate=donate,
+        )
+
+    @property
+    def compiles(self) -> Optional[int]:
+        """Ledger-observed compile count of this step (None before the
+        first call) — the 'compiles once per bucket' assert reads it."""
+        return None if self._jitted is None else self._jitted.compiles
+
+
+class DecodeStep(_CompiledDecodeBase):
+    """One compiled single-token step of the decode loop.
+
+    Usage::
+
+        step = paddle_tpu.jit.DecodeStep(model)
+        state = DecodeState.make(model.gen_cache(B, cap), first, pos)
+        emitted, logits, state = step(state)   # all device-side
+
+    ``emitted`` is [B] int32 with ``-1`` for slots that were already
+    done; ``logits`` is the [B, V] f32 pre-sampling distribution of this
+    step (device array — read it only where a sync is acceptable).
+    """
+
+    _label = "DecodeStep"
+
+    def _step_fn(self, p_raws, b_raws, cache_raws, pos, tok, done, key,
+                 temp, top_k, top_p, eos, budget):
+        from ..serving import sampling as _sampling
+
+        logits, new_caches = self._fwd(
+            p_raws, b_raws, tok[:, None], cache_raws, pos
+        )
+        last = logits[:, -1, :].astype(jnp.float32)
+        key, sub = jax.random.split(key)
+        from .. import profiler as _prof
+
+        with _prof.device_annotation("DecodeStep::sample"):
+            nxt = _sampling.sample(last, sub, temp, top_k, top_p)
+        # this step's token spends one unit of the slot's budget; both
+        # stop conditions fold into the done mask IN-GRAPH so the host
+        # loop never has to shrink its readback window below sync_every
+        new_budget = budget - jnp.where(done, 0, 1).astype(budget.dtype)
+        new_done = done | (nxt == eos) | (new_budget <= 0)
+        emit = jnp.where(done, jnp.int32(-1), nxt)
+        # done slots keep feeding token 0 at a frozen position: their
+        # cache writes land on the same already-dead row
+        feed = jnp.where(new_done, jnp.int32(0), nxt)
+        new_pos = pos + jnp.where(done, 0, 1).astype(pos.dtype)
+        return emit, last, (new_caches, new_pos, feed, new_done, key,
+                            new_budget)
+
+    def __call__(self, state: DecodeState):
+        # commit EVERY call, not just the first: a fresh generate()
+        # restarts from eager-built (uncommitted) arrays and would
+        # otherwise re-trace once per loop; on the steady state this is
+        # a no-op attribute walk over ~a dozen arrays
+        state = DecodeState(*_commit_tree(state.astuple()))
+        args = (
+            tuple(p._data for p in self._p_objs),
+            tuple(b._data for b in self._b_objs),
+            state.caches, state.pos, state.tok, state.done, state.key,
+            state.temperature, state.top_k, state.top_p, state.eos,
+            state.budget,
+        )
+        if self._jitted is None:
+            donate = (2,) if self._donate else ()
+            # EVERY loop-carried output pins to its input sharding —
+            # with a dp-sharded cache GSPMD would otherwise flip the
+            # small vectors (tok/done/budget) to dp-sharded outputs and
+            # the second call's signature would re-trace the step
+            out_sh = (
+                None,                       # emitted tokens
+                None,                       # step logits
+                (_pin(state.caches), _pin(state.pos), _pin(state.tok),
+                 _pin(state.done), _pin(state.key), _pin(state.budget)),
+            )
+            self._jitted = self._instrumented(donate, out_sh)
+        self._n_steps += 1
+        emit, logits, (caches, pos, tok, done, key, budget) = \
+            self._jitted(*args)
+        new_state = DecodeState(
+            caches, pos, tok, done, key, state.temperature, state.top_k,
+            state.top_p, state.eos, budget,
+        )
+        return emit, logits, new_state
+
+
+class PrefillStep(_CompiledDecodeBase):
+    """Bucketed compiled prefill: right-padded [B, L] prompt ids write
+    their K/V rows into the static cache at positions 0..len-1 and the
+    last REAL token's logits come back per row (the first sampling
+    input). One compile per (B, L) bucket shape — jit caches by shape,
+    so a single instance serves every bucket and the ledger counts the
+    per-bucket compiles under ``PrefillStep``.
+
+    Padding rows write garbage K/V at positions len..L-1; the decode
+    masks every position > pos AND overwrites position p on the very
+    step whose query sits at p (write-then-attend), so a stale row is
+    never read.
+    """
+
+    _label = "PrefillStep"
+
+    def _step_fn(self, p_raws, b_raws, cache_raws, ids, length):
+        logits, new_caches = self._fwd(
+            p_raws, b_raws, ids, cache_raws,
+            jnp.zeros((ids.shape[0],), jnp.int32),
+        )
+        idx = jnp.clip(length - 1, 0, ids.shape[1] - 1)
+        last = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1
+        )[:, 0, :].astype(jnp.float32)
+        return last, new_caches, jnp.asarray(length, jnp.int32)
+
+    def __call__(self, caches, ids, lengths):
+        """-> (last_logits [B, V] f32, new cache pytree, pos [B])."""
+        cache_raws = _raw_tree(caches)
+        args = (
+            tuple(p._data for p in self._p_objs),
+            tuple(b._data for b in self._b_objs),
+            cache_raws,
+            jnp.asarray(ids, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+        )
+        if self._jitted is None:
+            donate = (2,) if self._donate else ()
+            out_sh = (None, _pin(cache_raws), None)
+            self._jitted = self._instrumented(donate, out_sh)
+        self._n_steps += 1
+        return self._jitted(*args)

@@ -35,6 +35,14 @@ eligible shapes run the kernel through the `shard_map` seam
 its shard. `PADDLE_FLASH_SHARD=0` is the loud escape hatch back to the
 r6 dense fallback for every multi-device program (it also gates the
 sharded fused-LN routing in functional.norm).
+
+Round 10 (ISSUE 9): decode-append Sq != Sk causal shapes route too. The
+queries are the end-aligned suffix of the key sequence, so the kernel's
+`q_offset = Sk - Sq` seam computes the same triangle the dense fallback
+masks explicitly (`qpos = arange(Sq) + (Sk - Sq)`).
+`PADDLE_FLASH_APPEND=0` restores the r4 dense-only Sq != Sk policy.
+Traced (per-slot) positions cannot use a static offset: the serving
+KV-cache path uses `cached_attention`/`cache_update` below instead.
 """
 from __future__ import annotations
 
@@ -45,14 +53,24 @@ import jax
 from ...core import autograd as AG
 
 __all__ = [
-    "flash_default_enabled", "flash_shard_enabled", "shard_factoring",
-    "flash_plan", "flash_routable", "flash_core", "flash_core_sharded",
-    "flash_core_routed", "scaled_dot_product_attention",
+    "flash_default_enabled", "flash_shard_enabled", "flash_append_enabled",
+    "shard_factoring", "flash_plan", "flash_routable", "flash_core",
+    "flash_core_sharded", "flash_core_routed",
+    "scaled_dot_product_attention", "cache_update", "cached_attention",
 ]
 
 
 def flash_default_enabled() -> bool:
     v = os.environ.get("PADDLE_FLASH_DEFAULT", "1").strip().lower()
+    return v not in ("0", "false", "off")
+
+
+def flash_append_enabled() -> bool:
+    """May causal decode-append (Sq != Sk, queries end-aligned) shapes
+    route through the offset-aware flash kernel? `PADDLE_FLASH_APPEND=0`
+    restores the round-4 policy: every Sq != Sk shape takes the dense
+    end-aligned fallback (ISSUE 9)."""
+    v = os.environ.get("PADDLE_FLASH_APPEND", "1").strip().lower()
     return v not in ("0", "false", "off")
 
 
@@ -200,11 +218,17 @@ def flash_plan(seq_q, seq_k, *, causal, has_mask=False,
     if not causal or has_mask or dropout_active or need_weights \
             or has_cache:
         return None
-    # the kernel's causal mask compares ABSOLUTE positions from offset 0;
-    # Sq != Sk (decode-append / cross shapes) needs the end-aligned dense
-    # form — routing it would mask the wrong triangle
+    # Sq != Sk is the decode-append shape: queries are the END-ALIGNED
+    # suffix of the key sequence (qpos = arange(Sq) + (Sk - Sq), the same
+    # alignment as the dense fallback). Since round 10 it routes through
+    # the kernel's q_offset seam (PADDLE_FLASH_APPEND=0 hatch restores
+    # the r4 dense-only policy); Sq > Sk has no causal interpretation
+    # here and a too-small Sq tile (single-token decode) falls through
+    # to dense below via the block check — a 1-row matvec beats a
+    # degenerate Pallas tile anyway.
     if int(seq_q) != int(seq_k):
-        return None
+        if int(seq_q) > int(seq_k) or not flash_append_enabled():
+            return None
     if jax.default_backend() != "tpu" and not _interpret_forced():
         return None
     if _flash_block(int(seq_q)) < 8 or _flash_block(int(seq_k)) < 8:
@@ -231,9 +255,11 @@ def flash_routable(seq_q, seq_k, *, causal, has_mask=False,
     ) is not None
 
 
-def flash_core(q, k, v, *, causal=True, scale=None):
+def flash_core(q, k, v, *, causal=True, scale=None, q_offset=0):
     """Run the Pallas flash kernel on [B, H, S, D] Tensors (tape-recorded;
-    block sizes derived from the sequence lengths)."""
+    block sizes derived from the sequence lengths). `q_offset` is the
+    static global position of the first query row — `Sk - Sq` for the
+    end-aligned decode-append shape."""
     from ...ops.pallas import flash_attention
 
     bq = _flash_block(int(q.shape[2]))
@@ -244,14 +270,14 @@ def flash_core(q, k, v, *, causal=True, scale=None):
     with _prof.device_annotation("attention::flash"):
         return AG.apply(
             lambda a, b, c: flash_attention(
-                a, b, c, causal, bq, bk, scale, interpret
+                a, b, c, causal, bq, bk, scale, interpret, q_offset, 0
             ),
             (q, k, v), name="flash_attention",
         )
 
 
 def flash_core_sharded(q, k, v, *, mesh, batch_axes, head_axes,
-                       causal=True, scale=None):
+                       causal=True, scale=None, q_offset=0):
     """Run the flash kernel through the shard_map seam
     (ops/pallas/sharded.py) on [B, H, S, D] Tensors: B shards over
     `batch_axes`, H over `head_axes`, each device executes the
@@ -267,14 +293,14 @@ def flash_core_sharded(q, k, v, *, mesh, batch_axes, head_axes,
         return AG.apply(
             lambda a, b, c: sharded_flash_attention(
                 a, b, c, mesh, batch_axes, head_axes, causal, bq, bk,
-                scale, interpret
+                scale, interpret, q_offset, 0
             ),
             (q, k, v), name="sharded_flash_attention",
         )
 
 
 def flash_core_routed(q, k, v, *, mesh=None, causal=True, scale=None,
-                      plan=None):
+                      plan=None, q_offset=0):
     """Dispatch the flash kernel per the shard plan: through the
     shard_map seam when the mesh partitions the [B, H, S, D] operands,
     the plain single-device kernel otherwise. Callers that already hold
@@ -298,9 +324,10 @@ def flash_core_routed(q, k, v, *, mesh=None, causal=True, scale=None,
         _, m, (batch_axes, head_axes) = plan
         return flash_core_sharded(
             q, k, v, mesh=m, batch_axes=batch_axes, head_axes=head_axes,
-            causal=causal, scale=scale,
+            causal=causal, scale=scale, q_offset=q_offset,
         )
-    return flash_core(q, k, v, causal=causal, scale=scale)
+    return flash_core(q, k, v, causal=causal, scale=scale,
+                      q_offset=q_offset)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -322,9 +349,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                       dropout_active=dropout_active, batch=B, heads=H)
     if plan is not None:
         # multi-device programs run the kernel per shard through the
-        # shard_map seam (the plan carries the vetted factoring)
+        # shard_map seam (the plan carries the vetted factoring); a
+        # decode-append shape (Sq < Sk) rides the kernel's q_offset so
+        # its causal mask compares the SAME end-aligned positions as the
+        # dense fallback below
         return flash_core_routed(
-            query, key, value, causal=is_causal, scale=scale, plan=plan
+            query, key, value, causal=is_causal, scale=scale, plan=plan,
+            q_offset=int(key.shape[2]) - int(query.shape[2]),
         )
 
     sc = scale if scale is not None else int(query.shape[-1]) ** -0.5
@@ -353,3 +384,59 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             lambda w, vr: jnp.einsum("bhqk,bhkd->bhqd", w, vr),
             (weights, value), name="attention_context",
         )
+
+
+# ---------------------------------------------------------------------------
+# static-capacity KV cache (ISSUE 9 serving seam)
+# ---------------------------------------------------------------------------
+
+
+def cache_update(cache, new, pos):
+    """Write the [B, H, Sq, D] new K or V rows into the static-capacity
+    [B, H, cap, D] `cache` Tensor at per-slot write positions ``pos``
+    ([B] int32 Tensor): one vmapped dynamic_update_slice — no concat, no
+    shape change, so the compiled decode program is traced ONCE and the
+    cache buffer can be donated. Inference-only (no VJP)."""
+    import jax.numpy as jnp
+
+    def f(c, u, p):
+        return jax.vmap(
+            lambda cb, ub, pb: jax.lax.dynamic_update_slice_in_dim(
+                cb, ub.astype(cb.dtype), pb, axis=1
+            )
+        )(c, u, jnp.asarray(p, jnp.int32))
+
+    return AG.apply_nondiff(f, (cache, new, pos))
+
+
+def cached_attention(query, key, value, pos, *, scale=None):
+    """Decode attention over a static-capacity cache: [B, H, Sq, D]
+    queries whose first token sits at per-slot position ``pos`` ([B]
+    int32 Tensor) against [B, H, cap, D] cache K/V. The causal mask
+    compares TRACED per-slot positions (qpos = pos[b] + i vs kpos = j),
+    which also masks every not-yet-written cache slot (kpos > qpos by
+    construction — the engine only writes at monotonically growing pos).
+
+    This is deliberately the dense form: decode's Sq is 1 (a matvec per
+    head); a Pallas tile would be degenerate, and a TRACED offset cannot
+    feed the flash kernel's static q_offset seam. Static end-aligned
+    Sq != Sk shapes (prefill-with-history) route through the flash
+    kernel via `flash_plan` instead. Inference-only (no VJP)."""
+    import jax.numpy as jnp
+
+    sc = scale if scale is not None else int(query.shape[-1]) ** -0.5
+    Sq, Sk = int(query.shape[2]), int(key.shape[2])
+
+    def f(qr, kr, vr, pr):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qr, kr) * sc
+        qpos = pr[:, None].astype(jnp.int32) + jnp.arange(Sq)[None, :]
+        kpos = jnp.arange(Sk)
+        masked = kpos[None, None, None, :] > qpos[:, None, :, None]
+        s = jnp.where(masked, -1e9, s)
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, vr)
+
+    from ... import profiler as _prof
+
+    with _prof.device_annotation("attention::cached"):
+        return AG.apply_nondiff(f, (query, key, value, pos))

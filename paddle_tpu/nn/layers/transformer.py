@@ -133,20 +133,53 @@ class MultiHeadAttention(Layer):
         x = reshape(x, [B, T, self.num_heads, self.head_dim])
         return transpose(x, [0, 2, 1, 3])  # B, H, T, D
 
-    def gen_cache(self, key, value=None, type=None):
+    def gen_cache(self, key=None, value=None, type=None, max_length=None,
+                  batch_size=None, dtype=None):
+        """Paddle-compatible `gen_cache` grown a STATIC-CAPACITY form
+        (ISSUE 9): with ``max_length`` the returned ``Cache`` holds
+        zero-filled [B, H, max_length, Dh] buffers that decode WRITES
+        INTO at per-slot positions (forward's ``pos`` kwarg) — constant
+        shapes, so the compiled DecodeStep traces once and the buffers
+        are donatable. Without it, the legacy zero-length concat cache
+        (shape grows per step — eager-only) is returned."""
         if type == MultiHeadAttention.StaticCache:
             k = self._split_heads(self._proj(key, 1))
             v = self._split_heads(
                 self._proj(value if value is not None else key, 2)
             )
             return MultiHeadAttention.StaticCache(k, v)
-        B = key.shape[0]
-        import numpy as np
+        if batch_size is not None:
+            B = int(batch_size)
+        elif key is not None:
+            B = int(key.shape[0])
+        else:
+            raise ValueError("gen_cache needs `key` or `batch_size`")
+        cap = 0 if max_length is None else int(max_length)
+        dt = dtype or self._dtype
+        # _wrap, not Tensor(): the ctor's dtype inference would
+        # np.asarray the buffer — a device read per cache allocation
+        zk = Tensor._wrap(
+            jnp.zeros((B, self.num_heads, cap, self.head_dim), dt))
+        zv = Tensor._wrap(
+            jnp.zeros((B, self.num_heads, cap, self.head_dim), dt))
+        return MultiHeadAttention.Cache(zk, zv)
 
-        z = Tensor(jnp.zeros((B, self.num_heads, 0, self.head_dim), self._dtype))
-        return MultiHeadAttention.Cache(z, z)
+    def _finish_output(self, out, weights, cache):
+        from ...ops.manipulation import reshape, transpose
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        out = transpose(out, [0, 2, 1, 3])
+        out = reshape(out, [out.shape[0], out.shape[1], self.embed_dim])
+        out = self.out_proj(out)
+        outs = [out]
+        if self.need_weights:
+            outs.append(weights)
+        if cache is not None and not isinstance(
+                cache, MultiHeadAttention.StaticCache):
+            outs.append(cache)
+        return out if len(outs) == 1 else tuple(outs)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None, pos=None):
         key = query if key is None else key
         value = key if value is None else value
 
@@ -170,6 +203,34 @@ class MultiHeadAttention(Layer):
                 k = self._split_heads(self._proj(key, 1))
                 v = self._split_heads(self._proj(value, 2))
             if isinstance(cache, MultiHeadAttention.Cache):
+                if pos is not None:
+                    # static-capacity decode-append (ISSUE 9): K/V rows
+                    # are written IN PLACE at per-slot `pos` and the
+                    # position-masked attention runs over the full
+                    # capacity — constant shapes, donatable buffers,
+                    # one trace for the whole decode (jit.DecodeStep).
+                    if attn_mask is not None or self.need_weights:
+                        raise NotImplementedError(
+                            "static-capacity decode is causal-by-"
+                            "position and never materializes weights; "
+                            "attn_mask/need_weights need the concat "
+                            "cache (pos=None)"
+                        )
+                    if self.attn_impl != "dense":
+                        raise NotImplementedError(
+                            "static-capacity decode requires "
+                            "attn_impl='dense' (blockwise/ring paths "
+                            "have no traced-position masking)"
+                        )
+                    from ..functional import attention as attn_route
+
+                    k = attn_route.cache_update(cache.k, k, pos)
+                    v = attn_route.cache_update(cache.v, v, pos)
+                    cache = MultiHeadAttention.Cache(k, v)
+                    out = attn_route.cached_attention(
+                        q, k, v, pos, scale=self.head_dim ** -0.5
+                    )
+                    return self._finish_output(out, None, cache)
                 from ...ops.manipulation import concat
 
                 k = concat([cache.k, k], axis=2)
@@ -265,18 +326,7 @@ class MultiHeadAttention(Layer):
                 name="attention_context",
             )
 
-        from ...ops.manipulation import reshape, transpose
-
-        out = transpose(out, [0, 2, 1, 3])
-        out = reshape(out, [out.shape[0], out.shape[1], self.embed_dim])
-        out = self.out_proj(out)
-
-        outs = [out]
-        if self.need_weights:
-            outs.append(weights)
-        if cache is not None and not isinstance(cache, MultiHeadAttention.StaticCache):
-            outs.append(cache)
-        return out if len(outs) == 1 else tuple(outs)
+        return self._finish_output(out, weights, cache)
 
 
 class TransformerEncoderLayer(Layer):

@@ -7,7 +7,9 @@ metrics, recompile ledger.
   as compat aliases. Stdlib-pure.
 - :mod:`.metrics` — periodic ``step_metrics`` records riding the
   guard's ``PADDLE_GUARD_SYNC_EVERY`` async host read (zero new
-  per-step syncs).
+  per-step syncs), and ``decode_metrics``/``decode_request`` records
+  riding the serving engine's ``PADDLE_SERVE_SYNC_EVERY`` readback
+  cadence (ISSUE 9, same discipline).
 - :mod:`.ledger` — jit cache misses as ``recompile`` records with arg
   shape/dtype/donation fingerprints, compile seconds, and a
   recompile-storm detector naming the changing fingerprint field.

@@ -35,13 +35,20 @@ from typing import Optional
 
 from . import bus
 
-__all__ = ["StepMetricsSampler", "step_metrics_enabled", "device_memory"]
+__all__ = ["StepMetricsSampler", "step_metrics_enabled", "device_memory",
+           "DecodeMetricsSampler", "decode_metrics_enabled"]
 
 _ENABLE_ENV = "PADDLE_OBS_STEP_METRICS"
+_DECODE_ENABLE_ENV = "PADDLE_OBS_DECODE_METRICS"
 
 
 def step_metrics_enabled() -> bool:
     v = os.environ.get(_ENABLE_ENV, "1").strip().lower()
+    return v not in ("0", "false", "off")
+
+
+def decode_metrics_enabled() -> bool:
+    v = os.environ.get(_DECODE_ENABLE_ENV, "1").strip().lower()
     return v not in ("0", "false", "off")
 
 
@@ -129,3 +136,57 @@ class StepMetricsSampler:
         if mem:
             payload["device_memory"] = mem
         bus.emit("step_metrics", payload, step=step)
+
+
+class DecodeMetricsSampler:
+    """Serving-side telemetry on the engine's READBACK cadence
+    (ISSUE 9 satellite).
+
+    Same zero-new-per-step-sync discipline as :class:`StepMetricsSampler`:
+    the continuous-batching engine already pulls one stacked token block
+    plus the done mask to the host every ``PADDLE_SERVE_SYNC_EVERY``
+    decode steps (its stop-condition check); ``decode_metrics`` rows are
+    built from exactly those host values and wall-clock deltas — nothing
+    here reads a device array, so enabling the records changes the
+    decode loop's transfer count by zero (asserted in
+    tests/test_serving.py). ``PADDLE_OBS_DECODE_METRICS=0`` disables.
+
+    Rows:
+      ``decode_metrics``  per readback window: decode steps, emitted
+        tokens, tokens/sec over the window wall clock, inflight slots,
+        queue depth;
+      ``decode_request``  per completed request: generated tokens,
+        end-to-end latency, prefill share, per-token mean.
+    """
+
+    def __init__(self):
+        self.enabled = decode_metrics_enabled()
+        self._windows = 0
+
+    def window(self, *, steps: int, tokens: int, wall_s: float,
+               inflight: int, queue_depth: int) -> None:
+        if not self.enabled or not bus.enabled():
+            return
+        self._windows += 1
+        payload = {
+            "steps": int(steps),
+            "tokens": int(tokens),
+            "inflight_slots": int(inflight),
+            "queue_depth": int(queue_depth),
+        }
+        if wall_s > 0:
+            payload["tokens_per_sec"] = round(tokens / wall_s, 1)
+            payload["step_ms"] = round(wall_s / max(steps, 1) * 1e3, 3)
+        bus.emit("decode_metrics", payload, step=self._windows)
+
+    def request_done(self, *, rid, tokens: int, latency_ms: float,
+                     prefill_ms: float) -> None:
+        if not self.enabled or not bus.enabled():
+            return
+        bus.emit("decode_request", {
+            "rid": rid,
+            "tokens": int(tokens),
+            "latency_ms": round(latency_ms, 3),
+            "prefill_ms": round(prefill_ms, 3),
+            "ms_per_token": round(latency_ms / max(tokens, 1), 3),
+        }, step=self._windows)

@@ -373,33 +373,44 @@ def _backward(q, k, v, out, lse, g, *, causal, block_q, block_k, scale,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, causal=False, block_q=256, block_k=256,
-                    scale=None, interpret=False):
+                    scale=None, interpret=False, q_offset=0, kv_offset=0):
     """Exact softmax attention, Pallas-tiled on TPU. [B, H, S, D] in/out.
     `interpret=True` runs the kernels in the Pallas interpreter (CPU
     testing). Both forward and backward are hand kernels; K/V stream
     through the grid, so S is HBM-bound (tested at 32k), not VMEM-bound.
+
+    `q_offset`/`kv_offset` (static ints) shift the GLOBAL positions the
+    causal mask compares — the decode-append seam (ISSUE 9): a cached
+    Sq != Sk suffix attends end-aligned by passing `q_offset = Sk - Sq`,
+    computing the same function as the dense end-aligned fallback
+    (`qpos = arange(Sq) + (Sk - Sq)`) without materializing scores.
     """
     return _forward(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        scale=scale, interpret=interpret,
+        scale=scale, interpret=interpret, q_offset=q_offset,
+        kv_offset=kv_offset,
     )
 
 
-def _fa_fwd(q, k, v, causal, block_q, block_k, scale, interpret):
+def _fa_fwd(q, k, v, causal, block_q, block_k, scale, interpret,
+            q_offset, kv_offset):
     out, lse = _forward(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        scale=scale, interpret=interpret, return_lse=True,
+        scale=scale, interpret=interpret, q_offset=q_offset,
+        kv_offset=kv_offset, return_lse=True,
     )
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, block_q, block_k, scale, interpret, res, g):
+def _fa_bwd(causal, block_q, block_k, scale, interpret, q_offset,
+            kv_offset, res, g):
     q, k, v, out, lse = res
     return _backward(
         q, k, v, out, lse, g, causal=causal, block_q=block_q,
         block_k=block_k, scale=scale, interpret=interpret,
+        q_offset=q_offset, kv_offset=kv_offset,
     )
 
 

@@ -74,17 +74,22 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 def sharded_flash_attention(q, k, v, mesh, batch_axes, head_axes,
                             causal=True, block_q=256, block_k=256,
-                            scale=None, interpret=False):
+                            scale=None, interpret=False, q_offset=0,
+                            kv_offset=0):
     """Flash attention on [B, H, S, D] operands inside a multi-device
     program: B shards over `batch_axes` (the dp axis or the hierarchical
     dcn x ici pair), H over `head_axes` ('mp'); S/D stay whole. Each
     shard runs the single-chip Pallas kernel; gradients flow through the
     kernel's own custom VJP per shard (no cross-shard terms exist).
+    `q_offset`/`kv_offset` (static ints) carry the decode-append global
+    positions into each shard — safe to close over because the sequence
+    dim is never sharded here, so every shard sees the same alignment.
     """
     spec = P(_spec_elem(batch_axes), _spec_elem(head_axes), None, None)
     body = functools.partial(
         _sharded_flash_body, causal=causal, block_q=block_q,
         block_k=block_k, scale=scale, interpret=interpret,
+        q_offset=q_offset, kv_offset=kv_offset,
     )
     return _shard_map(
         body, mesh, in_specs=(spec, spec, spec), out_specs=spec
@@ -92,12 +97,12 @@ def sharded_flash_attention(q, k, v, mesh, batch_axes, head_axes,
 
 
 def _sharded_flash_body(q, k, v, *, causal, block_q, block_k, scale,
-                        interpret):
+                        interpret, q_offset=0, kv_offset=0):
     from .flash_attention import flash_attention
 
     # per-shard S is the full sequence; block sizes clamp inside
     return flash_attention(q, k, v, causal, block_q, block_k, scale,
-                           interpret)
+                           interpret, q_offset, kv_offset)
 
 
 # ---------------------------------------------------------------------------

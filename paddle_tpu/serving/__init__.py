@@ -1,0 +1,32 @@
+"""paddle_tpu.serving — autoregressive decode + continuous-batching
+inference (ISSUE 9 tentpole).
+
+The training side compiles ONE program per step (`jit.TrainStep`); this
+package does the same for the decode direction:
+
+- `sampling` — greedy/temperature/top-k/top-p as small traced-safe
+  functional ops over raw arrays (RNG-key threaded, per-slot [B]
+  parameter vectors so one compiled program serves mixed requests);
+- `TransformerLM` (model.py) — the reference-shaped causal LM contract
+  `jit.DecodeStep`/`jit.PrefillStep` consume (static-capacity KV cache
+  through the `MultiHeadAttention.Cache` seam);
+- `generate` / `GenerationConfig` (engine.py) — the whole-batch decode
+  loop: bucketed compiled prefill, one compiled single-token step,
+  device-resident loop state (ZERO per-token host syncs — tokens come
+  back in one transfer at the end or on the stop-check cadence);
+- `Request` / `InferenceEngine` (engine.py) — slot-based continuous
+  batching over the same compiled pair: insert-on-free scheduling,
+  length-bucketed prefill with the bucketed compile cache, per-request
+  stop conditions and sampling params, `decode_metrics` telemetry on
+  the readback cadence.
+"""
+from . import sampling  # noqa: F401
+from .engine import (  # noqa: F401
+    GeneratedResult, GenerationConfig, InferenceEngine, Request, generate,
+)
+from .model import TransformerLM  # noqa: F401
+
+__all__ = [
+    "sampling", "TransformerLM", "generate", "GenerationConfig",
+    "Request", "InferenceEngine", "GeneratedResult",
+]

@@ -1,0 +1,428 @@
+"""Decode loop + continuous-batching inference engine (ISSUE 9).
+
+Two layers on top of the compiled `jit.PrefillStep`/`jit.DecodeStep`
+pair:
+
+- :func:`generate` — the whole-batch reference loop (the e2e "load
+  checkpoint -> prefill -> decode N tokens" script shape): bucketed
+  compiled prefill, one compiled single-token step, DEVICE-RESIDENT
+  loop state. With ``sync_every=0`` (the default without a stop token)
+  the host touches the device exactly once after the loop — zero
+  per-token transfers, asserted in tests/test_serving.py.
+
+- :class:`InferenceEngine` — slot-based continuous batching: a fixed
+  [slots, H, cap, Dh] cache pool, per-request prefill into a length
+  bucket (compile cache is per bucket — warm compiles are cheap under
+  the persistent XLA cache), insert-on-free scheduling (a finished
+  slot is immediately re-filled from the queue), per-slot sampling
+  params and stop conditions riding the compiled step as [S] vectors,
+  and host readbacks only on the ``PADDLE_SERVE_SYNC_EVERY`` cadence —
+  the same cadence `decode_metrics` telemetry rides (zero extra syncs).
+
+Env knobs (documented in README):
+  ``PADDLE_SERVE_SYNC_EVERY``  decode steps per engine readback (16)
+  ``PADDLE_SERVE_BUCKETS``     prefill length buckets ("16,32,64,128,
+                               256,512,1024")
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..jit.decode_step import DecodeState, DecodeStep, PrefillStep
+from . import sampling
+
+__all__ = ["GenerationConfig", "generate", "Request", "GeneratedResult",
+           "InferenceEngine", "prefill_buckets", "bucket_for"]
+
+_SYNC_ENV = "PADDLE_SERVE_SYNC_EVERY"
+_BUCKETS_ENV = "PADDLE_SERVE_BUCKETS"
+
+
+def sync_every_default() -> int:
+    try:
+        return max(int(os.environ.get(_SYNC_ENV, "16")), 1)
+    except ValueError:
+        return 16
+
+
+def prefill_buckets() -> List[int]:
+    """The prefill length buckets (sorted). Each bucket is one compile
+    of the prefill program; prompts pad up to their bucket."""
+    raw = os.environ.get(_BUCKETS_ENV, "16,32,64,128,256,512,1024")
+    out = sorted({int(t) for t in raw.split(",") if t.strip()})
+    if not out:
+        raise ValueError(f"{_BUCKETS_ENV} parsed to no buckets: {raw!r}")
+    return out
+
+
+def bucket_for(length: int, cap: int,
+               buckets: Optional[List[int]] = None) -> int:
+    """Smallest bucket >= length, clamped to the cache capacity; lengths
+    past the largest bucket use the capacity itself (one extra shape)."""
+    if length > cap:
+        raise ValueError(f"prompt length {length} exceeds cache "
+                         f"capacity {cap}")
+    for b in (buckets if buckets is not None else prefill_buckets()):
+        if b >= length:
+            return min(b, cap)
+    return cap
+
+
+class GenerationConfig:
+    """Sampling + stop config for :func:`generate` (scalars or per-row
+    vectors): temperature<=0 greedy, top_k<=0 / top_p>=1 filters off."""
+
+    def __init__(self, max_new_tokens=16, temperature=0.0, top_k=0,
+                 top_p=1.0, eos_id=None, seed=0):
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.seed = seed
+
+
+def _pad_prompts(prompts, pad_to, pad_id=0):
+    """Ragged [B][*] int prompts -> (ids [B, pad_to] int32, len [B])."""
+    rows = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+    lens = np.asarray([r.size for r in rows], np.int32)
+    ids = np.full((len(rows), pad_to), pad_id, np.int32)
+    for i, r in enumerate(rows):
+        ids[i, : r.size] = r
+    return ids, lens
+
+
+def generate(model, input_ids, max_new_tokens=None, *, config=None,
+             temperature=0.0, top_k=0, top_p=1.0, eos_id=None, seed=0,
+             max_length=None, sync_every=None, return_logits=False,
+             prefill=None, decode=None):
+    """Decode ``max_new_tokens`` tokens for a whole batch.
+
+    Returns [B, max_new_tokens] int32 numpy tokens (``-1`` marks
+    positions after a row hit its stop token); with
+    ``return_logits=True`` also the [B, N, V] f32 per-step pre-sampling
+    logits (a test/debug hook — it keeps N logits rows alive on
+    device).
+
+    ``sync_every=0`` (default when no ``eos_id``) never reads the
+    device inside the loop; with a stop token the default checks the
+    done mask every ``PADDLE_SERVE_SYNC_EVERY`` steps to exit early.
+    ``prefill``/``decode`` accept pre-built step objects so repeated
+    calls share their compile caches.
+    """
+    cfg = config if config is not None else GenerationConfig(
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_id=eos_id, seed=seed)
+    # the explicit arg wins WITHOUT mutating a caller-owned config
+    n_new = int(max_new_tokens) if max_new_tokens is not None \
+        else cfg.max_new_tokens
+    model.eval()
+    rows = [np.asarray(p, np.int32).reshape(-1) for p in input_ids]
+    B = len(rows)
+    max_len = max(r.size for r in rows)
+    cap = int(max_length) if max_length is not None \
+        else max_len + n_new
+    if max_len + n_new > cap + 1:
+        raise ValueError(
+            f"max_length={cap} cannot hold prompt ({max_len}) + "
+            f"{n_new} new tokens")
+    bucket = bucket_for(max_len, cap)
+    ids, lens = _pad_prompts(rows, bucket)
+
+    pre = prefill if prefill is not None else PrefillStep(model)
+    step = decode if decode is not None else DecodeStep(model)
+    caches = model.gen_cache(B, cap)
+    last, cache_raws, pos = pre(caches, ids, lens)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    key, sub = jax.random.split(key)
+    state = DecodeState.make(
+        cache_raws, first_tokens=jnp.zeros((B,), jnp.int32), pos=pos,
+        temperature=cfg.temperature, top_k=cfg.top_k, top_p=cfg.top_p,
+        eos_id=cfg.eos_id, budget=n_new - 1)
+    state.key = key
+    first = sampling.sample(last, sub, state.temperature, state.top_k,
+                            state.top_p)
+    state.done = first == state.eos
+    state.tok = jnp.where(state.done, jnp.int32(0), first)
+
+    emits = [first]
+    logits_all = [last] if return_logits else None
+    if sync_every is None:
+        sync_every = 0 if cfg.eos_id is None else sync_every_default()
+    since_sync = 0
+    for _ in range(n_new - 1):
+        emit, logits, state = step(state)
+        emits.append(emit)
+        if return_logits:
+            logits_all.append(logits)
+        since_sync += 1
+        if sync_every and since_sync >= sync_every:
+            since_sync = 0
+            if bool(np.asarray(state.done).all()):
+                break
+    toks = np.asarray(jnp.stack(emits, axis=1))
+    out = np.full((B, n_new), -1, np.int32)
+    out[:, : toks.shape[1]] = toks
+    if return_logits:
+        return out, np.asarray(jnp.stack(logits_all, axis=1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# continuous batching
+# ---------------------------------------------------------------------------
+
+_rid_counter = itertools.count()
+
+
+class Request:
+    """One generation request for the engine."""
+
+    def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
+                 top_k=0, top_p=1.0, eos_id=None, rid=None):
+        self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_id = -1 if eos_id is None else int(eos_id)
+        self.rid = next(_rid_counter) if rid is None else rid
+
+
+class GeneratedResult:
+    """Completed request: generated ids + latency accounting."""
+
+    def __init__(self, rid, tokens, prefill_ms, total_ms):
+        self.rid = rid
+        self.tokens = list(tokens)
+        self.prefill_ms = prefill_ms
+        self.total_ms = total_ms
+
+    @property
+    def ms_per_token(self):
+        n = max(len(self.tokens), 1)
+        return self.total_ms / n
+
+
+class _Slot:
+    __slots__ = ("req", "t_start", "prefill_ms", "tokens")
+
+    def __init__(self, req, t_start, prefill_ms, first_token):
+        self.req = req
+        self.t_start = t_start
+        self.prefill_ms = prefill_ms
+        self.tokens = [int(first_token)]
+
+
+class InferenceEngine:
+    """Slot-based continuous batching over one model.
+
+    The decode batch is a fixed pool of ``slots``; each slot holds one
+    inflight request. A finished slot (stop token, budget) is re-filled
+    from the queue at the next readback (insert-on-free) — the compiled
+    decode program never changes shape. Per-request prefill runs at
+    batch 1 through the length-bucketed `PrefillStep` and is spliced
+    into the pool by a small compiled insert program (cache buffers
+    donated end to end).
+    """
+
+    def __init__(self, model, *, slots=4, max_length=256,
+                 sync_every=None, seed=0):
+        model.eval()
+        self.model = model
+        self.slots = int(slots)
+        self.max_length = int(max_length)
+        self.sync_every = (sync_every_default() if sync_every is None
+                           else max(int(sync_every), 1))
+        self._prefill = PrefillStep(model)
+        self._decode = DecodeStep(model)
+        self._insert_jitted = None
+        self._queue: deque = deque()
+        self._active: Dict[int, _Slot] = {}
+        self._key = jax.random.PRNGKey(seed)
+        caches = model.gen_cache(self.slots, self.max_length)
+        self._state = DecodeState.make(
+            caches, first_tokens=np.zeros(self.slots, np.int32),
+            pos=np.zeros(self.slots, np.int32), seed=seed)
+        # every slot starts free
+        self._state.done = jnp.ones((self.slots,), bool)
+        # commit the fresh pool once so the FIRST CacheInsert call sees
+        # the same (committed) signature as every later one — the
+        # DecodeStep placement-churn lesson applied to the insert jit
+        from ..jit.decode_step import _commit_tree
+
+        self._state = DecodeState(*_commit_tree(self._state.astuple()))
+        from ..observability.metrics import DecodeMetricsSampler
+
+        self._metrics = DecodeMetricsSampler()
+
+    # -- public API --------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        if req.prompt_ids.size + req.max_new_tokens > self.max_length:
+            raise ValueError(
+                f"request {req.rid}: prompt ({req.prompt_ids.size}) + "
+                f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                f"max_length={self.max_length}")
+        self._queue.append(req)
+
+    def run(self) -> Dict[object, GeneratedResult]:
+        """Drain the queue; returns rid -> GeneratedResult."""
+        results: Dict[object, GeneratedResult] = {}
+        while self._queue or self._active:
+            self._fill_free_slots(results)
+            if not self._active:
+                continue
+            window = self._window()
+            t0 = time.perf_counter()
+            emits = []
+            for _ in range(window):
+                emit, _, self._state = self._decode(self._state)
+                emits.append(emit)
+            # THE readback: one stacked token transfer + the done mask
+            # per window — the only recurring device->host reads in the
+            # serving loop (decode_metrics rides exactly this cadence)
+            tok_block = np.asarray(jnp.stack(emits, axis=0))
+            done = np.asarray(self._state.done)
+            dt = time.perf_counter() - t0
+            self._collect(tok_block, done, results)
+            self._metrics.window(
+                steps=window, tokens=int((tok_block >= 0).sum()),
+                wall_s=dt, inflight=len(self._active),
+                queue_depth=len(self._queue))
+        return results
+
+    # -- internals ---------------------------------------------------------
+    def _window(self) -> int:
+        """Decode steps until the next readback — always the full sync
+        cadence: per-slot budgets and stop tokens fold into the
+        IN-GRAPH done mask (DecodeStep), so one nearly-finished request
+        never drags the whole pool down to per-token readbacks; a done
+        slot just emits the -1 sentinel until the window closes.
+        Capacity needs no clamp either — submit() bounds every slot by
+        prompt + max_new_tokens <= max_length."""
+        return self.sync_every
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _fill_free_slots(self, results) -> None:
+        if not self._queue:
+            return
+        free = [s for s in range(self.slots) if s not in self._active]
+        for slot in free:
+            if not self._queue:
+                break
+            req = self._queue.popleft()
+            t0 = time.perf_counter()
+            first = self._insert(slot, req)
+            prefill_ms = (time.perf_counter() - t0) * 1e3
+            if first == req.eos_id or req.max_new_tokens <= 1:
+                # degenerate request: done at its first token
+                results[req.rid] = GeneratedResult(
+                    req.rid, [first], prefill_ms, prefill_ms)
+                self._metrics.request_done(
+                    rid=req.rid, tokens=1, latency_ms=prefill_ms,
+                    prefill_ms=prefill_ms)
+                self._state.done = self._state.done.at[slot].set(True)
+            else:
+                self._active[slot] = _Slot(req, t0, prefill_ms, first)
+
+    def _insert(self, slot: int, req: Request) -> int:
+        """Prefill one request and splice it into the pool slot.
+        Returns its first generated token (the one per-request host
+        read — per REQUEST, not per token)."""
+        L = req.prompt_ids.size
+        bucket = bucket_for(L, self.max_length)
+        ids, lens = _pad_prompts([req.prompt_ids], bucket)
+        slot_caches = self.model.gen_cache(1, self.max_length)
+        last, slot_raws, _ = self._prefill(slot_caches, ids, lens)
+        sub = self._next_key()
+        first = sampling.sample(
+            last, sub,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32))
+        if self._insert_jitted is None:
+            from ..observability import ledger as _ledger
+
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._insert_jitted = _ledger.instrument(
+                jax.jit(_insert_fn, donate_argnums=donate,
+                        static_argnums=()),
+                label="CacheInsert", donate=donate)
+        st = self._state
+        (caches, pos, tok, done, temp, top_k, top_p, eos, budget) = \
+            self._insert_jitted(
+                st.caches, slot_raws, jnp.asarray(slot, jnp.int32),
+                st.pos, st.tok, st.done, st.temperature, st.top_k,
+                st.top_p, st.eos, st.budget,
+                jnp.asarray(L, jnp.int32),
+                first[0],
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_k, jnp.int32),
+                jnp.asarray(req.top_p, jnp.float32),
+                jnp.asarray(req.eos_id, jnp.int32),
+                jnp.asarray(req.max_new_tokens - 1, jnp.int32))
+        self._state = DecodeState(caches, pos, tok, done, st.key, temp,
+                                  top_k, top_p, eos, budget)
+        return int(np.asarray(first)[0])
+
+    def _collect(self, tok_block, done, results) -> None:
+        """Fold one readback window into per-request host state; retire
+        finished slots (insert-on-free happens on the next loop turn).
+        Stop conditions (eos, budget) already fired IN-GRAPH — a done
+        slot emits the -1 sentinel, so collection is a sentinel scan."""
+        finished = []
+        for slot, st in self._active.items():
+            for t in range(tok_block.shape[0]):
+                tok = int(tok_block[t, slot])
+                if tok < 0:   # sentinel: slot finished in-graph
+                    break
+                st.tokens.append(tok)
+            if done[slot]:
+                finished.append(slot)
+        for slot in finished:
+            st = self._active.pop(slot)
+            total_ms = (time.perf_counter() - st.t_start) * 1e3
+            results[st.req.rid] = GeneratedResult(
+                st.req.rid, st.tokens, st.prefill_ms, total_ms)
+            self._metrics.request_done(
+                rid=st.req.rid, tokens=len(st.tokens),
+                latency_ms=total_ms, prefill_ms=st.prefill_ms)
+            self._state.done = self._state.done.at[slot].set(True)
+
+
+def _insert_fn(cache_raws, slot_raws, slot, pos, tok, done, temp, top_k,
+               top_p, eos, budget, length, first_tok, t_val, k_val,
+               p_val, e_val, b_val):
+    """Compiled slot splice: write the batch-1 prefilled cache into the
+    pool at `slot` (batch-dim dynamic_update_slice per leaf) and reset
+    that slot's state-vector entries. `slot` rides as a traced scalar so
+    every slot shares one compile."""
+    def splice(batch_leaf, slot_leaf):
+        return jax.lax.dynamic_update_slice_in_dim(
+            batch_leaf, slot_leaf.astype(batch_leaf.dtype), slot, axis=0)
+
+    caches = jax.tree_util.tree_map(splice, cache_raws, slot_raws)
+    return (
+        caches,
+        pos.at[slot].set(length),
+        tok.at[slot].set(first_tok),
+        done.at[slot].set(False),
+        temp.at[slot].set(t_val),
+        top_k.at[slot].set(k_val),
+        top_p.at[slot].set(p_val),
+        eos.at[slot].set(e_val),
+        budget.at[slot].set(b_val),
+    )

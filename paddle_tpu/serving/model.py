@@ -1,0 +1,77 @@
+"""Reference-shaped causal LM implementing the serving model contract
+(ISSUE 9).
+
+`jit.DecodeStep` / `jit.PrefillStep` (and the engine on top of them)
+consume any Layer with this surface::
+
+    model(ids)                       -> [B, S, V] logits (full forward)
+    model(ids, cache=cs, pos=pos)    -> ([B, Sq, V] logits, new caches)
+    model.gen_cache(B, cap[, dtype]) -> per-layer static-capacity caches
+
+`TransformerLM` is the in-repo implementation: token + learned position
+embeddings, a `ParallelGPTBlock` stack (tensor-parallel attention/MLP —
+trivial on one chip, sharded over 'mp' on a hybrid mesh, same code
+path), final LayerNorm and an untied vocab head — the same shape
+bench.py's GPT-medium proxy uses, so serving benches and training
+benches price the same decoder.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..distributed import comm
+from ..distributed.meta_parallel import ParallelGPTBlock
+from ..ops.creation import arange
+
+__all__ = ["TransformerLM"]
+
+
+class TransformerLM(nn.Layer):
+    def __init__(self, vocab_size, d_model=256, num_heads=8,
+                 num_layers=4, max_position=2048, dim_feedforward=None,
+                 dropout=0.0, use_flash_attention=None):
+        super().__init__()
+        if comm.hybrid_mesh() is None:
+            comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.max_position = max_position
+        self.embed = nn.Embedding(vocab_size, d_model)
+        self.pos_embed = nn.Embedding(max_position, d_model)
+        self.blocks = nn.LayerList([
+            ParallelGPTBlock(
+                d_model, num_heads, dim_feedforward, dropout=dropout,
+                use_flash_attention=use_flash_attention,
+            )
+            for _ in range(num_layers)
+        ])
+        self.ln_f = nn.LayerNorm(d_model)
+        self.head = nn.Linear(d_model, vocab_size)
+
+    def forward(self, ids, cache=None, pos=None):
+        T = int(ids.shape[1])
+        if cache is None:
+            h = self.embed(ids) + self.pos_embed(
+                arange(T, dtype="int64"))
+            for blk in self.blocks:
+                h = blk(h)
+            return self.head(self.ln_f(h))
+        if pos is None:
+            raise ValueError("cache decoding needs `pos` ([B] int32)")
+        # per-slot absolute positions: slot b's first query sits at
+        # pos[b] (traced — one program serves every step of the decode)
+        pos_ids = pos.reshape([-1, 1]) + arange(T, dtype="int32")
+        h = self.embed(ids) + self.pos_embed(pos_ids)
+        new_caches = []
+        for blk, c in zip(self.blocks, cache):
+            h, nc = blk(h, cache=c, pos=pos)
+            new_caches.append(nc)
+        return self.head(self.ln_f(h)), new_caches
+
+    def gen_cache(self, batch_size, max_length, dtype=None):
+        if int(max_length) > self.max_position:
+            raise ValueError(
+                f"cache capacity {max_length} exceeds max_position="
+                f"{self.max_position} (the position table)"
+            )
+        return [blk.gen_cache(batch_size, max_length, dtype)
+                for blk in self.blocks]

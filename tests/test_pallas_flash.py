@@ -152,3 +152,75 @@ class TestParallelMHAFlashRouting:
             ParallelMultiHeadAttention(
                 32, 2, dropout=0.1, use_flash_attention=True
             )
+
+
+# ---------------------------------------------------------------------------
+# offset-aware causal masking (ISSUE 9 decode-append seam)
+# ---------------------------------------------------------------------------
+
+
+class TestOffsetCausal:
+    """`q_offset`/`kv_offset` through the PUBLIC flash_attention entry:
+    the kernel's global-position causal mask vs a dense oracle with the
+    same offsets, forward and backward — the seam the decode-append
+    routing (attention.flash_plan Sq != Sk) and ring attention share."""
+
+    def _dense_offset(self, q, k, v, q_off, kv_off):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+        qpos = jnp.arange(q.shape[2]) + q_off
+        kpos = jnp.arange(k.shape[2]) + kv_off
+        s = jnp.where(kpos[None, :] > qpos[:, None], -1e30, s)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    @pytest.mark.parametrize("Sq,Sk,q_off,kv_off", [
+        (64, 128, 64, 0),    # end-aligned decode-append
+        (32, 128, 96, 0),    # deeper append
+        (64, 64, 64, 64),    # both shifted equally == aligned diagonal
+        (64, 64, 128, 64),   # fully-visible KV shard (ring rotation)
+    ])
+    def test_forward_matches_dense_oracle(self, Sq, Sk, q_off, kv_off):
+        r = np.random.RandomState(5)
+        q, k, v = [
+            jnp.asarray(r.rand(2, 2, s, 32).astype(np.float32) - 0.5)
+            for s in (Sq, Sk, Sk)
+        ]
+        out = flash_attention(q, k, v, True, 32, 32, None, True,
+                              q_off, kv_off)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(self._dense_offset(q, k, v, q_off, kv_off)),
+            rtol=2e-5, atol=2e-6)
+
+    def test_backward_matches_dense_oracle(self):
+        Sq, Sk, q_off = 32, 96, 64
+        r = np.random.RandomState(6)
+        q, k, v = [
+            jnp.asarray(r.rand(2, 2, s, 32).astype(np.float32) - 0.5)
+            for s in (Sq, Sk, Sk)
+        ]
+        g = jnp.asarray(r.rand(2, 2, Sq, 32).astype(np.float32))
+
+        def f_flash(q, k, v):
+            return (flash_attention(q, k, v, True, 32, 32, None, True,
+                                    q_off, 0) * g).sum()
+
+        def f_dense(q, k, v):
+            return (self._dense_offset(q, k, v, q_off, 0) * g).sum()
+
+        gf = jax.grad(f_flash, (0, 1, 2))(q, k, v)
+        gd = jax.grad(f_dense, (0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_default_offsets_keep_r5_signature(self):
+        """Positional callers that predate the offset params (sharded
+        seam, ring attention, benches) get offset 0 — identical to the
+        r5 kernel."""
+        q, k, v = _qkv(3)
+        out_old = flash_attention(q, k, v, True, 64, 64, None, True)
+        out_new = flash_attention(q, k, v, True, 64, 64, None, True,
+                                  0, 0)
+        np.testing.assert_array_equal(np.asarray(out_old),
+                                      np.asarray(out_new))

@@ -206,7 +206,10 @@ class ModuleGraph:
     def _mark_compiled(self):
         roots = set(self._wrapper_targets())
         # step-body methods of *Step classes are compiled by contract
-        # even when the jax.jit call lives in another module
+        # even when the jax.jit call lives in another module — this is
+        # the list that covers TrainStep/LocalSGDStep AND the serving
+        # DecodeStep/PrefillStep (ISSUE 9): host-sync/donation/numpy
+        # rules police the decode path through the same suffix match
         for (cname, fname), info in self.funcs.items():
             if cname and cname.endswith("Step") and fname in (
                     "_step_fn", "step_fn", "_worker"):
