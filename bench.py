@@ -411,6 +411,93 @@ def _bench_gpt_multichip(steps=10, seq=1024, shard_off=False):
     }
 
 
+def _bench_gpt_dp_q8(steps=10, seq=1024, quant=True):
+    """GPT-medium training step on a hierarchical dcn x ici dp mesh with
+    the dcn hop quantized (ISSUE 10) vs full-width f32: the
+    `gpt_medium_bf16_dp_q8_*` / `*_q8_off_*` pair under the
+    tools/bench_continuity.py >10% gate. Both configs run the explicit
+    per-grad dcn reduction (async_dcn_allreduce), so the ONLY difference
+    is the wire width of the slow inter-node hop — int8 payload +
+    per-block scales vs f32. The static comm-byte estimate rides along
+    report-only (`gpt_medium_bf16_dp_q8_comm_mb`). Runs when the job
+    spans >= 4 devices with an even count (dcn = ndev/2 x ici 2)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.jit import TrainStep
+
+    ndev = len(jax.devices())
+    try:
+        paddle.seed(0)
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        strategy.hierarchical_allreduce = True
+        strategy.hierarchical_allreduce_inter_nranks = 2
+        strategy.async_dcn_allreduce = True
+        if quant:
+            strategy.quantized_allreduce = "int8"
+        fleet.init(is_collective=True, strategy=strategy)
+        model = _gpt_medium()
+        fl_model = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(
+            optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                            parameters=model.parameters())
+        )
+
+        def lm_loss(h, labels):
+            d = h.shape[-1]
+            return nn.functional.fused_linear_cross_entropy(
+                h.reshape([-1, d]), model.head.weight, model.head.bias,
+                labels.reshape([-1]),
+            )
+
+        step = TrainStep(fl_model, lm_loss, opt)
+        batch = 4 * ndev  # 4 per data-parallel shard
+        ids = fl_model.shard_input(
+            (np.arange(batch * seq) % 31000).reshape(batch, seq)
+            .astype(np.int32)
+        )
+        labels = fl_model.shard_input(
+            ((np.arange(batch * seq) + 1) % 31000).reshape(batch, seq)
+            .astype(np.int32)
+        )
+        _ = np.asarray(ids._data.ravel()[:1])
+
+        t0 = time.perf_counter()
+        loss = step(ids, labels)
+        _ = np.asarray(loss._data)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(ids, labels)
+        _ = np.asarray(loss._data)
+        dt = time.perf_counter() - t0
+        tok_s = steps * batch * seq / dt
+        comm = step._grad_comm_info
+    finally:
+        from paddle_tpu.distributed import comm as _comm
+
+        _comm._state.hybrid_mesh = None
+    tag = "" if quant else "_off"
+    out = {
+        f"gpt_medium_bf16_dp_q8{tag}_step_ms": round(dt / steps * 1e3, 2),
+        f"gpt_medium_bf16_dp_q8{tag}_tokens_per_sec": round(tok_s, 0),
+        f"gpt_medium_bf16_dp_q8{tag}_compile_s": round(compile_s, 1),
+    }
+    if quant and comm:
+        # report-only (no per_sec/_ms suffix -> never gated): the dcn
+        # hop's priced bytes, payload + scales
+        out["gpt_medium_bf16_dp_q8_comm_mb"] = round(
+            comm["bytes_on_wire"] / 1e6, 1)
+        out["gpt_medium_bf16_dp_q8_comm_reduction_x"] = \
+            comm["reduction_x"]
+    return out
+
+
 def _bench_decode(batch_sizes=(1, 8, 64), prompt_len=128, new_tokens=64):
     """Serving bench (ISSUE 9): the compiled prefill/decode pair over
     the GPT-medium-shaped TransformerLM (same decoder the training
@@ -687,6 +774,27 @@ def main():
         )
         extra.update(mcd_d)
         extra["gpt_medium_bf16_dp_mp_dense_tokens_per_sec_spread"] = mcd_sp
+
+    if len(jax.devices()) >= 4 and len(jax.devices()) % 2 == 0:
+        # quantized dcn-hop pair (ISSUE 10): int8 block-scaled grad
+        # allreduce over the slow inter-node hop vs the f32 hop, both on
+        # the hierarchical dcn x ici mesh with the explicit per-grad
+        # reduction — the wire-width win lands under the >10% gate and
+        # the priced comm bytes ride report-only
+        _, q8_d, q8_sp = _repeat(
+            lambda: (lambda d: (
+                d["gpt_medium_bf16_dp_q8_tokens_per_sec"], d))(
+                _bench_gpt_dp_q8(quant=True))
+        )
+        extra.update(q8_d)
+        extra["gpt_medium_bf16_dp_q8_tokens_per_sec_spread"] = q8_sp
+        _, q8o_d, q8o_sp = _repeat(
+            lambda: (lambda d: (
+                d["gpt_medium_bf16_dp_q8_off_tokens_per_sec"], d))(
+                _bench_gpt_dp_q8(quant=False))
+        )
+        extra.update(q8o_d)
+        extra["gpt_medium_bf16_dp_q8_off_tokens_per_sec_spread"] = q8o_sp
 
     if jax.default_backend() == "tpu":  # compiled pallas is TPU-only
         # single-shot by design: 500 iterations already run inside ONE

@@ -92,12 +92,18 @@ class _DistributedOptimizer:
         object.__setattr__(self, "_inner", optimizer)
         object.__setattr__(self, "user_defined_strategy", strategy)
         object.__setattr__(self, "_gm_calls", 0)
+        # set by jit.TrainStep when it routes the quantized grad comm
+        # through the explicit manual-over-'dcn' exchange — the boundary
+        # round trip here must then stand down (quantizing twice would
+        # double the error the parity gates budget for once)
+        object.__setattr__(self, "_quant_explicit", False)
 
     def __getattr__(self, name):
         return getattr(object.__getattribute__(self, "_inner"), name)
 
     def __setattr__(self, name, value):
-        if name in ("_inner", "user_defined_strategy", "_gm_calls"):
+        if name in ("_inner", "user_defined_strategy", "_gm_calls",
+                    "_quant_explicit"):
             object.__setattr__(self, name, value)
         else:
             setattr(self._inner, name, value)  # e.g. _step_count, _lr
@@ -172,6 +178,45 @@ class _DistributedOptimizer:
     def _fp16_allreduce(self) -> bool:
         return bool(self.user_defined_strategy.fp16_allreduce)
 
+    @property
+    def _quant_policy(self):
+        """strategy.quantized_allreduce as a validated ("int8"|"fp8",
+        block) pair, or None."""
+        from .. import quantized_comm as qc
+
+        s = self.user_defined_strategy
+        return qc.resolve_policy(
+            s.quantized_allreduce, s.quantized_allreduce_block
+        )
+
+    def _quant_cast(self, g):
+        """strategy.quantized_allreduce at the grad-comm boundary (same
+        seam and contract as the bf16 _comm_cast, at block-quantized
+        width): the grad value entering the f32 master update has passed
+        the symmetric per-block quantizer exactly once — the error model
+        of the quantized wire. Used when no explicit dcn exchange owns
+        the policy (flat-dp mesh / eager steps); TrainStep sets
+        _quant_explicit when the manual-over-'dcn' quantized allreduce
+        is the one doing the narrowing."""
+        import jax.numpy as jnp
+
+        from .. import quantized_comm as qc
+
+        if g.dtype != jnp.float32:
+            return g
+        dtype, block = self._quant_policy
+        return qc.quantize_dequantize(g, dtype=dtype, block=block)
+
+    def _comm_width_cast(self):
+        """The active grad-comm width policy's cast fn, or None (one
+        policy at a time — distributed_optimizer rejects combining
+        fp16_allreduce with quantized_allreduce)."""
+        if self._fp16_allreduce:
+            return self._comm_cast
+        if self._quant_policy is not None and not self._quant_explicit:
+            return self._quant_cast
+        return None
+
     # -- functional path hooks (consumed by jit.TrainStep) -------------------
     def _functional_state(self, params):
         state = self._inner._functional_state(params)
@@ -215,9 +260,9 @@ class _DistributedOptimizer:
         gm_buf = state.pop("@gm_buf", None)
         gm_cnt = state.pop("@gm_cnt", None)
 
-        if self._fp16_allreduce:
-            g_raws = [g if g is None else self._comm_cast(g)
-                      for g in g_raws]
+        width_cast = self._comm_width_cast()
+        if width_cast is not None:
+            g_raws = [g if g is None else width_cast(g) for g in g_raws]
 
         if stage >= 2:
             g_raws = [g if g is None else self._zero_constrain(g)
@@ -274,13 +319,14 @@ class _DistributedOptimizer:
         return new_p, new_state
 
     # -- eager path ----------------------------------------------------------
-    def _comm_cast_grads(self):
+    def _comm_cast_grads(self, cast):
         for p in self._inner._get_params():
             if p.grad is not None:
-                p.grad._data = self._comm_cast(p.grad._data)
+                p.grad._data = cast(p.grad._data)
 
     def step(self):
         k = self._gm_k
+        width_cast = self._comm_width_cast()
         if k > 1:
             self._gm_calls += 1
             if self._gm_calls % k != 0:
@@ -289,16 +335,17 @@ class _DistributedOptimizer:
                 for p in self._inner._get_params():
                     if p.grad is not None:
                         p.grad._data = p.grad._data / k
-            # ONE bf16 round trip on the merged grad at the apply
-            # boundary — casting every micro-step would re-quantize the
-            # running sum k times and compound the error
-            if self._fp16_allreduce:
-                self._comm_cast_grads()
+            # ONE width round trip (bf16 or block-quantized) on the
+            # merged grad at the apply boundary — casting every
+            # micro-step would re-quantize the running sum k times and
+            # compound the error
+            if width_cast is not None:
+                self._comm_cast_grads(width_cast)
             out = self._inner.step()
             self._inner.clear_grad()
             return out
-        if self._fp16_allreduce:
-            self._comm_cast_grads()
+        if width_cast is not None:
+            self._comm_cast_grads(width_cast)
         return self._inner.step()
 
     def clear_grad(self):
@@ -513,11 +560,42 @@ class Fleet:
         if strategy is not None:
             self._strategy = strategy
         s = self._strategy
-        if s.dgc:
-            raise NotImplementedError(
-                "dgc (top-k sparsified allreduce) is not built; the TPU "
-                "analog would be a quantized allreduce (SURVEY.md §2.9)"
+        if s.dgc and s.fp16_allreduce:
+            # don't route-then-blame: the user set dgc + fp16_allreduce,
+            # not quantized_allreduce — name the actual conflict
+            raise ValueError(
+                "dgc routes to the quantized_allreduce grad-comm width "
+                "policy, which cannot combine with fp16_allreduce — "
+                "drop one of dgc/fp16_allreduce"
             )
+        if s.dgc:
+            # VERDICT row 33, the last loud-raise strategy: DGC's top-k
+            # sparsified allreduce has no TPU-native form (a sparse
+            # exchange has no GSPMD lowering), but its goal — grad-comm
+            # bytes — is exactly what the block-scaled quantized
+            # allreduce delivers, so the flag routes there (SURVEY §5)
+            import warnings
+
+            warnings.warn(
+                "strategy.dgc (top-k sparsified allreduce) is deprecated "
+                "on TPU: routing to the block-scaled quantized allreduce "
+                "policy (strategy.quantized_allreduce='int8'), the "
+                "TPU-native bandwidth-reduction analog",
+                DeprecationWarning, stacklevel=2,
+            )
+            if not s.quantized_allreduce:
+                s.quantized_allreduce = "int8"
+        if s.quantized_allreduce:
+            from .. import quantized_comm as _qc
+
+            _qc.resolve_policy(          # loud on typos / missing fp8
+                s.quantized_allreduce, s.quantized_allreduce_block
+            )
+            if s.fp16_allreduce:
+                raise ValueError(
+                    "fp16_allreduce and quantized_allreduce are both "
+                    "grad-comm width policies — enable one, not both"
+                )
         if s.a_sync:
             raise NotImplementedError(
                 "a_sync is parameter-server mode — out of the TPU scope"

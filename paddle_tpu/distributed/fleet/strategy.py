@@ -87,6 +87,24 @@ _DEFAULTS = {
     # backward region (nested shard_map over a manual axis is
     # ill-formed): the model composes through its dense forms there.
     "async_dcn_allreduce": False,
+    # block-scaled quantized grad allreduce (EQuARX, PAPERS.md):
+    # "int8" | "fp8" narrows the grad-comm payload with symmetric
+    # per-block (quantized_allreduce_block-wide) scales exchanged
+    # alongside it, f32 master apply (distributed/quantized_comm.py).
+    # Composed with hierarchical_allreduce the policy quantizes ONLY the
+    # slow dcn hop — the step routes through the manual-over-'dcn' seam
+    # (dcn_value_and_grad) where each grad's inter-node exchange is an
+    # explicit quantized collective (ici stays full-width under GSPMD),
+    # inheriting that seam's constraints (buffer-free model, no fp16
+    # dynamic loss scaling, fixed-divisor batch-mean loss). On a flat dp
+    # mesh / eager steps the policy is the boundary round trip at the
+    # comm seam (the fp16_allreduce contract at int8/fp8 width). One
+    # width policy at a time: combining with fp16_allreduce raises.
+    "quantized_allreduce": None,
+    "quantized_allreduce_block": 128,
+    # dgc (top-k sparsified allreduce) is DEPRECATED on TPU: setting it
+    # routes to quantized_allreduce="int8" with a warning — the
+    # TPU-native bandwidth-reduction analog (SURVEY §5; VERDICT row 33)
     "dgc": False,
     "a_sync": False,
     # parity-accepted, no-op on TPU (XLA owns comm fusion/scheduling)

@@ -271,6 +271,9 @@ class ParallelMultiHeadAttention(Layer):
         from ..nn.layers.transformer import MultiHeadAttention
 
         H, dh = self.num_heads, self.head_dim
+        from . import quantized_comm as qc
+
+        kvq = qc.kv_quant_policy(dtype)
         dt = dtype or self._dtype  # follow the layer dtype (bf16 models
         #                            get bf16 caches, like the 1-chip MHA)
         shape = (int(batch_size), H, int(max_length), dh)
@@ -290,14 +293,26 @@ class ParallelMultiHeadAttention(Layer):
             bspec = baxes[0] if len(baxes) == 1 else tuple(baxes)
         spec = P(bspec, "mp" if (mp > 1 and H % mp == 0) else None,
                  None, None)
-        out = []
-        for _ in range(2):
-            z = jnp.zeros(shape, dt)
+
+        def place(z):
             if self.mesh.size > 1:
+                # the scale buffer's leading dims match the payload's,
+                # so one spec lays out both
                 z = jax.device_put(z, NamedSharding(self.mesh, spec))
             # _wrap, not Tensor(): the ctor's dtype inference would
             # np.asarray the buffer — a device read per cache allocation
-            out.append(Tensor._wrap(z))
+            return Tensor._wrap(z)
+
+        if kvq is not None:
+            # int8/fp8 block-scaled KV cache (ISSUE 10): payload +
+            # per-row-block scales shard identically (batch over dp,
+            # heads over mp); decode writes quantize, reads dequantize
+            def qkv_buf():
+                p, s = qc.kv_zero(shape, kvq)
+                return qc.QuantKV(place(p), place(s))
+
+            return MultiHeadAttention.Cache(qkv_buf(), qkv_buf())
+        out = [place(jnp.zeros(shape, dt)) for _ in range(2)]
         return MultiHeadAttention.Cache(out[0], out[1])
 
     def forward(self, x, cache=None, pos=None):

@@ -251,7 +251,8 @@ def column_gather_overlap(x, w, b, mesh, mp, row_ax, axis="mp"):
 # ---------------------------------------------------------------------------
 
 
-def dcn_value_and_grad(loss_of, mesh, p_raws, key, in_raws, label_raws):
+def dcn_value_and_grad(loss_of, mesh, p_raws, key, in_raws, label_raws,
+                       quant=None):
     """value_and_grad of the training loss with the inter-node ('dcn')
     gradient reduction explicit and per-grad (manual over 'dcn', GSPMD
     auto over every other axis). `loss_of(p_tuple, b_raws, key, in_raws,
@@ -265,6 +266,18 @@ def dcn_value_and_grad(loss_of, mesh, p_raws, key, in_raws, label_raws):
     variable-denominator losses scale/bias under the per-group pmean),
     with each grad's dcn pmean placed at its definition point in the
     backward dataflow.
+
+    ``quant`` — a quantized_comm.resolve_policy pair ("int8"|"fp8",
+    block) — swaps each grad's dcn pmean for the block-scaled
+    ``quantized_pmean`` (ISSUE 10): the ici hop inside each dcn group
+    stays full-width under GSPMD; each group's contribution to the slow
+    inter-node exchange passes the symmetric per-block quantizer before
+    the f32-master reduction (the EQuARX error model; see
+    quantized_comm.quantized_pmean for why the narrow-payload
+    ``quantized_allreduce`` form cannot lower in this partial-manual
+    region). The per-grad placement is unchanged, so the quantized hop
+    inherits the same overlap-behind-backward schedule. The loss scalar
+    stays full-width.
     """
     dcn = int(mesh.shape["dcn"])
     for r in tuple(in_raws) + tuple(label_raws):
@@ -275,6 +288,19 @@ def dcn_value_and_grad(loss_of, mesh, p_raws, key, in_raws, label_raws):
                 f"{tuple(r.shape)}"
             )
     auto = frozenset(a for a in mesh.axis_names if a != "dcn")
+    if quant is None:
+        reduce_grad = lambda g: jax.lax.pmean(g, "dcn")
+    else:
+        # quantized_pmean, not quantized_allreduce: this region is
+        # PARTIAL-manual (GSPMD auto over ici/mp) and this XLA admits
+        # only all-reduce collectives in manual subgroups — see the
+        # quantized_comm.quantized_pmean docstring for the trade
+        from . import quantized_comm as _qc
+
+        q_dtype, q_block = quant
+        reduce_grad = lambda g: _qc.quantized_pmean(
+            g, "dcn", dtype=q_dtype, block=q_block
+        )
 
     def body(p, k, ins, lbls):
         global _MANUAL_DCN
@@ -294,9 +320,10 @@ def dcn_value_and_grad(loss_of, mesh, p_raws, key, in_raws, label_raws):
             _MANUAL_DCN = False
         # the explicit dcn hop, one collective PER GRAD at the grad's
         # own position in the dataflow — schedulable behind the rest of
-        # backward, un-combinable into a tail collective
+        # backward, un-combinable into a tail collective (full-width
+        # pmean, or the block-quantized exchange under the policy)
         grads = tuple(
-            g if g is None else jax.lax.pmean(g, "dcn") for g in grads
+            g if g is None else reduce_grad(g) for g in grads
         )
         return jax.lax.pmean(loss, "dcn"), grads
 

@@ -1,0 +1,327 @@
+"""Block-scaled quantization for the comm plane (ISSUE 10):
+symmetric per-block int8 / fp8-e4m3 quantize/dequantize primitives, the
+quantized dcn-hop allreduce built on them, and the quantized KV-cache
+layout serving reuses.
+
+EQuARX lineage ("Efficient Quantized AllReduce in XLA", PAPERS.md): the
+slow inter-node (dcn) hop of a hierarchical grad reduction moves MOST of
+the bytes and tolerates narrow payloads — per-block scales recover the
+dynamic range a single tensor-wide scale loses on long-tailed grads.
+Everything here is a PURE function of arrays (no custom VJP, no state):
+the primitives sit AFTER value_and_grad in the step dataflow (grad comm)
+or in inference-only paths (KV cache), so autodiff never traverses them,
+and jit/shard_map trace them like any other jnp code.
+
+Two forms of the grad-comm policy consume these primitives:
+
+* ``quantized_allreduce(g, axis)`` — the WIRE-TRUE exchange, callable
+  inside a shard_map region MANUAL over ``axis`` (the PR 6
+  ``dcn_value_and_grad`` seam): each dcn group quantizes its local
+  (already ici-reduced — GSPMD owns the fast full-width inner hop)
+  partial grad, all-gathers payloads + per-block scales over the axis
+  (int8/fp8 bytes plus a 1/block-sized f32 side channel on the wire),
+  dequantizes each peer's contribution and reduces in f32 — the f32
+  master apply then sees the mean of the per-group block-quantized
+  values. The reduction itself never happens in the narrow dtype.
+
+* ``quantize_dequantize(g)`` — the BOUNDARY round trip for programs with
+  no explicit dcn seam (flat-dp meshes / eager steps), the same contract
+  as the bf16 ``fp16_allreduce`` policy: the grad value entering the f32
+  master update is exactly a block-quantized-width number (one pass
+  through the quantizer — the error model of the quantized wire),
+  while the reduction XLA emits stays wherever the compiler put it.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SUPPORTED", "fp8_dtype", "resolve_policy", "quantize_blockwise",
+    "dequantize_blockwise", "quantize_dequantize", "quantized_allreduce",
+    "quantized_pmean", "quantize_lastaxis", "dequantize_lastaxis",
+    "QuantKV", "kv_quant_policy", "kv_zero", "wire_bytes",
+    "grad_comm_info",
+]
+
+#: grad-comm policy dtypes DistributedStrategy.quantized_allreduce accepts
+SUPPORTED = ("int8", "fp8")
+
+#: symmetric int8 range: +-127 (the -128 code is never emitted, keeping
+#: the quantizer symmetric so sign(x) == sign(q))
+_INT8_QMAX = 127.0
+#: largest finite float8_e4m3fn value
+_FP8_QMAX = 448.0
+
+
+def fp8_dtype():
+    """jnp.float8_e4m3fn where this jax has it, else None."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def resolve_policy(value, block=128):
+    """Validate a strategy (quantized_allreduce, quantized_allreduce_block)
+    pair -> ("int8"|"fp8", block) or None. Loud on unknown dtypes and on
+    fp8 without the dtype in this jax (silently training at a different
+    width than asked is the one failure mode a comm policy must not
+    have)."""
+    if value is None or value is False or value == "":
+        return None
+    v = str(value).strip().lower()
+    if v not in SUPPORTED:
+        raise ValueError(
+            f"quantized_allreduce={value!r}: supported policies are "
+            f"{SUPPORTED} (or None to disable)"
+        )
+    if v == "fp8" and fp8_dtype() is None:
+        raise NotImplementedError(
+            "quantized_allreduce='fp8' needs jnp.float8_e4m3fn, which "
+            "this jax does not provide; use 'int8'"
+        )
+    b = int(block)
+    if b <= 0:
+        raise ValueError(
+            f"quantized_allreduce_block={block} must be a positive "
+            "block width"
+        )
+    return v, b
+
+
+def _qparams(dtype: str):
+    if dtype == "int8":
+        return jnp.int8, _INT8_QMAX
+    if dtype == "fp8":
+        f8 = fp8_dtype()
+        if f8 is None:
+            raise NotImplementedError("no float8_e4m3fn in this jax")
+        return f8, _FP8_QMAX
+    raise ValueError(f"unknown quantization dtype {dtype!r}")
+
+
+def _encode(x32, scale, qdtype, qmax):
+    """Scale-then-narrow one block layout (x32 f32, scale broadcastable).
+    int8 rounds-to-nearest and clips; fp8 relies on the cast (the scale
+    maps the block amax onto the largest finite e4m3 value, so nothing
+    saturates)."""
+    safe = jnp.where(scale > 0, scale, 1.0)
+    y = x32 / safe
+    if qdtype == jnp.int8:
+        return jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    return y.astype(qdtype)
+
+
+def quantize_blockwise(x, dtype: str = "int8", block: int = 128):
+    """x (any shape) -> (payload [nb, block] narrow, scales [nb] f32).
+
+    The array is flattened and zero-padded to a block multiple; each
+    128-wide (``block``) run gets one symmetric scale amax/qmax. Zero
+    blocks encode as zero payload with zero scale (dequantizes to exact
+    zeros)."""
+    qdtype, qmax = _qparams(dtype)
+    flat = jnp.ravel(x).astype(jnp.float32)
+    n = flat.shape[0]
+    nb = -(-n // block)
+    flat = jnp.pad(flat, (0, nb * block - n))
+    xb = flat.reshape(nb, block)
+    scales = jnp.max(jnp.abs(xb), axis=1) / qmax
+    payload = _encode(xb, scales[:, None], qdtype, qmax)
+    return payload, scales.astype(jnp.float32)
+
+
+def dequantize_blockwise(payload, scales, shape, out_dtype=jnp.float32):
+    """Inverse of :func:`quantize_blockwise` back onto ``shape``."""
+    flat = payload.astype(jnp.float32) * scales[:, None].astype(jnp.float32)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return flat.reshape(-1)[:n].reshape(shape).astype(out_dtype)
+
+
+def quantize_dequantize(x, dtype: str = "int8", block: int = 128):
+    """The boundary round trip: x passes the block quantizer once and
+    comes back at its own dtype — the grad-comm width policy for
+    programs whose reduction has no explicit dcn seam (the bf16
+    ``_comm_cast`` contract at int8/fp8 width)."""
+    p, s = quantize_blockwise(x, dtype, block)
+    return dequantize_blockwise(p, s, x.shape, x.dtype)
+
+
+def quantized_allreduce(x, axis: str, *, dtype: str = "int8",
+                        block: int = 128, mean: bool = True):
+    """Block-quantized allreduce over the named mesh axis — call inside
+    a shard_map region MANUAL over ``axis`` (e.g. the async-dcn grad
+    body). Exchanges per-block scales alongside the narrow payload and
+    applies the reduction against an f32 master:
+
+      local quantize -> all_gather(payload, scales) over ``axis`` ->
+      per-peer f32 dequantize -> f32 sum (mean) -> cast to x.dtype.
+
+    With an all-gather the wire moves (axis_size x) the quantized bytes
+    — for the small dcn degrees this hop targets (2-8 pods) that is the
+    one-shot EQuARX variant; the payload is 1/4 (int8 vs f32) plus a
+    1/block scale side channel, so the hop's bytes drop ~3.8x at
+    block=128."""
+    payload, scales = quantize_blockwise(x, dtype, block)
+    all_p = jax.lax.all_gather(payload, axis)   # [n, nb, block]
+    all_s = jax.lax.all_gather(scales, axis)    # [n, nb]
+    contrib = all_p.astype(jnp.float32) * all_s[..., None]
+    total = jnp.sum(contrib, axis=0)            # f32 master accumulate
+    if mean:
+        total = total / all_p.shape[0]
+    n = x.size
+    return total.reshape(-1)[:n].reshape(x.shape).astype(x.dtype)
+
+
+def quantized_pmean(x, axis: str, *, dtype: str = "int8",
+                    block: int = 128):
+    """The quantized hop's form for PARTIAL-manual shard_map regions
+    (manual over ``axis``, GSPMD auto over ici/mp — the
+    ``dcn_value_and_grad`` seam): per-shard block quantize-dequantize,
+    then a full-width pmean.
+
+    Why not :func:`quantized_allreduce` there: this XLA's SPMD
+    partitioner admits only all-reduce collectives inside manual
+    SUBGROUPS — ``all_gather`` and ``ppermute`` both trip the
+    ``IsManualSubgroup`` check (spmd_partitioner.cc:512) when other mesh
+    axes stay auto, so the narrow-payload exchange cannot lower in the
+    partial-auto region. This form keeps the quantized exchange's
+    NUMERICS — each dcn group's contribution passes the symmetric
+    per-block quantizer BEFORE the reduction and the f32 master
+    accumulates the group values (the EQuARX error model: n independent
+    per-group quantization errors averaged, NOT one post-reduction
+    round trip) — and keeps the per-grad definition-point placement, so
+    the overlap schedule is unchanged. The wire-byte win is what the
+    ``grad_comm`` telemetry prices and what ``quantized_allreduce``
+    realizes wherever a full-manual region is available."""
+    q = quantize_dequantize(x, dtype, block)
+    return jax.lax.pmean(q, axis)
+
+
+# ---------------------------------------------------------------------------
+# last-axis block layout (the KV-cache form)
+# ---------------------------------------------------------------------------
+
+
+def _lastaxis_block(d: int, block: int) -> int:
+    """Effective block width along a length-d last axis: the requested
+    width when it tiles, else the whole row (one scale per row — a head
+    dim of 64 under block=128 gets per-row scales, which is exactly the
+    per-token-per-head scaling a KV cache wants)."""
+    return block if (block > 0 and d % block == 0) else d
+
+
+def quantize_lastaxis(x, dtype: str = "int8", block: int = 128):
+    """x [..., D] -> (payload [..., D] narrow, scales [..., D/bs] f32),
+    blocks along the LAST axis so a [B, H, cap, Dh] KV buffer keeps its
+    shape (in-place decode writes stay one dynamic_update_slice) and the
+    scales ride a parallel [B, H, cap, nb] buffer."""
+    qdtype, qmax = _qparams(dtype)
+    d = int(x.shape[-1])
+    bs = _lastaxis_block(d, block)
+    xr = x.astype(jnp.float32).reshape(x.shape[:-1] + (d // bs, bs))
+    scales = jnp.max(jnp.abs(xr), axis=-1) / qmax
+    payload = _encode(xr, scales[..., None], qdtype, qmax)
+    return payload.reshape(x.shape), scales.astype(jnp.float32)
+
+
+def dequantize_lastaxis(payload, scales, out_dtype=jnp.float32):
+    """Inverse of :func:`quantize_lastaxis`."""
+    d = int(payload.shape[-1])
+    nb = int(scales.shape[-1])
+    pr = payload.astype(jnp.float32).reshape(
+        payload.shape[:-1] + (nb, d // nb))
+    out = pr * scales[..., None].astype(jnp.float32)
+    return out.reshape(payload.shape).astype(out_dtype)
+
+
+#: quantized K or V cache buffer: `q` holds the narrow payload at the
+#: full [B, H, cap, Dh] cache shape, `scale` the per-block f32 scales
+#: [B, H, cap, Dh/bs]. A namedtuple, so it is a pytree — DecodeStep
+#: donates/pins it leaf-wise exactly like the f32 Cache entries, and the
+#: engine's CacheInsert splice tree_maps over both leaves by batch dim.
+QuantKV = namedtuple("QuantKV", ["q", "scale"])
+
+
+def kv_quant_policy(dtype):
+    """Resolve a ``gen_cache(dtype=)`` request (plus the
+    ``PADDLE_SERVE_KV_QUANT`` env default when no dtype is passed) into
+    "int8" | "fp8" | None. A non-policy value (a real array dtype like
+    bf16, or unset) returns None — the caller builds the plain
+    full-width cache from it."""
+    import os
+
+    v = dtype
+    if v is None:
+        env = os.environ.get("PADDLE_SERVE_KV_QUANT", "").strip().lower()
+        if not env or env in ("0", "off", "false", "none"):
+            return None
+        if env not in SUPPORTED:
+            # the env knob takes ONLY policy names — a typo must not
+            # silently serve at full width
+            raise ValueError(
+                f"PADDLE_SERVE_KV_QUANT={env!r}: supported values are "
+                f"{SUPPORTED} (or 0/off)"
+            )
+        v = env
+    if isinstance(v, str) and v.lower() in SUPPORTED:
+        v = v.lower()
+        if v == "fp8" and fp8_dtype() is None:
+            raise NotImplementedError(
+                "PADDLE_SERVE_KV_QUANT/gen_cache dtype 'fp8' needs "
+                "jnp.float8_e4m3fn, which this jax does not provide; "
+                "use 'int8'"
+            )
+        return v
+    return None
+
+
+def kv_zero(shape, dtype: str = "int8", block: int = 128):
+    """Zero-filled (payload, scales) raw arrays for a fresh quantized
+    KV-cache buffer of ``shape`` [B, H, cap, Dh] (zero scales dequantize
+    to exact zeros, matching the f32 cache's zero fill)."""
+    qdtype, _ = _qparams(dtype)
+    d = int(shape[-1])
+    bs = _lastaxis_block(d, block)
+    return (jnp.zeros(shape, qdtype),
+            jnp.zeros(tuple(shape[:-1]) + (d // bs,), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# byte accounting (observability: bytes-on-wire, all static ints)
+# ---------------------------------------------------------------------------
+
+
+def wire_bytes(n_elems: int, dtype, block: int = 128) -> int:
+    """Bytes one grad-comm hop moves for ``n_elems`` gradient elements
+    under the named width policy: quantized payload (1 byte/elem for
+    int8 and fp8-e4m3) plus the f32 per-block scale side channel;
+    full-width dtypes have no side channel. Static-shape arithmetic —
+    zero device reads."""
+    if dtype in SUPPORTED:
+        nb = -(-int(n_elems) // int(block))
+        return int(n_elems) + 4 * nb
+    itemsize = {"float32": 4, "bfloat16": 2, "float16": 2}.get(
+        str(dtype), 4)
+    return int(n_elems) * itemsize
+
+
+def grad_comm_info(n_elems: int, policy, *, fp16_allreduce=False) -> dict:
+    """The static ``grad_comm`` telemetry record: grad-comm dtype and
+    actual bytes-on-wire per step (payload + scales) next to the f32
+    baseline. ``policy`` is a resolve_policy() pair or None."""
+    if policy is not None:
+        dtype, block = policy
+    else:
+        dtype, block = ("bfloat16" if fp16_allreduce else "float32"), 0
+    wire = wire_bytes(n_elems, dtype, block or 128)
+    f32 = 4 * int(n_elems)
+    return {
+        "dtype": dtype,
+        "block": int(block),
+        "grad_elems": int(n_elems),
+        "bytes_on_wire": int(wire),
+        "bytes_f32": int(f32),
+        "reduction_x": round(f32 / wire, 2) if wire else 1.0,
+    }

@@ -102,12 +102,27 @@ class TrainStep:
         self._guard = None            # set below (delegate owns its own)
         self._guard_state = ()
         self._inject_enabled = False
+        self._dcn_quant = None        # quantized dcn-hop exchange policy
+        self._quant_info = None       # resolved width policy (telemetry)
         strategy = getattr(optimizer, "user_defined_strategy", None)
         if strategy is not None:
+            if strategy.quantized_allreduce:
+                from ..distributed import quantized_comm as _qc
+
+                self._quant_info = _qc.resolve_policy(
+                    strategy.quantized_allreduce,
+                    strategy.quantized_allreduce_block,
+                )
             if strategy.localsgd:
                 if strategy.amp or strategy.recompute:
                     raise NotImplementedError(
                         "localsgd does not compose with amp/recompute yet"
+                    )
+                if strategy.quantized_allreduce:
+                    raise NotImplementedError(
+                        "localsgd does not compose with "
+                        "quantized_allreduce: LocalSGD replaces per-step "
+                        "grad reduction with periodic parameter averaging"
                     )
                 if strategy.async_dcn_allreduce:
                     # LocalSGDStep has its own comm schedule (periodic
@@ -151,20 +166,32 @@ class TrainStep:
                     )
             if strategy.recompute:
                 self._recompute = True
-            if strategy.async_dcn_allreduce:
-                if not strategy.hierarchical_allreduce:
-                    raise ValueError(
-                        "async_dcn_allreduce requires "
-                        "hierarchical_allreduce: the explicit async hop "
-                        "is the 'dcn' level of the dcn x ici mesh "
-                        "factoring"
-                    )
+            if strategy.async_dcn_allreduce and \
+                    not strategy.hierarchical_allreduce:
+                raise ValueError(
+                    "async_dcn_allreduce requires "
+                    "hierarchical_allreduce: the explicit async hop "
+                    "is the 'dcn' level of the dcn x ici mesh "
+                    "factoring"
+                )
+            # the explicit manual-over-'dcn' grad reduction engages for
+            # async_dcn_allreduce AND for quantized_allreduce composed
+            # with hierarchical_allreduce (ISSUE 10): the quantized
+            # exchange IS a per-grad dcn collective — ici stays
+            # full-width under GSPMD, only the slow hop narrows
+            if strategy.async_dcn_allreduce or (
+                self._quant_info is not None
+                and strategy.hierarchical_allreduce
+            ):
                 if self._loss_scale_cfg is not None:
                     raise NotImplementedError(
-                        "async_dcn_allreduce does not compose with fp16 "
-                        "dynamic loss scaling yet (bf16 amp composes)"
+                        "the explicit dcn grad reduction (async_dcn_"
+                        "allreduce / hierarchical quantized_allreduce) "
+                        "does not compose with fp16 dynamic loss "
+                        "scaling yet (bf16 amp composes)"
                     )
                 self._async_dcn = True
+                self._dcn_quant = self._quant_info
         self._p_objs = [p for p in optimizer._get_params() if p.trainable]
         b_named = dict(model.named_buffers())
         self._b_names = list(b_named)
@@ -198,23 +225,33 @@ class TrainStep:
             if mesh is None or "dcn" not in mesh.axis_names \
                     or int(mesh.shape["dcn"]) <= 1:
                 raise ValueError(
-                    "async_dcn_allreduce: the hybrid mesh has no dcn "
-                    "axis (> 1) — fleet.init with hierarchical_allreduce "
-                    "and a dp_degree that factors must run first"
+                    "the explicit dcn grad reduction (async_dcn_"
+                    "allreduce / hierarchical quantized_allreduce) "
+                    "needs a hybrid mesh with a dcn axis (> 1) — "
+                    "fleet.init with hierarchical_allreduce and a "
+                    "dp_degree that factors must run first"
                 )
             if self._b_objs:
                 # batch-statistic buffers (BN running stats) would be
                 # updated per dcn group and diverge across groups
                 raise NotImplementedError(
-                    "async_dcn_allreduce does not support models with "
-                    "buffers (running batch statistics) yet"
+                    "the explicit dcn grad reduction does not support "
+                    "models with buffers (running batch statistics) yet"
                 )
             if self._ret_out:
                 raise NotImplementedError(
-                    "async_dcn_allreduce does not compose with "
-                    "return_outputs"
+                    "the explicit dcn grad reduction does not compose "
+                    "with return_outputs"
                 )
             self._dcn_mesh = mesh
+            if self._dcn_quant is not None and hasattr(
+                    optimizer, "_quant_explicit"):
+                # the dcn exchange owns the narrowing — the optimizer's
+                # boundary round trip stands down. Set only AFTER the
+                # validation above: a ctor that raised must leave the
+                # optimizer's eager boundary policy armed, not silently
+                # full-width
+                optimizer._quant_explicit = True
         self._donate = donate and jax.default_backend() != "cpu"
         # -- numerical guardrails (utils/train_guard.py): the in-graph
         # sentinel + skip masking engage unless PADDLE_GUARD_MODE=off;
@@ -229,6 +266,21 @@ class TrainStep:
             self._guard._on_rollback = self._after_rollback
             self._guard_state = self._place_guard_state(
                 _TG.init_guard_state())
+        # grad-comm byte accounting (ISSUE 10): the dtype and actual
+        # bytes-on-wire (quantized payload + per-block scales) of one
+        # grad reduction, from STATIC param shapes — zero device reads.
+        # Rides every step_metrics row via the guard's sampler and lands
+        # once on the bus as a `grad_comm` record below.
+        from ..distributed import quantized_comm as _qc
+
+        self._grad_comm_info = _qc.grad_comm_info(
+            sum(int(p._data.size) for p in self._p_objs),
+            self._quant_info,
+            fp16_allreduce=bool(strategy is not None
+                                and strategy.fp16_allreduce),
+        )
+        if self._guard is not None:
+            self._guard._sampler.set_grad_comm(self._grad_comm_info)
         # grad-poison fault injection (PADDLE_FAULT_SPEC=grad:nan:N):
         # decided once at construction — a clean spec keeps the compiled
         # program byte-identical to the unguarded seed program
@@ -254,6 +306,7 @@ class TrainStep:
 
         if _bus.enabled():
             _ledger.install_backend_listener()
+            _bus.emit("grad_comm", self._grad_comm_info, step=0)
 
     # -- the pure program ----------------------------------------------------
     def _amp_guard(self):
@@ -311,7 +364,7 @@ class TrainStep:
 
             loss, grads = dcn_value_and_grad(
                 self._loss_of, self._dcn_mesh, p_raws, key, in_raws,
-                label_raws,
+                label_raws, quant=self._dcn_quant,
             )
             new_b, outs = (), None
         elif self._loss_scale_cfg is None:

@@ -396,17 +396,36 @@ def cache_update(cache, new, pos):
     [B, H, cap, D] `cache` Tensor at per-slot write positions ``pos``
     ([B] int32 Tensor): one vmapped dynamic_update_slice — no concat, no
     shape change, so the compiled decode program is traced ONCE and the
-    cache buffer can be donated. Inference-only (no VJP)."""
+    cache buffer can be donated. Inference-only (no VJP).
+
+    A block-quantized cache (``quantized_comm.QuantKV`` — int8/fp8
+    payload at the full cache shape + per-row-block f32 scales, ISSUE
+    10) quantizes the new rows along the head dim and writes payload and
+    scales with the same per-slot slice — the HBM-resident buffer the
+    decode streams every step stays narrow."""
     import jax.numpy as jnp
 
-    def f(c, u, p):
+    from ...distributed import quantized_comm as qc
+
+    def write(c, u, p):
         return jax.vmap(
             lambda cb, ub, pb: jax.lax.dynamic_update_slice_in_dim(
                 cb, ub.astype(cb.dtype), pb, axis=1
             )
         )(c, u, jnp.asarray(p, jnp.int32))
 
-    return AG.apply_nondiff(f, (cache, new, pos))
+    if isinstance(cache, qc.QuantKV):
+        bs = int(cache.q.shape[-1]) // int(cache.scale.shape[-1])
+        qdtype = "int8" if cache.q.dtype == jnp.int8 else "fp8"
+
+        def fq(cq, cs, u, p):
+            uq, us = qc.quantize_lastaxis(u, dtype=qdtype, block=bs)
+            return write(cq, uq, p), write(cs, us, p)
+
+        out = AG.apply_nondiff(fq, (cache.q, cache.scale, new, pos))
+        return qc.QuantKV(out[0], out[1])
+
+    return AG.apply_nondiff(write, (cache, new, pos))
 
 
 def cached_attention(query, key, value, pos, *, scale=None):
@@ -424,10 +443,14 @@ def cached_attention(query, key, value, pos, *, scale=None):
     kernel via `flash_plan` instead. Inference-only (no VJP)."""
     import jax.numpy as jnp
 
-    sc = scale if scale is not None else int(query.shape[-1]) ** -0.5
-    Sq, Sk = int(query.shape[2]), int(key.shape[2])
+    from ...distributed import quantized_comm as qc
 
-    def f(qr, kr, vr, pr):
+    sc = scale if scale is not None else int(query.shape[-1]) ** -0.5
+    quantized = isinstance(key, qc.QuantKV)
+    Sq = int(query.shape[2])
+    Sk = int((key.q if quantized else key).shape[2])
+
+    def core(qr, kr, vr, pr):
         s = jnp.einsum("bhqd,bhkd->bhqk", qr, kr) * sc
         qpos = pr[:, None].astype(jnp.int32) + jnp.arange(Sq)[None, :]
         kpos = jnp.arange(Sk)
@@ -439,4 +462,16 @@ def cached_attention(query, key, value, pos, *, scale=None):
     from ... import profiler as _prof
 
     with _prof.device_annotation("attention::cached"):
-        return AG.apply_nondiff(f, (query, key, value, pos))
+        if quantized:
+            # dequantize-on-read: the score math runs at the query
+            # dtype, but the buffer the step streams from HBM (the
+            # decode bottleneck) is the narrow payload + scales
+            def fq(qr, kq, ks, vq, vs, pr):
+                kr = qc.dequantize_lastaxis(kq, ks, qr.dtype)
+                vr = qc.dequantize_lastaxis(vq, vs, qr.dtype)
+                return core(qr, kr, vr, pr)
+
+            return AG.apply_nondiff(
+                fq, (query, key.q, key.scale, value.q, value.scale, pos)
+            )
+        return AG.apply_nondiff(core, (query, key, value, pos))

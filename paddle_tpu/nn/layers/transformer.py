@@ -155,13 +155,36 @@ class MultiHeadAttention(Layer):
         else:
             raise ValueError("gen_cache needs `key` or `batch_size`")
         cap = 0 if max_length is None else int(max_length)
+        shape = (B, self.num_heads, cap, self.head_dim)
+        from ...distributed import quantized_comm as qc
+
+        kvq = qc.kv_quant_policy(dtype)
+        if kvq is not None and cap == 0 and dtype is None:
+            # the env default applies only to the static-capacity
+            # serving form — a legacy concat-cache caller in the same
+            # process never opted in and keeps its full-width cache
+            kvq = None
+        if kvq is not None:
+            # int8/fp8 block-scaled KV cache (ISSUE 10): narrow payload
+            # at the cache shape + per-row-block f32 scales, reusing the
+            # quantized-comm primitives; decode writes quantize, reads
+            # dequantize (cache_update / cached_attention)
+            if cap == 0:
+                raise ValueError(
+                    "a quantized KV cache needs the static-capacity "
+                    "form: pass max_length="
+                )
+
+            def qkv_buf():
+                p, s = qc.kv_zero(shape, kvq)
+                return qc.QuantKV(Tensor._wrap(p), Tensor._wrap(s))
+
+            return MultiHeadAttention.Cache(qkv_buf(), qkv_buf())
         dt = dtype or self._dtype
         # _wrap, not Tensor(): the ctor's dtype inference would
         # np.asarray the buffer — a device read per cache allocation
-        zk = Tensor._wrap(
-            jnp.zeros((B, self.num_heads, cap, self.head_dim), dt))
-        zv = Tensor._wrap(
-            jnp.zeros((B, self.num_heads, cap, self.head_dim), dt))
+        zk = Tensor._wrap(jnp.zeros(shape, dt))
+        zv = Tensor._wrap(jnp.zeros(shape, dt))
         return MultiHeadAttention.Cache(zk, zv)
 
     def _finish_output(self, out, weights, cache):

@@ -87,6 +87,14 @@ class StepMetricsSampler:
         self._step_last = 0
         self._examples = 0
         self._tokens = 0
+        self._grad_comm: Optional[dict] = None
+
+    def set_grad_comm(self, info: Optional[dict]) -> None:
+        """Static grad-comm accounting (dtype + bytes-on-wire of one
+        reduction hop, quantized payload + scales — ISSUE 10), computed
+        once by TrainStep from static shapes; riding every row costs
+        zero device reads."""
+        self._grad_comm = dict(info) if info else None
 
     def tick(self, inputs) -> None:
         """Per-step accounting from static input shapes (host ints)."""
@@ -132,6 +140,8 @@ class StepMetricsSampler:
                 payload["examples_per_sec"] = round(examples / dt, 2)
             if tokens:
                 payload["tokens_per_sec"] = round(tokens / dt, 1)
+        if self._grad_comm:
+            payload["grad_comm"] = self._grad_comm
         mem = device_memory()
         if mem:
             payload["device_memory"] = mem

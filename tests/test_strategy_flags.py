@@ -171,14 +171,33 @@ class TestOptimizerSwaps:
         )
         assert isinstance(opt._inner, optimizer.Lars)
 
-    def test_dgc_raises(self):
+    def test_dgc_routes_to_quantized_allreduce(self):
+        """VERDICT row 33, the last loud-raise strategy: dgc now routes
+        to the block-scaled quantized allreduce (the TPU-native
+        bandwidth-reduction analog) with a deprecation warning instead
+        of raising."""
         model = nn.Linear(4, 4)
-        with pytest.raises(NotImplementedError, match="dgc"):
-            _fleet_opt(
+        with pytest.warns(DeprecationWarning, match="quantized"):
+            opt = _fleet_opt(
                 optimizer.Momentum(learning_rate=1e-3,
                                    parameters=model.parameters()),
                 dgc=True,
             )
+        assert opt.user_defined_strategy.quantized_allreduce == "int8"
+        assert opt._quant_policy == ("int8", 128)
+        # an explicit user policy survives the routing (fp8 only where
+        # this jax has the dtype — same gate as test_quantized_comm)
+        from paddle_tpu.distributed import quantized_comm as qc
+
+        if qc.fp8_dtype() is not None:
+            model2 = nn.Linear(4, 4)
+            with pytest.warns(DeprecationWarning):
+                opt2 = _fleet_opt(
+                    optimizer.Momentum(learning_rate=1e-3,
+                                       parameters=model2.parameters()),
+                    dgc=True, quantized_allreduce="fp8",
+                )
+            assert opt2.user_defined_strategy.quantized_allreduce == "fp8"
 
     def test_fp16_allreduce_is_grad_comm_dtype_policy(self):
         """No longer a raise (VERDICT no#35): the flag composes as a

@@ -182,6 +182,19 @@ def _rank_stats(rows: List[dict], coll: List[dict]) -> dict:
     recompiles = [r["payload"] for r in rows if r.get("kind") == "recompile"]
     storms = [r for r in rows if r.get("kind") == "recompile_storm"]
     guard = [r for r in rows if str(r.get("kind", "")).startswith("guard_")]
+    # grad-comm width accounting (ISSUE 10): the static `grad_comm`
+    # record (or its copy riding step_metrics rows) names the grad
+    # reduction's wire dtype and bytes — the quantized payload + scale
+    # bytes the exposed-comm estimate below is pricing
+    grad_comm = None
+    for r in rows:
+        if r.get("kind") == "grad_comm" and isinstance(
+                r.get("payload"), dict):
+            grad_comm = r["payload"]
+    if grad_comm is None:
+        for m in metrics:
+            if isinstance(m.get("grad_comm"), dict):
+                grad_comm = m["grad_comm"]
     coll_s = 0.0
     coll_n = 0
     window: Tuple[Optional[float], Optional[float]] = (None, None)
@@ -214,6 +227,7 @@ def _rank_stats(rows: List[dict], coll: List[dict]) -> dict:
         "coll_s": round(coll_s, 3),
         "exposed_comm_pct": (round(coll_s / span * 100.0, 1)
                              if span > 0 and coll_s else None),
+        "grad_comm": grad_comm,
     }
 
 
@@ -241,6 +255,21 @@ def summarize(streams: Dict[int, List[dict]],
             f"{s['recompiles']:>10}  {fmt(s['compile_s']):>9}  "
             f"{fmt(s['coll_s'], 3):>7}  "
             f"{fmt(s['exposed_comm_pct'], 1):>8}")
+    # grad-comm width lines (deduped: every rank of one job runs the
+    # same program, so one line per distinct policy)
+    seen_comm = []
+    for r in ranks:
+        gc = stats[r].get("grad_comm")
+        if not gc or gc in seen_comm:
+            continue
+        seen_comm.append(gc)
+        wire = gc.get("bytes_on_wire", 0) / 1e6
+        f32 = gc.get("bytes_f32", 0) / 1e6
+        lines.append(
+            f"grad comm: dtype={gc.get('dtype')} "
+            f"wire {wire:.1f} MB/step (f32 {f32:.1f} MB, "
+            f"{gc.get('reduction_x', 1.0)}x)"
+            + (f" block={gc['block']}" if gc.get("block") else ""))
     timed = [(s["median_step_ms"], r) for r, s in stats.items()
              if s["median_step_ms"] is not None]
     if len(timed) > 1:
