@@ -162,6 +162,14 @@ class Tensor:
                 "Tensor.numpy() inside a to_static/jit trace — the value is "
                 "symbolic. Return it from the program instead."
             )
+        zp = getattr(self, "_zero_pad", None)
+        if zp is not None:
+            # ZeRO pad-to-shard-multiple storage (fleet): the host view —
+            # and through it every checkpoint — is the LOGICAL extent
+            axis, logical = zp
+            return np.asarray(self._data)[tuple(
+                slice(0, logical) if a == axis else slice(None)
+                for a in range(self._data.ndim))]
         return np.asarray(self._data)
 
     def item(self, *args):
@@ -238,6 +246,18 @@ class Tensor:
             raw = value._data.astype(self._data.dtype)
         else:
             raw = jnp.asarray(value, dtype=self._data.dtype)
+        zp = getattr(self, "_zero_pad", None)
+        if zp is not None and tuple(raw.shape) != tuple(self._data.shape):
+            # padded ZeRO storage accepts the LOGICAL shape and re-pads,
+            # keeping the sharded placement (checkpoint restore path)
+            axis, logical = zp
+            if raw.ndim == self._data.ndim and raw.shape[axis] == logical:
+                raw = jnp.pad(raw, [
+                    (0, self._data.shape[a] - raw.shape[a]) if a == axis
+                    else (0, 0) for a in range(raw.ndim)])
+                sh = getattr(self._data, "sharding", None)
+                if sh is not None and not _is_tracer(raw):
+                    raw = jax.device_put(raw, sh)
         if tuple(raw.shape) != tuple(self._data.shape):
             raise ValueError(
                 f"set_value shape mismatch: {raw.shape} vs {self._data.shape}"
@@ -305,7 +325,8 @@ class Parameter(Tensor):
     persistable, with an optional trainable switch."""
 
     __slots__ = (
-        "trainable", "optimize_attr", "regularizer", "need_clip", "_tp_spec"
+        "trainable", "optimize_attr", "regularizer", "need_clip", "_tp_spec",
+        "_zero_pad",  # (axis, logical_extent) of padded ZeRO storage
     )
 
     def __init__(self, data, dtype=None, name=None, trainable=True):

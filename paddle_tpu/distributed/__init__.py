@@ -38,6 +38,7 @@ from .parallel import DataParallel  # noqa: F401
 from .pipeline import PipelineLayer, PipelineParallel  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import elastic  # noqa: F401  (ElasticManager, heartbeat)
+from . import resharding  # noqa: F401  (ElasticStep, plan_refactoring)
 # NOTE: .launch is deliberately not imported here — it is the
 # `python -m paddle_tpu.distributed.launch` entry point, and importing it
 # eagerly would trip runpy's re-execution warning.

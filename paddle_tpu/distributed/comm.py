@@ -461,6 +461,26 @@ def hybrid_mesh() -> Optional[Mesh]:
     return _state.hybrid_mesh
 
 
+def set_hybrid_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Swap the job-wide hybrid mesh in place (the elastic-reshard seam:
+    survivors re-factor onto a smaller/larger device set mid-job —
+    distributed/resharding.py). Returns the previous mesh."""
+    prev = _state.hybrid_mesh
+    _state.hybrid_mesh = mesh
+    return prev
+
+
+def rebuild_world(devices: Sequence) -> Group:
+    """Re-point the default communicator ('dp' axis, group id 0) at
+    exactly `devices` — the comm-group half of an elastic reshard: after
+    rank departure/arrival the eager collectives and DataParallel input
+    sharding must span the SURVIVORS, not the spawn-time world."""
+    g = Group(list(devices), axis_name="dp", gid=0)
+    _state.default_group = g
+    _state.groups[0] = g
+    return g
+
+
 def dp_axes(mesh: Optional[Mesh] = None):
     """The mesh axis (or axis pair) data-parallel work shards over:
     'dp' on a flat mesh, ('dcn', 'ici') on a hierarchical one. The tuple

@@ -23,6 +23,23 @@ TPU-native additions over the reference watch loop:
 - **preemption notice**: SIGTERM/SIGINT to the manager is forwarded to
   every child (the cloud's 30s warning), children snapshot and exit, no
   relaunch is attempted, and the manager exits 143.
+- **reshard notice** (ISSUE 11): with ``reshard="shrink"`` (or
+  ``"shrink_expand"``; CLI ``--reshard``, env ``PADDLE_RESHARD_MODE``)
+  the manager distinguishes *rank lost, quorum holds* from *world
+  lost*: when a rank dies (or the watchdog puts it down) and at least
+  ``PADDLE_RESHARD_QUORUM`` of the attempt's ranks survive, the dead
+  rank is RETIRED instead of taking the job down — the manager appends
+  a JSON notice line to every survivor's
+  ``PADDLE_RESHARD_NOTICE_FILE`` and pokes it with SIGUSR1 (the same
+  notice-channel pattern as the SIGTERM preemption protocol); survivors
+  consume the notice at their next step boundary and reshard
+  device-to-device (distributed/resharding.py). Below quorum — or with
+  resharding off — the old semantics stand: teardown, budgeted
+  relaunch, checkpoint reload. The expand half of ``shrink_expand`` is
+  an in-process affair (a fresh OS rank cannot join a live
+  jax.distributed world on this runtime): the launcher treats it as
+  shrink and leaves re-absorption to jobs that inject returns in
+  process.
 """
 from __future__ import annotations
 
@@ -62,6 +79,9 @@ _GRACE_ENV = "PADDLE_WATCHDOG_GRACE"
 _BACKOFF_ENV = "PADDLE_ELASTIC_BACKOFF"
 _WINDOW_ENV = "PADDLE_ELASTIC_WINDOW"
 _LOGDIR_ENV = "PADDLE_LOG_DIR"
+_RESHARD_MODE_ENV = "PADDLE_RESHARD_MODE"
+_RESHARD_QUORUM_ENV = "PADDLE_RESHARD_QUORUM"
+_RESHARD_NOTICE_ENV = "PADDLE_RESHARD_NOTICE_FILE"
 
 #: exit code the manager reports when the watchdog had to put a rank down
 HUNG_RC = 98
@@ -118,10 +138,10 @@ class RankProc:
     """One spawned rank (launch_utils.py TrainerProc analog)."""
 
     __slots__ = ("proc", "rank", "hb_path", "log_path", "log_file",
-                 "ev_path", "guard_ev_path")
+                 "ev_path", "guard_ev_path", "notice_path")
 
     def __init__(self, proc, rank, hb_path, log_path=None, log_file=None,
-                 ev_path=None, guard_ev_path=None):
+                 ev_path=None, guard_ev_path=None, notice_path=None):
         self.proc = proc
         self.rank = rank
         self.hb_path = hb_path
@@ -129,6 +149,7 @@ class RankProc:
         self.log_file = log_file
         self.ev_path = ev_path
         self.guard_ev_path = guard_ev_path
+        self.notice_path = notice_path
 
 
 class ElasticManager:
@@ -149,7 +170,9 @@ class ElasticManager:
                  restart_window: Optional[float] = None,
                  log_dir: Optional[str] = None,
                  poll_interval: float = 0.1,
-                 coll_timeout: Optional[float] = None):
+                 coll_timeout: Optional[float] = None,
+                 reshard: Optional[str] = None,
+                 reshard_quorum: Optional[float] = None):
         def _envf(name, default):
             raw = os.environ.get(name, "")
             return float(raw) if raw.strip() else default
@@ -171,8 +194,18 @@ class ElasticManager:
         self.log_dir = log_dir or os.environ.get(_LOGDIR_ENV) or None
         self.poll_interval = poll_interval
         self.coll_timeout = coll_timeout
+        self.reshard = (reshard if reshard is not None
+                        else os.environ.get(_RESHARD_MODE_ENV, "off")) \
+            .strip().lower() or "off"
+        if self.reshard not in ("off", "shrink", "shrink_expand"):
+            raise ValueError(
+                f"reshard={self.reshard!r}: want off|shrink|shrink_expand")
+        self.reshard_quorum = (reshard_quorum if reshard_quorum is not None
+                               else _envf(_RESHARD_QUORUM_ENV, 0.5))
         self._run_dir = None          # heartbeat-file home, made lazily
         self._procs: List[RankProc] = []
+        self._retired: List[RankProc] = []  # resharded-away ranks
+        self._spawn_total = 0         # this attempt's quorum denominator
         self._restarts = deque()      # monotonic stamps of past relaunches
         self._preempted = False
 
@@ -197,6 +230,7 @@ class ElasticManager:
         # PADDLE_OBS_DIR riding in via the env dicts) the bus stays off.
         obs_dir = os.environ.get("PADDLE_OBS_DIR") or self.log_dir
         self._procs = []
+        self._retired = []
         for env in self.envs:
             env = dict(env)
             if self.backend:
@@ -225,6 +259,15 @@ class ElasticManager:
             with open(gev, "w"):
                 pass
             env["PADDLE_GUARD_EVENT_FILE"] = gev
+            notice = None
+            if self.reshard != "off":
+                # per-attempt reshard-notice channel (resharding.py
+                # consumes it at step boundaries after a SIGUSR1 poke)
+                notice = os.path.join(
+                    self._run_dir, f"reshard.notice.{attempt}.{rank}")
+                with open(notice, "w"):
+                    pass
+                env[_RESHARD_NOTICE_ENV] = notice
             env["PADDLE_COLL_SYNC_DIR"] = sync_dir
             env.setdefault("PADDLE_COLL_DEBUG_DIR", debug_dir)
             if obs_dir:
@@ -241,7 +284,9 @@ class ElasticManager:
                 [sys.executable, self.script] + self.script_args,
                 env=env, stdout=log_file, stderr=log_file)
             self._procs.append(RankProc(p, rank, hb, log_path, log_file,
-                                        ev_path=ev, guard_ev_path=gev))
+                                        ev_path=ev, guard_ev_path=gev,
+                                        notice_path=notice))
+        self._spawn_total = len(self._procs)
         _emit("elastic_spawn", attempt=attempt,
               ranks=[rp.rank for rp in self._procs],
               pids=[rp.proc.pid for rp in self._procs],
@@ -283,7 +328,7 @@ class ElasticManager:
                 except subprocess.TimeoutExpired:
                     rp.proc.kill()
                     rp.proc.wait()
-        for rp in self._procs:
+        for rp in self._procs + self._retired:
             if rp.log_file is not None:
                 try:
                     rp.log_file.close()
@@ -313,16 +358,74 @@ class ElasticManager:
             f"{ev.get('event', '?')}: {what}",
             file=sys.stderr, flush=True)
 
+    # -- reshard notice channel (quorum-holding rank loss) ----------------
+    def _quorum_holds(self, n_alive: int) -> bool:
+        if self.reshard == "off" or n_alive < 1:
+            return False
+        return (n_alive / max(self._spawn_total, 1)) >= self.reshard_quorum
+
+    def _retire(self, rp: RankProc) -> None:
+        """Drop a departed rank from the watch set without taking the
+        job down (its workerlog closes at teardown like everyone's)."""
+        self._procs.remove(rp)
+        self._retired.append(rp)
+
+    def _notify_reshard(self, event: str, ranks: List[int],
+                        survivors: List[RankProc]) -> None:
+        """Append one notice row to every survivor's notice file and
+        poke it with SIGUSR1 (resharding.install_reshard_notice) — the
+        step-boundary poller does the rest in-process."""
+        import json
+
+        row = {"event": event, "ranks": ranks, "time": time.time(),
+               "survivors": [s.rank for s in survivors]}
+        for rp in survivors:
+            if rp.notice_path:
+                try:
+                    with open(rp.notice_path, "a") as f:
+                        f.write(json.dumps(row) + "\n")
+                except OSError:
+                    pass
+            # the poke is prompt-pickup only, and only for ranks whose
+            # handler is armed (the .armed marker from
+            # resharding.install_reshard_notice): to an un-armed child
+            # — still importing, first compile — the default SIGUSR1
+            # disposition is TERMINATION. Un-poked survivors still see
+            # the notice at their next step-boundary file poll.
+            if rp.notice_path and os.path.exists(
+                    rp.notice_path + ".armed"):
+                try:
+                    rp.proc.send_signal(signal.SIGUSR1)
+                except (OSError, AttributeError):
+                    pass
+        _emit("elastic_reshard_notice", event=event, ranks=ranks,
+              survivors=[s.rank for s in survivors],
+              quorum=self.reshard_quorum)
+        print(f"paddle_tpu.elastic: rank(s) {ranks} {event}ed; quorum "
+              f"holds ({len(survivors)}/{self._spawn_total}) — reshard "
+              f"notice sent, job continues",
+              file=sys.stderr, flush=True)
+
     # -- the watch loop (launch_utils.py:996-1118) ------------------------
     def _watch(self) -> int:
         rc = 0
         while True:
             alive = []
+            failed = []
             for rp in self._procs:
                 code = rp.proc.poll()
                 if code is None:
                     alive.append(rp)
-                elif code != 0 and rc == 0:
+                elif code != 0:
+                    failed.append((rp, code))
+            for rp, code in failed:
+                # rank lost: an in-job event when the quorum holds and
+                # resharding is on; a job failure otherwise
+                if self._quorum_holds(len(alive)):
+                    self._attribute(rp, f"departure (rc={code})")
+                    self._retire(rp)
+                    self._notify_reshard("depart", [rp.rank], alive)
+                elif rc == 0:
                     rc = code  # first failure wins; tear the job down
                     self._attribute(rp, f"failure (rc={code})")
             if rc != 0 or not alive:
@@ -349,7 +452,15 @@ class ElasticManager:
                         # a rank wedged in a collective stops heartbeating
                         # too: its monitor's event line says WHERE
                         self._attribute(rp, "watchdog kill")
-                        rc = HUNG_RC
+                        survivors = [s for s in alive if s is not rp]
+                        if self._quorum_holds(len(survivors)):
+                            # a hung rank is put down, then treated as a
+                            # departure: survivors reshard, no relaunch
+                            self._retire(rp)
+                            self._notify_reshard("depart", [rp.rank],
+                                                 survivors)
+                        else:
+                            rc = HUNG_RC
                         break
                 if rc != 0:
                     break

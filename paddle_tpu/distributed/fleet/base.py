@@ -119,37 +119,154 @@ class _DistributedOptimizer:
     def _gm_avg(self) -> bool:
         return bool(self.user_defined_strategy.gradient_merge_configs["avg"])
 
-    def _zero_constrain(self, x, force=False):
+    def _zero_constrain(self, x, pad=False):
         """Shard a state leaf over dp on the FIRST dp-divisible axis.
 
         Leaves with no dp-divisible axis (e.g. a [30522, 12] embedding on
-        dp=8) get an UNEVEN sharding constraint on their largest axis:
-        GSPMD pads the dimension internally to a shardable extent (the
-        pad-to-divisible of the reference's sharding/shard.py owner
-        assignment, done by the compiler instead of by reshaping the
-        state layout). Scalars and tiny leaves (< one tile) stay
-        replicated — distributing <1KiB costs more in collective latency
-        than it saves."""
+        dp=8) are handled per ``pad``: storage leaves (``pad=True`` —
+        optimizer state at stage>=1, params at stage 3) are PADDED on
+        their largest axis to the next shard multiple and sharded evenly
+        (the pad-to-divisible of the reference's sharding/shard.py owner
+        assignment, done in the framework because this XLA silently
+        *drops* uneven sharding constraints — probed in
+        test_sharding_gm); transient leaves (grads) keep the best-effort
+        uneven constraint, which a GSPMD that supports it may honor.
+        Scalars and tiny leaves (< one tile) stay replicated —
+        distributing <1KiB costs more in collective latency than it
+        saves."""
         mesh = getattr(self, "_constrain_mesh", None) or comm.hybrid_mesh()
         if mesh is None:
             return x
         dp = comm.dp_size(mesh)
         dp_ax = comm.dp_axes(mesh)  # 'dp', or ('dcn','ici') hierarchical
 
-        def constrain(axis):
+        def constrain(v, axis):
             spec = P(*(
-                [None] * axis + [dp_ax] + [None] * (x.ndim - axis - 1)
+                [None] * axis + [dp_ax] + [None] * (v.ndim - axis - 1)
             ))
             return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, spec)
+                v, NamedSharding(mesh, spec)
             )
 
         for axis in range(x.ndim):
             if x.shape[axis] % dp == 0 and x.shape[axis] > 0:
-                return constrain(axis)
+                return constrain(x, axis)
         if x.ndim > 0 and x.size >= 1024:
-            return constrain(int(max(range(x.ndim), key=lambda a: x.shape[a])))
+            axis = int(max(range(x.ndim), key=lambda a: x.shape[a]))
+            if pad:
+                import jax.numpy as jnp
+
+                target = -(-x.shape[axis] // dp) * dp
+                widths = [(0, target - x.shape[a]) if a == axis else (0, 0)
+                          for a in range(x.ndim)]
+                return constrain(jnp.pad(x, widths), axis)
+            return constrain(x, axis)
         return x
+
+    # -- ZeRO pad-to-shard-multiple storage (ISSUE 11 satellite) ----------
+    # A leaf with NO dp-divisible axis cannot be stored evenly sharded,
+    # and this XLA silently drops uneven sharding constraints — so such
+    # leaves were silently replicated (the stage3-odd-embedding tier-1
+    # failure). Storage is now padded to the shard multiple on the
+    # largest axis; math unpads at the use site (TrainStep unpads params
+    # before the forward "gather"; _functional_update unpads state to the
+    # grad shapes). Checkpoints stay at LOGICAL shapes: Tensor.numpy()
+    # slices the pad off and set_value re-pads (core/tensor.py).
+
+    def _leaf_pad_plan(self, p):
+        """(axis, logical_extent, padded_extent) for a param whose state
+        (and, at stage 3, the param itself) needs padded storage under
+        the current mesh — None when an even sharding exists (or no mesh,
+        or the leaf is too small to distribute)."""
+        mesh = getattr(self, "_constrain_mesh", None) or comm.hybrid_mesh()
+        if mesh is None or comm.dp_size(mesh) <= 1:
+            return None
+        dp = comm.dp_size(mesh)
+        shape = list(p._data.shape)
+        zp = getattr(p, "_zero_pad", None)
+        if zp is not None:
+            shape[zp[0]] = zp[1]  # logical extent of the padded axis
+        if any(d % dp == 0 and d > 0 for d in shape):
+            return None
+        size = 1
+        for d in shape:
+            size *= d
+        if not shape or size < 1024:
+            return None
+        axis = int(max(range(len(shape)), key=lambda a: shape[a]))
+        return axis, shape[axis], -(-shape[axis] // dp) * dp
+
+    def _dp_sharding(self, mesh, ndim, axis):
+        dp_ax = comm.dp_axes(mesh)
+        spec = P(*([None] * axis + [dp_ax] + [None] * (ndim - axis - 1)))
+        return NamedSharding(mesh, spec)
+
+    def _apply_zero_padding(self, params):
+        """Stage 3: pad each uneven param's storage to the shard multiple
+        and lay it out dp-sharded EAGERLY (stable jit signature from call
+        one; the in-graph constraint keeps it sharded). Marks the param
+        with ``_zero_pad = (axis, logical_extent)`` — the contract every
+        unpad site (forward gather, numpy()/set_value, reshard) reads.
+
+        Known limitation (documented in the README): the padded physical
+        shape lives in ``p._data``, so EAGER forward of such a leaf
+        between compiled steps sees the padded extent (embedding row
+        lookups tolerate it; a shape-coupled op like a matmul does not).
+        Stage-3 training runs through the compiled step, which unpads at
+        the gather; eager evaluation should go through a checkpoint
+        round-trip (state_dict exports logical shapes) or a model built
+        without stage-3 sharding."""
+        if self._sharding_stage < 3:
+            return
+        mesh = getattr(self, "_constrain_mesh", None) or comm.hybrid_mesh()
+        if mesh is None:
+            return
+        import jax.numpy as jnp
+
+        for p in params:
+            plan = self._leaf_pad_plan(p)
+            if plan is None or getattr(p, "_zero_pad", None) is not None:
+                continue
+            axis, logical, target = plan
+            widths = [(0, target - logical) if a == axis else (0, 0)
+                      for a in range(p._data.ndim)]
+            p._data = jax.device_put(
+                jnp.pad(p._data, widths),
+                self._dp_sharding(mesh, p._data.ndim, axis))
+            p._zero_pad = (axis, logical)
+
+    def _strip_zero_padding(self, params):
+        """Unpad padded storage back to logical shapes (the reshard seam:
+        the pad multiple depends on dp, which is about to change — the
+        next step/seed re-pads for the new mesh). Keyed off the RECORDED
+        padding (param ``_zero_pad`` / a state leaf wider than the
+        param's logical shape), never off a freshly computed plan: the
+        caller may already have swapped the mesh, under which the old
+        pad can look unnecessary and would be silently left in place."""
+        for p in params:
+            zp = getattr(p, "_zero_pad", None)
+            shape = list(p._data.shape)
+            if zp is not None:
+                shape[zp[0]] = zp[1]
+            for store in self._inner._accumulators.values():
+                v = store.get(id(p)) if isinstance(store, dict) else None
+                if v is None or not hasattr(v, "ndim") \
+                        or v.ndim != len(shape):
+                    continue
+                if tuple(v.shape) != tuple(shape) and all(
+                        a >= b for a, b in zip(v.shape, shape)):
+                    store[id(p)] = self._unpad_to(v, shape)
+            if zp is not None:
+                p._data = self._unpad_to(p._data, shape)
+                del p._zero_pad
+
+    @staticmethod
+    def _unpad_to(v, ref_shape):
+        """Slice a (possibly padded) state leaf down to the update's
+        reference shape (identity when shapes already match)."""
+        if tuple(v.shape) == tuple(ref_shape):
+            return v
+        return v[tuple(slice(0, d) for d in ref_shape)]
 
     @property
     def _sharding_stage(self) -> int:
@@ -218,6 +335,40 @@ class _DistributedOptimizer:
         return None
 
     # -- functional path hooks (consumed by jit.TrainStep) -------------------
+    def _pad_seed_state(self, params, state):
+        """Pad-seed: uneven state leaves enter the program already padded
+        + dp-sharded, so the jit signature is stable from call one (a
+        logical-shaped leaf appears after set_state_dict or a reshard
+        stripped the pads — re-pad here)."""
+        if self._sharding_stage < 1:
+            return state
+        mesh = getattr(self, "_constrain_mesh", None) or comm.hybrid_mesh()
+        if mesh is None:
+            return state
+        import jax.numpy as jnp
+
+        for name, vals in state.items():
+            if not (isinstance(vals, tuple) and len(vals) == len(params)):
+                continue
+            store = self._inner._accumulators.get(name)
+            out = []
+            for p, v in zip(params, vals):
+                plan = self._leaf_pad_plan(p)
+                if plan is not None and v.ndim == p._data.ndim \
+                        and v.shape[plan[0]] == plan[1] \
+                        and plan[1] != plan[2]:
+                    axis, logical, target = plan
+                    widths = [(0, target - logical) if a == axis
+                              else (0, 0) for a in range(v.ndim)]
+                    v = jax.device_put(
+                        jnp.pad(v, widths),
+                        self._dp_sharding(mesh, v.ndim, axis))
+                    if isinstance(store, dict):
+                        store[id(p)] = v
+                out.append(v)
+            state[name] = tuple(out)
+        return state
+
     def _functional_state(self, params):
         state = self._inner._functional_state(params)
         if self._gm_k > 1:
@@ -237,7 +388,7 @@ class _DistributedOptimizer:
                 bufs.append(buf_store[id(p)])
             state["@gm_buf"] = tuple(bufs)
             state["@gm_cnt"] = jnp.asarray(self._gm_calls, jnp.int32)
-        return state
+        return self._pad_seed_state(params, state)
 
     def _load_functional_state(self, params, state):
         state = dict(state)
@@ -259,6 +410,22 @@ class _DistributedOptimizer:
         state = dict(state)
         gm_buf = state.pop("@gm_buf", None)
         gm_cnt = state.pop("@gm_cnt", None)
+        if stage >= 1:
+            # padded-storage leaves come down to the update's reference
+            # shapes (the traced p_raws — themselves padded at stage 3,
+            # where the whole update runs in padded space: pad rows carry
+            # g=0/m=0/v=0, so every elementwise rule is exact there)
+            refs = [r.shape for r in p_raws]
+            state = {
+                name: tuple(self._unpad_to(v, r)
+                            for v, r in zip(vals, refs))
+                if isinstance(vals, tuple) and len(vals) == len(p_raws)
+                else vals
+                for name, vals in state.items()
+            }
+            if gm_buf is not None:
+                gm_buf = [self._unpad_to(b, r)
+                          for b, r in zip(gm_buf, refs)]
 
         width_cast = self._comm_width_cast()
         if width_cast is not None:
@@ -310,13 +477,35 @@ class _DistributedOptimizer:
 
         if stage >= 1:
             new_state = {
-                name: tuple(self._zero_constrain(v) for v in vals)
+                name: tuple(self._zero_constrain(v, pad=True) for v in vals)
                 if isinstance(vals, tuple) else vals  # @gm_cnt scalar rides
                 for name, vals in new_state.items()
             }
         if stage >= 3:
-            new_p = tuple(self._zero_constrain(v) for v in new_p)
+            new_p = tuple(self._zero_constrain(v, pad=True) for v in new_p)
         return new_p, new_state
+
+    def state_dict(self):
+        """Padded ZeRO storage exports at LOGICAL shapes (the checkpoint
+        contract — a snapshot must restore into any sharding config)."""
+        out = self._inner.state_dict()
+        params = self._inner._get_params()
+        name_of = {(p.name or f"param_{i}"): p
+                   for i, p in enumerate(params)}
+        for key, val in list(out.items()):
+            pname, _, _acc = key.rpartition(".")
+            p = name_of.get(pname)
+            if p is None or not isinstance(val, Tensor):
+                continue
+            shape = list(p._data.shape)
+            zp = getattr(p, "_zero_pad", None)
+            if zp is not None:
+                shape[zp[0]] = zp[1]
+            if val._data.ndim == len(shape) \
+                    and tuple(val._data.shape) != tuple(shape) \
+                    and all(a >= b for a, b in zip(val._data.shape, shape)):
+                out[key] = Tensor._wrap(self._unpad_to(val._data, shape))
+        return out
 
     # -- eager path ----------------------------------------------------------
     def _comm_cast_grads(self, cast):

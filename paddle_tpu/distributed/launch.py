@@ -63,7 +63,8 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
            ips: str = "127.0.0.1", start_port: int = 6170,
            backend: str = None, node_rank: int = None,
            elastic_retries: int = 0, watchdog_timeout: float = None,
-           log_dir: str = None, coll_timeout: float = None) -> int:
+           log_dir: str = None, coll_timeout: float = None,
+           reshard: str = None, reshard_quorum: float = None) -> int:
     """Spawn THIS node's ranks and babysit them (launch_collective :208).
 
     `node_rank` selects which host of `ips` this invocation is (default
@@ -95,6 +96,15 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
       hang. The manager always exports PADDLE_COLL_EVENT_FILE,
       PADDLE_COLL_SYNC_DIR (monitored_barrier / desync exchange), and
       PADDLE_COLL_DEBUG_DIR (dumps land next to the workerlogs).
+    - `reshard` (or PADDLE_RESHARD_MODE) = "shrink"/"shrink_expand"
+      turns a quorum-holding rank loss into an IN-JOB event: the dead
+      rank retires, survivors get a reshard notice
+      (PADDLE_RESHARD_NOTICE_FILE + SIGUSR1, consumed by
+      distributed/resharding.ElasticStep at a step boundary) and keep
+      training on a re-factored mesh — no teardown, no checkpoint
+      round trip. `reshard_quorum` (or PADDLE_RESHARD_QUORUM, default
+      0.5) is the minimum surviving fraction; below it the loss is a
+      world loss and the relaunch path above applies.
     """
     if node_rank is None:
         node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
@@ -110,7 +120,8 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
         script, list(script_args), envs, backend=backend,
         max_restarts=int(elastic_retries),
         watchdog_timeout=watchdog_timeout, log_dir=log_dir,
-        coll_timeout=coll_timeout,
+        coll_timeout=coll_timeout, reshard=reshard,
+        reshard_quorum=reshard_quorum,
     )
     return mgr.run()
 
@@ -147,6 +158,16 @@ def main(argv=None):
                              "$PADDLE_COLL_TIMEOUT, 0 = off); a stalled "
                              "collective dumps the flight recorder and "
                              "recycles the rank with attribution")
+    parser.add_argument("--reshard", type=str, default=None,
+                        choices=("off", "shrink", "shrink_expand"),
+                        help="turn a quorum-holding rank loss into an "
+                             "in-job reshard notice instead of a world "
+                             "relaunch (default: $PADDLE_RESHARD_MODE "
+                             "or off)")
+    parser.add_argument("--reshard_quorum", type=float, default=None,
+                        help="minimum surviving fraction for an in-job "
+                             "reshard (default: $PADDLE_RESHARD_QUORUM "
+                             "or 0.5)")
     parser.add_argument("script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -155,7 +176,8 @@ def main(argv=None):
         ips=args.ips, start_port=args.start_port, backend=args.backend,
         node_rank=args.node_rank, elastic_retries=args.elastic_retries,
         watchdog_timeout=args.watchdog_timeout, log_dir=args.log_dir,
-        coll_timeout=args.coll_timeout,
+        coll_timeout=args.coll_timeout, reshard=args.reshard,
+        reshard_quorum=args.reshard_quorum,
     )
     sys.exit(rc)
 

@@ -1,0 +1,628 @@
+"""Elastic mesh resharding — survive rank loss without a relaunch
+round trip (ISSUE 11).
+
+PR 1's elastic runtime treats every rank loss the same way: kill the
+world, respawn every rank, reload the last host checkpoint — minutes of
+lost pod time per preemption. Flex-TPU's runtime-reconfigurable-dataflow
+idea (PAPERS.md), lifted to the framework level, says the recovery path
+for a *covered* loss should be a device-to-device reshard among
+survivors instead:
+
+- **planner** (:func:`plan_refactoring`): given the surviving rank set,
+  pick a new dcn x ici (or flat dp) factoring of the mesh. Model axes
+  (mp/pp/sp) keep their degree — their shards are replicated across dp
+  rows, so a lost device retires its whole dp row and the planner keeps
+  only intact rows (hierarchical meshes balance to the smallest
+  surviving ici group: dcn2 x ici4 minus one device -> dcn2 x ici3).
+- **coverage** (:func:`leaf_coverage`): a reshard is only sound when
+  every shard of every state leaf still has a surviving replica. Plain
+  data-parallel state (params/moments replicated over dp) is always
+  covered; ZeRO-sharded state is NOT — the departed rank held the only
+  copy of its slice — so those jobs take the host-checkpoint fallback,
+  exactly like a dp=1 loss.
+- **executor** (``TrainStep.rebind_mesh``): params, optimizer state,
+  guard counters and the fp16 scaler move with ``jax.device_put`` onto
+  the new mesh — an XLA device-to-device transfer program, no host
+  filesystem on the happy path — and the step re-jits once (bounded
+  recompile, attributed by the recompile ledger).
+- **control plane** (:class:`ElasticStep` here;
+  ``ElasticManager.reshard`` launcher-side): departure/arrival notices
+  are consumed at a STEP BOUNDARY (the guard's async cadence makes the
+  step object the natural drain point); the policy knob
+  ``strategy.elastic_reshard`` selects off / ``"shrink"`` /
+  ``"shrink_expand"``, with quorum and global-batch semantics in
+  ``strategy.elastic_reshard_configs``.
+
+Every reshard emits a ``reshard`` row on the telemetry bus (trigger,
+survivor set, old/new factoring, bytes moved, wall seconds);
+``tools/timeline.py`` renders them as duration slices.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+__all__ = [
+    "ReshardError", "RankLostError", "CoverageError", "MeshPlan",
+    "plan_refactoring", "leaf_coverage", "coverage_report", "ElasticStep",
+    "install_reshard_notice", "read_launcher_notices", "factoring_str",
+]
+
+_NOTICE_ENV = "PADDLE_RESHARD_NOTICE_FILE"
+
+#: mesh axes that carry data-parallel rows (shrinkable); everything else
+#: is a model axis whose degree the planner must preserve
+_DP_AXES = ("dp", "dcn", "ici")
+
+
+class ReshardError(RuntimeError):
+    """Base of the reshard control plane's failures."""
+
+
+class RankLostError(ReshardError):
+    """Rank loss that cannot be absorbed in-job (policy off, quorum
+    lost, or no surviving dp row) — the caller hands the job back to the
+    elastic launcher's relaunch path."""
+
+
+class CoverageError(ReshardError):
+    """Survivors cannot reconstruct the state (a departed rank held the
+    only replica of some shard) and no host-checkpoint fallback is
+    registered."""
+
+
+def factoring_str(dims: Dict[str, int]) -> str:
+    """'dp4' / 'dcn2xici4' / 'dcn2xici3xmp2' — size-1 model axes are
+    elided, dp axes always print (a shrink to dp1 must be visible)."""
+    parts = [f"{a}{n}" for a, n in dims.items()
+             if a in _DP_AXES or n > 1]
+    return "x".join(parts) if parts else "dp1"
+
+
+class MeshPlan:
+    """One planned re-factoring: the survivor mesh plus bookkeeping the
+    control plane and telemetry need."""
+
+    __slots__ = ("old_mesh", "new_mesh", "old_dims", "new_dims",
+                 "lost_ranks", "survivor_ranks", "dropped_ranks")
+
+    def __init__(self, old_mesh, new_mesh, old_dims, new_dims,
+                 lost_ranks, survivor_ranks, dropped_ranks):
+        self.old_mesh = old_mesh
+        self.new_mesh = new_mesh
+        self.old_dims = old_dims      # {axis: size} of the base mesh
+        self.new_dims = new_dims
+        self.lost_ranks = lost_ranks          # sorted flat base ranks
+        self.survivor_ranks = survivor_ranks  # ranks the new mesh uses
+        self.dropped_ranks = dropped_ranks    # alive but unused (ici
+        #                                       balancing remainder)
+
+    def describe(self) -> str:
+        s = (f"{factoring_str(self.old_dims)} -> "
+             f"{factoring_str(self.new_dims)}")
+        if self.dropped_ranks:
+            s += f" (idling intact ranks {self.dropped_ranks})"
+        return s
+
+
+def plan_refactoring(base_mesh, lost_ranks: Sequence[int]) -> MeshPlan:
+    """Factor the surviving devices of `base_mesh` into a new mesh.
+
+    `lost_ranks` are flat indices into ``base_mesh.devices.flatten()``
+    (row-major — the same order ranks are spawned in). A lost device
+    retires its whole dp row: the row's mp/pp/sp peers hold shards that
+    are only replicated ACROSS dp rows, so a partial row cannot compute.
+    Raises :class:`RankLostError` when no complete dp row survives.
+    """
+    axes = list(base_mesh.axis_names)
+    sizes = {a: int(base_mesh.shape[a]) for a in axes}
+    dp_axes = [a for a in axes if a in _DP_AXES]
+    model_axes = [a for a in axes if a not in _DP_AXES]
+    if axes[:len(dp_axes)] != dp_axes:
+        raise ReshardError(
+            f"unsupported mesh layout {axes}: dp axes must lead "
+            "(init_hybrid_mesh order)")
+    devs = np.asarray(base_mesh.devices)
+    n = devs.size
+    lost = sorted(set(int(r) for r in lost_ranks))
+    for r in lost:
+        if not 0 <= r < n:
+            raise ReshardError(f"lost rank {r} out of range for a "
+                               f"{n}-device mesh")
+    row_len = 1
+    for a in model_axes:
+        row_len *= sizes[a]
+    n_rows = n // row_len
+    lost_rows = {r // row_len for r in lost}
+    row_ranks = [list(range(i * row_len, (i + 1) * row_len))
+                 for i in range(n_rows)]
+
+    new_dims = dict(sizes)
+    keep_rows: List[int] = []
+    dropped: List[int] = []
+    if len(dp_axes) == 2:  # hierarchical dcn x ici
+        ici = sizes[dp_axes[1]]
+        groups = []
+        for g in range(sizes[dp_axes[0]]):
+            intact = [g * ici + j for j in range(ici)
+                      if (g * ici + j) not in lost_rows]
+            if intact:
+                groups.append(intact)
+        if not groups:
+            raise RankLostError(
+                "no intact dp row survives — world lost, fall back to "
+                "the relaunch path")
+        ici_new = min(len(g) for g in groups)
+        for g in groups:
+            keep_rows.extend(g[:ici_new])
+            for row in g[ici_new:]:
+                dropped.extend(row_ranks[row])
+        new_dims[dp_axes[0]] = len(groups)
+        new_dims[dp_axes[1]] = ici_new
+    elif len(dp_axes) == 1:
+        keep_rows = [i for i in range(n_rows) if i not in lost_rows]
+        if not keep_rows:
+            raise RankLostError(
+                "no intact dp row survives — world lost, fall back to "
+                "the relaunch path")
+        new_dims[dp_axes[0]] = len(keep_rows)
+    else:
+        raise ReshardError(
+            f"mesh {axes} has no dp axis to shrink — elastic resharding "
+            "needs a data-parallel dimension")
+
+    new_devs = np.stack([devs.reshape(n_rows, row_len)[i]
+                         for i in keep_rows])
+    shape = [new_dims[a] for a in axes]
+    from jax.sharding import Mesh
+
+    new_mesh = Mesh(new_devs.reshape(shape), tuple(axes))
+    survivors = sorted(r for i in keep_rows for r in row_ranks[i])
+    return MeshPlan(base_mesh, new_mesh, sizes, new_dims, lost,
+                    survivors, sorted(dropped))
+
+
+# ---------------------------------------------------------------------------
+# coverage: can the survivors reconstruct every byte?
+# ---------------------------------------------------------------------------
+
+
+def leaf_coverage(arr, lost_devices: Set) -> bool:
+    """True when every shard of `arr` has at least one replica on a
+    device OUTSIDE `lost_devices` (jax arrays are global: the sharding's
+    device->index map names who holds what)."""
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None:
+        return True  # host value — trivially covered
+    try:
+        imap = sharding.devices_indices_map(arr.shape)
+    except Exception:  # noqa: BLE001 — exotic shardings: assume covered
+        return True
+    holders: Dict[tuple, Set] = {}
+    for dev, idx in imap.items():
+        key = tuple(
+            (s.start or 0,
+             s.stop if s.stop is not None else dim)
+            for s, dim in zip(idx, arr.shape)
+        ) if idx else ()
+        holders.setdefault(key, set()).add(dev)
+    return all(hs - lost_devices for hs in holders.values())
+
+
+def coverage_report(leaves: Dict[str, object],
+                    lost_devices: Set) -> List[str]:
+    """Names of the leaves the survivors can NOT reconstruct."""
+    return [name for name, arr in leaves.items()
+            if not leaf_coverage(arr, lost_devices)]
+
+
+# ---------------------------------------------------------------------------
+# launcher notice channel (the SIGTERM-notice pattern from PR 1)
+# ---------------------------------------------------------------------------
+
+_notice_flag = threading.Event()
+
+
+def install_reshard_notice() -> None:
+    """Install the SIGUSR1 handler the elastic launcher pokes after
+    writing a reshard notice (``PADDLE_RESHARD_NOTICE_FILE``). The
+    handler only sets a flag — the notice is consumed at the next step
+    boundary by :meth:`ElasticStep._poll_notices`. No-op off the main
+    thread (the poller reads the file regardless; the signal just makes
+    pickup prompt).
+
+    Installation touches ``<notice_file>.armed``: the launcher sends
+    SIGUSR1 ONLY once that marker exists — before the handler is armed
+    the default SIGUSR1 disposition would TERMINATE a child still deep
+    in imports/first-compile (a departure one second into the job),
+    turning a survivable rank loss into a world loss."""
+    if threading.current_thread() is not threading.main_thread():
+        return
+
+    def _handler(signum, frame):
+        _notice_flag.set()
+
+    try:
+        signal.signal(signal.SIGUSR1, _handler)
+    except (ValueError, OSError, AttributeError):
+        return
+    path = os.environ.get(_NOTICE_ENV)
+    if path:
+        try:
+            with open(path + ".armed", "w"):
+                pass
+        except OSError:
+            pass
+
+
+def read_launcher_notices(offset: int = 0) -> Tuple[List[dict], int]:
+    """Parse notice rows appended to ``PADDLE_RESHARD_NOTICE_FILE``
+    past `offset`; returns (rows, new_offset). Torn last lines are left
+    for the next poll."""
+    path = os.environ.get(_NOTICE_ENV)
+    if not path or not os.path.exists(path):
+        return [], offset
+    rows: List[dict] = []
+    try:
+        with open(path) as f:
+            f.seek(offset)
+            chunk = f.read()
+    except OSError:
+        return [], offset
+    consumed = 0
+    for line in chunk.splitlines(keepends=True):
+        if not line.endswith("\n"):
+            break  # torn write: retry next poll
+        consumed += len(line)
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict) and row.get("event") in ("depart",
+                                                          "return"):
+            rows.append(row)
+    return rows, offset + consumed
+
+
+# ---------------------------------------------------------------------------
+# the control plane: a reshard-aware step wrapper
+# ---------------------------------------------------------------------------
+
+
+class ElasticStep:
+    """Wrap a compiled ``jit.TrainStep`` with the elastic-reshard
+    control plane::
+
+        estep = resharding.ElasticStep(TrainStep(model, loss_fn, opt))
+        for x, y in loader:
+            loss = estep(estep.shard_input(x), estep.shard_input(y))
+
+    Departure/arrival notices — from the ``rank`` fault-injection site,
+    the launcher's notice file (SIGUSR1 + ``PADDLE_RESHARD_NOTICE_FILE``)
+    or the :meth:`notify_departure`/:meth:`notify_return` API — are
+    consumed at the next call, i.e. at a step boundary: the wrapped
+    step's in-flight work has drained by construction (its guard reads
+    ride an async cadence; the reshard syncs the pending prefetch before
+    moving anything).
+
+    Policy comes from ``strategy.elastic_reshard`` on the optimizer's
+    strategy (constructor args override): ``None``/"off" re-raises every
+    departure as :class:`RankLostError` (PR-1 relaunch semantics),
+    ``"shrink"`` absorbs covered departures, ``"shrink_expand"`` also
+    re-absorbs returning ranks back toward the original factoring.
+    """
+
+    def __init__(self, step, policy: Optional[str] = None,
+                 quorum: Optional[float] = None,
+                 batch: Optional[str] = None, fallback=None):
+        from . import comm
+
+        self.step = step
+        strategy = getattr(step.opt, "user_defined_strategy", None)
+        cfg = (dict(strategy.elastic_reshard_configs)
+               if strategy is not None else {})
+        if policy is None and strategy is not None:
+            policy = strategy.elastic_reshard
+        self.policy = (policy or "off").lower()
+        if self.policy not in ("off", "shrink", "shrink_expand"):
+            raise ValueError(
+                f"elastic_reshard={self.policy!r}: want off|shrink|"
+                "shrink_expand")
+        self.quorum = float(quorum if quorum is not None
+                            else cfg.get("quorum", 0.5))
+        self.batch = str(batch if batch is not None
+                         else cfg.get("batch", "rescale"))
+        if self.batch not in ("rescale", "shrink"):
+            raise ValueError(
+                f"elastic_reshard batch={self.batch!r}: want "
+                "rescale|shrink")
+        self._fallback = fallback
+        mesh = comm.hybrid_mesh()
+        if mesh is None:
+            group = getattr(getattr(step, "model", None), "group", None)
+            mesh = group.mesh if group is not None \
+                else comm._default_group().mesh
+        self._base_mesh = mesh
+        self._base_devices = list(np.asarray(mesh.devices).reshape(-1))
+        self._had_hybrid = comm.hybrid_mesh() is not None
+        self.mesh = mesh
+        self._lost: Set[int] = set()
+        self._queued: List[Tuple[str, Optional[int]]] = []
+        self._notice_offset = 0
+        self._per_rank_batch: Optional[int] = None
+        self.reshards = 0
+        if os.environ.get(_NOTICE_ENV):
+            # launched under a reshard-aware ElasticManager: arm the
+            # SIGUSR1 prompt-pickup handler before the first poke
+            install_reshard_notice()
+
+    # -- public notice API -------------------------------------------------
+    def notify_departure(self, ranks) -> None:
+        """Queue a departure notice (consumed at the next step
+        boundary). `ranks` are flat indices into the ORIGINAL mesh."""
+        for r in np.atleast_1d(ranks):
+            self._queued.append(("depart", int(r)))
+
+    def notify_return(self, ranks) -> None:
+        for r in np.atleast_1d(ranks):
+            self._queued.append(("return", int(r)))
+
+    @property
+    def live_ranks(self) -> List[int]:
+        return [r for r in range(len(self._base_devices))
+                if r not in self._lost]
+
+    def dp_size(self) -> int:
+        from . import comm
+
+        return comm.dp_size(self.mesh) if len(self.mesh.axis_names) > 1 \
+            else int(self.mesh.size)
+
+    # -- input sharding (global-batch semantics) ---------------------------
+    def shard_input(self, x):
+        """Shard a global batch over the CURRENT mesh. Under
+        ``batch="rescale"`` the global batch is preserved (the per-rank
+        share grows after a shrink; divisibility asserted). Under
+        ``batch="shrink"`` the fed batch is trimmed to the original
+        per-rank share x the current dp — a smaller global batch."""
+        from ..core.tensor import Tensor
+        from .parallel import shard_batch
+
+        raw = x._data if isinstance(x, Tensor) else np.asarray(x)
+        dp = self.dp_size()
+        if self._per_rank_batch is None:
+            if raw.shape[0] % dp:
+                raise ValueError(
+                    f"global batch {raw.shape[0]} does not divide the "
+                    f"dp degree {dp}")
+            self._per_rank_batch = raw.shape[0] // dp
+        if self.batch == "shrink":
+            want = self._per_rank_batch * dp
+            if raw.shape[0] > want:
+                raw = raw[:want]
+        if raw.shape[0] % dp:
+            if self.batch == "rescale":
+                raise ValueError(
+                    f"elastic_reshard batch='rescale' preserves the "
+                    f"global batch, but {raw.shape[0]} does not divide "
+                    f"the post-reshard dp degree {dp}; feed a divisible "
+                    f"global batch or use batch='shrink'")
+            raise ValueError(
+                f"batch of {raw.shape[0]} rows does not divide the "
+                f"current dp degree {dp} (elastic_reshard "
+                f"batch='shrink' trims to {self._per_rank_batch} rows "
+                f"per rank; feed at least that many per live rank)")
+        return shard_batch(raw, self.mesh)
+
+    # -- the step-boundary hook --------------------------------------------
+    def __call__(self, inputs, labels=None):
+        n = self.reshards
+        self._poll_notices()
+        if self.reshards != n:
+            # the caller sharded this batch BEFORE the notice landed —
+            # re-lay it out on the post-reshard mesh (and re-apply the
+            # batch policy: a "shrink" job trims to the new global batch)
+            inputs = self._reshard_batch(inputs)
+            labels = self._reshard_batch(labels)
+        return self.step(inputs, labels)
+
+    def _reshard_batch(self, xs):
+        if xs is None:
+            return None
+        single = not isinstance(xs, (list, tuple))
+        out = [self.shard_input(x) for x in ([xs] if single else xs)]
+        return out[0] if single else type(xs)(out)
+
+    def _poll_notices(self) -> None:
+        from ..utils import fault_injection as _FI
+
+        events = [(a, r, "fault") for a, r in _FI.consume_rank_events()]
+        if self._queued:
+            events.extend((a, r, "api") for a, r in self._queued)
+            self._queued = []
+        if _notice_flag.is_set() or os.environ.get(_NOTICE_ENV):
+            _notice_flag.clear()
+            rows, self._notice_offset = read_launcher_notices(
+                self._notice_offset)
+            for row in rows:
+                events.extend((row["event"], int(r), "launcher")
+                              for r in row.get("ranks", []))
+        if not events:
+            return
+        # fold the events into the lost set IN ORDER (a return followed
+        # by a depart of the same rank nets out to "still lost" — batch
+        # processing by kind would resurrect it), then make at most ONE
+        # transition to the net state
+        net_lost = set(self._lost)
+        n = len(self._base_devices)
+        trigger = "api"
+        first = True
+        for action, rank, src in events:
+            if rank is None:
+                live = [r for r in range(n) if r not in net_lost]
+                rank = max(live) if action == "depart" and live \
+                    else (max(net_lost) if net_lost else None)
+            if rank is None:
+                continue
+            if action == "depart":
+                net_lost.add(int(rank))
+            elif self.policy == "shrink_expand":
+                net_lost.discard(int(rank))
+            if first:
+                trigger = src
+                first = False
+        added = net_lost - self._lost
+        if added:
+            self._handle_departure(sorted(net_lost), sorted(added),
+                                   trigger=trigger)
+        elif net_lost != self._lost:
+            self._handle_return(sorted(net_lost), trigger=trigger)
+
+    # -- state-leaf inventory ----------------------------------------------
+    def _state_leaves(self) -> Dict[str, object]:
+        step = self.step
+        leaves: Dict[str, object] = {}
+        for i, p in enumerate(step._p_objs):
+            leaves[f"param:{p.name or i}"] = p._data
+        for name, b in zip(step._b_names, step._b_objs):
+            leaves[f"buffer:{name}"] = b._data
+        inner = getattr(step.opt, "_inner", step.opt)
+        names = {id(p): (p.name or str(i))
+                 for i, p in enumerate(step._p_objs)}
+        for acc, store in getattr(inner, "_accumulators", {}).items():
+            if isinstance(store, dict):
+                for pid, v in store.items():
+                    leaves[f"opt:{names.get(pid, pid)}.{acc}"] = v
+        for i, v in enumerate(step._scaler_state or ()):
+            leaves[f"scaler:{i}"] = v
+        if step._guard is not None and len(step._guard_state):
+            leaves["guard:state"] = step._guard_state
+        return leaves
+
+    @staticmethod
+    def _bytes_of(leaves: Dict[str, object]) -> int:
+        total = 0
+        for v in leaves.values():
+            total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+
+    # -- the reshard transitions -------------------------------------------
+    def _handle_departure(self, net_lost: List[int], newly: List[int],
+                          trigger: str) -> None:
+        n = len(self._base_devices)
+        if self.policy == "off":
+            raise RankLostError(
+                f"rank(s) {newly} departed and "
+                "strategy.elastic_reshard is off — rank loss is a job "
+                "failure (elastic relaunch path)")
+        if (n - len(net_lost)) / n < self.quorum:
+            raise RankLostError(
+                f"quorum lost: {n - len(net_lost)}/{n} survivors < "
+                f"quorum {self.quorum} — world loss, relaunch path")
+        plan = plan_refactoring(self._base_mesh, net_lost)
+        lost_devices = {self._base_devices[r] for r in newly}
+        leaves = self._state_leaves()
+        uncovered = coverage_report(leaves, lost_devices)
+        self._transition(plan, trigger, uncovered, leaves, lost=net_lost)
+
+    def _handle_return(self, net_lost: List[int], trigger: str) -> None:
+        plan = plan_refactoring(self._base_mesh, net_lost)
+        leaves = self._state_leaves()
+        # expansion is always covered: all state lives on survivors,
+        # which remain members of the grown mesh
+        self._transition(plan, trigger, [], leaves, lost=net_lost)
+
+    def _transition(self, plan: MeshPlan, trigger: str,
+                    uncovered: List[str], leaves: Dict[str, object],
+                    lost: List[int]) -> None:
+        from . import comm
+        from ..observability import bus as _bus
+
+        import jax
+
+        step = self.step
+        cur_dims = {a: int(self.mesh.shape[a])
+                    for a in self.mesh.axis_names}
+        t0 = time.perf_counter()
+        # drain: the step boundary is the barrier — sync the guard's
+        # in-flight async prefetch and the dispatched device work
+        if step._guard is not None:
+            step._guard._sync_pending()
+        try:
+            jax.block_until_ready([p._data for p in step._p_objs])
+        except Exception:  # noqa: BLE001 — drain stays best-effort
+            pass
+        fallback_used = False
+        if uncovered:
+            if self._fallback is None and not self._has_rescue_target():
+                raise CoverageError(
+                    f"survivors cannot cover {len(uncovered)} state "
+                    f"leaf/leaves (e.g. {uncovered[:3]}) and no "
+                    "host-checkpoint fallback is registered — pass "
+                    "fallback= or iterate a TrainEpochRange")
+            fallback_used = True
+        bytes_moved = self._bytes_of(leaves)
+        if self._had_hybrid:
+            comm.set_hybrid_mesh(plan.new_mesh)
+            from .fleet.base import fleet as _fleet
+
+            if _fleet._hcg is not None:  # topology accessor follows
+                _fleet._hcg.mesh = plan.new_mesh
+        comm.rebuild_world(list(
+            np.asarray(plan.new_mesh.devices).reshape(-1)))
+        model = getattr(step, "model", None)
+        group = getattr(model, "group", None)
+        if group is not None:  # DataParallel wrapper follows the world
+            model.group = comm._default_group()
+        step.rebind_mesh(plan.new_mesh)
+        if fallback_used:
+            # the uncoverable shards are gone: reload the last host
+            # checkpoint INTO the new layout (the one filesystem read
+            # this subsystem is built to avoid on the happy path)
+            if self._fallback is not None:
+                self._fallback()
+            else:
+                from ..utils import train_guard as _TG
+
+                _TG._rescue_target().restore()
+            step.rebind_mesh(plan.new_mesh)  # re-place restored values
+        self._lost = set(lost)
+        self.mesh = plan.new_mesh
+        self.reshards += 1
+        wall = time.perf_counter() - t0
+        payload = {
+            "trigger": trigger,
+            "lost": lost,
+            "survivors": plan.survivor_ranks,
+            "dropped": plan.dropped_ranks,
+            "old": factoring_str(cur_dims),
+            "new": factoring_str(plan.new_dims),
+            "covered": not uncovered,
+            "fallback": fallback_used,
+            "uncovered": uncovered[:8],
+            "bytes_moved": bytes_moved,
+            "wall_s": round(wall, 4),
+        }
+        _bus.emit("reshard", payload)
+        import sys
+
+        print(f"paddle_tpu.resharding: {factoring_str(cur_dims)} -> "
+              f"{factoring_str(plan.new_dims)} "
+              f"({'fallback' if fallback_used else 'device-to-device'}, "
+              f"{bytes_moved / 1e6:.3f} MB state, {wall:.2f}s)",
+              file=sys.stderr, flush=True)
+
+    @staticmethod
+    def _has_rescue_target() -> bool:
+        from ..utils import train_guard as _TG
+
+        return _TG._rescue_target() is not None

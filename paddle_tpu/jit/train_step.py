@@ -221,6 +221,13 @@ class TrainStep:
                     getattr(o._data, "sharding", None), NamedSharding
                 ):
                     o._data = jax.device_put(o._data, repl)
+        # ZeRO stage-3 pad-to-shard-multiple storage (ISSUE 11): params
+        # with no dp-divisible axis go padded + dp-sharded NOW (uneven
+        # sharding constraints are silently dropped by this XLA); the
+        # forward unpads — "unpad on gather" — via _unpad_params below
+        if hasattr(self.opt, "_apply_zero_padding"):
+            self.opt._apply_zero_padding(self._p_objs)
+        self._refresh_zero_pads()
         if self._async_dcn:
             if mesh is None or "dcn" not in mesh.axis_names \
                     or int(mesh.shape["dcn"]) <= 1:
@@ -308,6 +315,27 @@ class TrainStep:
             _ledger.install_backend_listener()
             _bus.emit("grad_comm", self._grad_comm_info, step=0)
 
+    def _refresh_zero_pads(self):
+        """Index the params whose storage is padded to the ZeRO shard
+        multiple (param._zero_pad contract, fleet._DistributedOptimizer):
+        the traced unpad below slices them back to logical shape before
+        the model sees them."""
+        self._zero_pads = [
+            (i, p._zero_pad) for i, p in enumerate(self._p_objs)
+            if getattr(p, "_zero_pad", None) is not None
+        ]
+
+    def _unpad_params(self, p_tuple):
+        if not self._zero_pads:
+            return p_tuple
+        out = list(p_tuple)
+        for i, (axis, logical) in self._zero_pads:
+            v = out[i]
+            out[i] = v[tuple(
+                slice(0, logical) if a == axis else slice(None)
+                for a in range(v.ndim))]
+        return tuple(out)
+
     # -- the pure program ----------------------------------------------------
     def _amp_guard(self):
         if self._amp_ctx is None:
@@ -335,6 +363,10 @@ class TrainStep:
         return out_raw, new_b
 
     def _loss_of(self, p_tuple, b_raws, key, in_raws, label_raws):
+        # padded ZeRO storage comes down to logical shapes here — the
+        # "unpad on gather": grads w.r.t. the padded operands carry zeros
+        # in the pad rows, so the update stays exact in padded space
+        p_tuple = self._unpad_params(tuple(p_tuple))
         # disjoint RNG streams for the two trace regions (the fwd segment
         # may be recomputed in backward and must redraw identically)
         fwd_key = None if key is None else jax.random.fold_in(key, 0)
@@ -522,6 +554,101 @@ class TrainStep:
         if self._guard is not None:
             self._guard_state = self._place_guard_state(
                 self._guard.restored_device_state())
+
+    # -- elastic resharding (distributed/resharding.py, ISSUE 11) ----------
+    def rebind_mesh(self, mesh):
+        """Move every piece of step state onto `mesh` device-to-device
+        and drop the compiled program — the reshard executor. Params,
+        buffers, optimizer accumulators, the fp16 scaler and the guard
+        carry are re-placed with jax.device_put (replicated, or the
+        param's tensor-parallel spec); ZeRO pad-to-shard-multiple storage
+        is stripped first and re-derived for the new dp. The next call
+        re-jits: ONE bounded recompile, attributed by the recompile
+        ledger under the same "TrainStep" label."""
+        if self._delegate is not None:
+            raise NotImplementedError(
+                "elastic resharding does not compose with localsgd: "
+                "LocalSGDStep carries per-replica state the reshard "
+                "planner does not cover yet"
+            )
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        if self._async_dcn:
+            if "dcn" not in mesh.axis_names or int(mesh.shape["dcn"]) <= 1:
+                raise ValueError(
+                    "the explicit dcn grad reduction needs a dcn axis "
+                    "(> 1) on the resharded mesh — the planner must keep "
+                    "the hierarchical factoring"
+                )
+            self._dcn_mesh = mesh
+        # pads are sized for the OLD dp — strip to logical shapes, move,
+        # then re-pad for the new factoring
+        if hasattr(self.opt, "_strip_zero_padding"):
+            self.opt._strip_zero_padding(self._p_objs)
+        repl = NamedSharding(mesh, _P())
+        for p in self._p_objs:
+            spec = getattr(p, "_tp_spec", None)
+            sh = NamedSharding(mesh, spec) if spec is not None else repl
+            p._data = jax.device_put(p._data, sh)
+        for b in self._b_objs:
+            b._data = jax.device_put(b._data, repl)
+        spec_of = {id(p): getattr(p, "_tp_spec", None)
+                   for p in self._p_objs}
+        shape_of = {id(p): tuple(p._data.shape) for p in self._p_objs}
+
+        def _axes_size(entry):
+            size = 1
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                if a not in mesh.axis_names:
+                    return None
+                size *= int(mesh.shape[a])
+            return size
+
+        def _carry_spec(v):
+            """Keep a leaf's CURRENT partitioning on the new mesh when
+            it still fits (ZeRO dp-sharded moments must not transit
+            through full replication — that spike is the memory the
+            sharding exists to avoid); replicate only when the old spec
+            no longer divides, and let the next step's in-graph
+            constraint re-shard."""
+            sh = getattr(v, "sharding", None)
+            if not isinstance(sh, NamedSharding) or sh.spec is None:
+                return None
+            for dim, entry in zip(v.shape, sh.spec):
+                if entry is None:
+                    continue
+                size = _axes_size(entry)
+                if size is None or dim % size:
+                    return None
+            return sh.spec
+
+        inner = getattr(self.opt, "_inner", self.opt)
+        for store in getattr(inner, "_accumulators", {}).values():
+            if not isinstance(store, dict):
+                continue
+            for pid, v in store.items():
+                spec = spec_of.get(pid)
+                if spec is not None and hasattr(v, "shape") \
+                        and tuple(v.shape) == shape_of.get(pid):
+                    sh = NamedSharding(mesh, spec)
+                else:
+                    carried = _carry_spec(v) if hasattr(v, "shape") \
+                        else None
+                    sh = NamedSharding(mesh, carried) \
+                        if carried is not None else repl
+                store[pid] = jax.device_put(v, sh)
+        if self._scaler_state:
+            self._scaler_state = tuple(
+                jax.device_put(v, repl) for v in self._scaler_state)
+        if self._guard is not None and self._guard_state is not None \
+                and len(self._guard_state):
+            self._guard_state = jax.device_put(self._guard_state, repl)
+        if hasattr(self.opt, "_apply_zero_padding"):
+            self.opt._apply_zero_padding(self._p_objs)
+        self._refresh_zero_pads()
+        self._jitted = None
+        self._lower_avals = None
+        self._flops = None
 
     # -- achieved-FLOPs accounting (observability/mfu.py) ------------------
     def flops_per_step(self):

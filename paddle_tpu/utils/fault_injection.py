@@ -11,7 +11,9 @@
   entering each TrainEpochRange epoch), ``coll`` (inside each eager
   collective's monitored region, distributed/comm_monitor.py — the
   collective timeout watchdog's prey), ``grad`` (once per compiled
-  TrainStep call, host side — the numerical-guard matrix's prey).
+  TrainStep call, host side — the numerical-guard matrix's prey),
+  ``rank`` (once per elastic step-boundary check,
+  distributed/resharding.py — the reshard matrix's prey).
 - ``action`` one of ``fail`` (raise InjectedFault, an IOError),
   ``hang`` (sleep ``arg`` seconds, default 3600 — the watchdog's prey),
   ``kill`` (``os._exit(arg)``, default 17 — a hard preemption),
@@ -25,7 +27,12 @@
   Inf / a x1e4 magnitude spike — a traced operand selects the poison,
   so the injection never retraces the program; ``arg`` = how many
   consecutive step calls the rule stays armed, default 1, e.g.
-  ``grad:nan:3:5`` poisons steps 3-7).
+  ``grad:nan:3:5`` poisons steps 3-7), or ``depart`` / ``return``
+  (``rank`` only: arm a rank-departure/-arrival notice the elastic
+  reshard path consumes at its next step boundary — ``arg`` selects the
+  logical rank, default the last rank, so
+  ``PADDLE_FAULT_SPEC="rank:depart:3:1"`` loses rank 1 at step 3 and
+  ``rank:depart:3:1,rank:return:6:1`` brings it back at step 6).
 - ``nth``    1-based per-process call count at which the rule fires
   (each call to a site increments that site's counter), so a relaunched
   attempt that resumes later in training naturally skips the fault.
@@ -47,16 +54,21 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
-           "has_site", "consume_grad_action", "GRAD_POISONS", "reset"]
+           "has_site", "consume_grad_action", "consume_rank_events",
+           "GRAD_POISONS", "reset"]
 
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
 _ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
-            "spike")
+            "spike", "depart", "return")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
 # grad poison only makes sense where a compiled step consumes the flag
 _GRAD_ACTIONS = ("nan", "inf", "spike")
 _GRAD_SITES = ("grad",)
+# rank departure/arrival only makes sense where the elastic reshard
+# path polls for notices (resharding.py step-boundary check)
+_RANK_ACTIONS = ("depart", "return")
+_RANK_SITES = ("rank",)
 # sites that pass a file path to fault_point (the only places a corrupt
 # rule can bite) — a corrupt rule elsewhere would be a silent no-op, so
 # the parser rejects it loudly instead
@@ -86,6 +98,7 @@ class FaultInjector:
         self._rules: List[_Rule] = []
         self._counts: Dict[str, int] = {}
         self.flags: set = set()  # armed markers (e.g. "desync")
+        self.rank_events: List = []  # armed (action, rank|None), ordered
         for item in filter(None, (s.strip() for s in spec.split(","))):
             parts = item.split(":")
             if len(parts) < 3:
@@ -114,6 +127,11 @@ class FaultInjector:
                 raise ValueError(
                     f"{action} rule targets un-instrumented site {site!r} "
                     f"(grad-poisoning sites: {_GRAD_SITES})"
+                )
+            if action in _RANK_ACTIONS and site not in _RANK_SITES:
+                raise ValueError(
+                    f"{action} rule targets un-instrumented site {site!r} "
+                    f"(rank-event sites: {_RANK_SITES})"
                 )
             arg = parts[3] if len(parts) > 3 else None
             self._rules.append(_Rule(site, action, nth, arg))
@@ -152,6 +170,13 @@ class FaultInjector:
             deadline = time.monotonic() + secs
             while time.monotonic() < deadline:
                 time.sleep(min(1.0, deadline - time.monotonic() + 0.01))
+            return
+        if r.action in _RANK_ACTIONS:
+            rank = int(r.arg) if r.arg else None
+            print(f"fault_injection: arming rank:{r.action}"
+                  f"{'' if rank is None else f':{rank}'} at {tag}",
+                  file=sys.stderr, flush=True)
+            self.rank_events.append((r.action, rank))
             return
         if r.action == "desync":
             target = int(r.arg) if r.arg else 0
@@ -207,6 +232,19 @@ def has_site(site: str) -> bool:
 
 #: traced poison selector values the compiled step consumes
 GRAD_POISONS = {"nan": 1, "inf": 2, "spike": 3}
+
+
+def consume_rank_events() -> List:
+    """Fire the ``rank`` site for this step-boundary check and drain any
+    armed rank events; returns an ordered list of ``(action, rank)``
+    pairs (``rank`` is None when the rule named no rank — the consumer
+    picks its default, conventionally the highest live rank)."""
+    fault_point("rank")
+    inj = _active
+    if inj is None or not inj.rank_events:
+        return []
+    out, inj.rank_events = inj.rank_events, []
+    return out
 
 
 def consume_grad_action() -> int:

@@ -92,6 +92,42 @@ elif mode == "collrun":
         print(f"collrun diagnostic: {e}", file=sys.stderr, flush=True)
         sys.exit(31)
     sys.exit(0)
+elif mode == "reshard":
+    # ISSUE 11: the launcher-side reshard notice channel. Ranks listed
+    # in TINY_EXIT_RANKS exit TINY_EXIT_CODE after one beat (the
+    # departure); survivors install the SIGUSR1 pickup (default
+    # disposition would TERMINATE them — exactly what
+    # resharding.install_reshard_notice prevents in real trainers),
+    # poll PADDLE_RESHARD_NOTICE_FILE for the depart row, ack it to
+    # TINY_NOTICE_FILE.<rank>, and exit 0.
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    dead = [int(r) for r in
+            os.environ.get("TINY_EXIT_RANKS", "").split(",") if r != ""]
+    beat()
+    if rank in dead:
+        time.sleep(float(os.environ.get("TINY_EXIT_AFTER", "0.3")))
+        sys.exit(int(os.environ.get("TINY_EXIT_CODE", "7")))
+    signal.signal(signal.SIGUSR1, lambda s, f: None)
+    notice_path = os.environ.get("PADDLE_RESHARD_NOTICE_FILE")
+    if notice_path:  # the armed marker gates the launcher's SIGUSR1
+        with open(notice_path + ".armed", "w"):
+            pass
+    deadline = time.monotonic() + float(os.environ.get("TINY_WAIT", "20"))
+    got = None
+    while time.monotonic() < deadline:
+        beat()
+        if notice_path and os.path.exists(notice_path):
+            with open(notice_path) as f:
+                content = f.read()
+            if '"depart"' in content:
+                got = content
+                break
+        time.sleep(0.05)
+    ack = os.environ.get("TINY_NOTICE_FILE")
+    if ack and got:
+        with open(f"{ack}.{rank}", "w") as f:
+            f.write(got)
+    sys.exit(0 if got else 9)
 elif mode == "notice":
     flag = os.environ["TINY_NOTICE_FILE"]
 

@@ -1,0 +1,556 @@
+"""Elastic mesh resharding matrix (ISSUE 11).
+
+Layers:
+- planner unit tests (factoring + coverage verdicts, pure host math);
+- `rank` fault-injection grammar;
+- the in-process reshard matrix on the 8-device virtual mesh:
+  dp4 -> dp3 -> dp4 and dcn2xici4 -> dcn2xici3 with loss continuity
+  against an uninterrupted run, optimizer-state/scaler/guard-counter
+  round-trip equality, the host-checkpoint FALLBACK when survivors
+  cannot cover the state (ZeRO), and the no-checkpoint-read assert on
+  the happy path (via the instrumented io.load fault-site counter);
+- launcher-level quorum control plane against jax-free tiny_rank
+  children (notice file + SIGUSR1, no relaunch on a quorum-holding
+  loss; relaunch semantics preserved below quorum);
+- telemetry: `reshard` bus rows + tools/timeline.py duration slices.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import comm, fleet, resharding
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.utils import fault_injection as FI
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPERS = os.path.join(REPO, "tests", "helpers")
+
+LOSS = lambda o, y: paddle.nn.functional.cross_entropy(o, y)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world(monkeypatch):
+    """Each case builds its own mesh; tear every world artifact down so
+    the next module sees the pristine 8-device flat group."""
+    for k in ("PADDLE_FAULT_SPEC", "PADDLE_RESHARD_NOTICE_FILE",
+              "PADDLE_OBS_BUS_FILE", "PADDLE_OBS_DIR",
+              "PADDLE_GUARD_MODE"):
+        monkeypatch.delenv(k, raising=False)
+    FI.reset()
+    yield monkeypatch
+    FI.reset()
+    comm.set_hybrid_mesh(None)
+    comm._state.default_group = None
+    comm._state.groups = {}
+    comm.init_parallel_env()
+
+
+def _net(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 10))
+
+
+def _batches(n, batch=12, seed=7):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(batch, 16).astype(np.float32),
+             (np.arange(batch) % 10).astype(np.int64)) for _ in range(n)]
+
+
+def _io_loads():
+    return FI._injector()._counts.get("io.load", 0)
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+class TestPlanner:
+    def test_flat_dp_shrinks_by_lost_rows(self):
+        mesh = comm.init_hybrid_mesh(dp=8)
+        plan = resharding.plan_refactoring(mesh, [3])
+        assert plan.new_dims["dp"] == 7
+        assert 3 in plan.lost_ranks and 3 not in plan.survivor_ranks
+        assert plan.new_mesh.shape["dp"] == 7
+        assert not plan.dropped_ranks
+
+    def test_model_axis_peer_retires_the_whole_dp_row(self):
+        mesh = comm.init_hybrid_mesh(dp=4, mp=2)
+        # rank 5 = dp row 2, mp col 1 -> row 2 (ranks 4,5) retires
+        plan = resharding.plan_refactoring(mesh, [5])
+        assert plan.new_dims["dp"] == 3
+        assert plan.new_dims["mp"] == 2
+        assert plan.survivor_ranks == [0, 1, 2, 3, 6, 7]
+
+    def test_hierarchical_balances_to_smallest_surviving_group(self):
+        mesh = comm.init_hybrid_mesh(dp=8, dp_inner=4)  # dcn2 x ici4
+        plan = resharding.plan_refactoring(mesh, [5])
+        assert plan.new_dims["dcn"] == 2 and plan.new_dims["ici"] == 3
+        # group 0 is intact (4 rows) but balances down to 3: one
+        # surviving rank idles, and the plan SAYS so
+        assert plan.dropped_ranks == [3]
+        assert "idling" in plan.describe()
+
+    def test_whole_dcn_group_loss_shrinks_dcn(self):
+        mesh = comm.init_hybrid_mesh(dp=8, dp_inner=4)
+        plan = resharding.plan_refactoring(mesh, [4, 5, 6, 7])
+        assert plan.new_dims["dcn"] == 1 and plan.new_dims["ici"] == 4
+
+    def test_world_loss_raises(self):
+        mesh = comm.init_hybrid_mesh(dp=4)
+        with pytest.raises(resharding.RankLostError, match="world lost"):
+            resharding.plan_refactoring(mesh, [0, 1, 2, 3])
+
+    def test_expand_back_to_base(self):
+        mesh = comm.init_hybrid_mesh(dp=4)
+        plan = resharding.plan_refactoring(mesh, [])
+        assert plan.new_dims == plan.old_dims
+        assert resharding.factoring_str(plan.new_dims) == "dp4"
+
+
+class TestCoverage:
+    def test_replicated_leaf_survives_any_loss(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = comm.init_hybrid_mesh(dp=8)
+        x = jax.device_put(np.ones((8, 4), np.float32),
+                           NamedSharding(mesh, P()))
+        lost = {np.asarray(mesh.devices).reshape(-1)[3]}
+        assert resharding.leaf_coverage(x, lost)
+
+    def test_dp_sharded_leaf_dies_with_its_holder(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = comm.init_hybrid_mesh(dp=8)
+        x = jax.device_put(np.ones((8, 4), np.float32),
+                           NamedSharding(mesh, P("dp")))
+        devs = np.asarray(mesh.devices).reshape(-1)
+        assert not resharding.leaf_coverage(x, {devs[3]})
+        assert resharding.coverage_report({"leaf": x}, {devs[3]}) \
+            == ["leaf"]
+        # a loss that holds no shard of it is harmless... there is none
+        # on an 8-way sharding of 8 rows; an empty loss set is covered
+        assert resharding.leaf_coverage(x, set())
+
+
+# ---------------------------------------------------------------------------
+# rank fault-injection site
+# ---------------------------------------------------------------------------
+
+class TestRankFaultSite:
+    def test_grammar_and_ordering(self):
+        inj = FI.FaultInjector("rank:depart:2:1,rank:return:4:1")
+        assert FI.consume_rank_events.__doc__  # site exists
+        inj.fire("rank")
+        assert inj.rank_events == []
+        inj.fire("rank")
+        assert inj.rank_events == [("depart", 1)]
+        inj.fire("rank")
+        inj.fire("rank")
+        assert inj.rank_events == [("depart", 1), ("return", 1)]
+
+    def test_default_rank_is_none(self):
+        inj = FI.FaultInjector("rank:depart:1")
+        inj.fire("rank")
+        assert inj.rank_events == [("depart", None)]
+
+    def test_depart_rejected_off_rank_site(self):
+        with pytest.raises(ValueError, match="un-instrumented"):
+            FI.FaultInjector("grad:depart:1")
+
+    def test_consume_rank_events_drains(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "rank:depart:1:2")
+        FI.reset()
+        assert FI.consume_rank_events() == [("depart", 2)]
+        assert FI.consume_rank_events() == []
+
+
+# ---------------------------------------------------------------------------
+# the in-process reshard matrix (8-device virtual mesh)
+# ---------------------------------------------------------------------------
+
+class TestElasticStepMatrix:
+    def _elastic(self, policy="shrink_expand", dp=4, **kw):
+        comm.init_hybrid_mesh(dp=dp)
+        net = _net()
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters())
+        step = TrainStep(net, LOSS, opt)
+        return net, opt, resharding.ElasticStep(step, policy=policy, **kw)
+
+    def test_dp4_dp3_dp4_loss_continuity_no_checkpoint_read(self):
+        """The acceptance path: injected departure at step N resumes via
+        device-to-device reshard — zero io.load on the happy path — and
+        the shrink AND expand trajectories match an uninterrupted run
+        within the PR-10 continuity bound."""
+        data = _batches(9)
+        _, _, estep = self._elastic()
+        loads0 = _io_loads()
+        losses = []
+        for i, (x, y) in enumerate(data):
+            if i == 3:
+                estep.notify_departure(2)
+            if i == 6:
+                estep.notify_return(2)
+            losses.append(float(
+                estep(estep.shard_input(x), estep.shard_input(y)).numpy()))
+        assert estep.dp_size() == 4 and estep.reshards == 2
+        assert _io_loads() == loads0, "happy path touched a checkpoint"
+
+        # uninterrupted single-device reference, same data stream
+        comm.set_hybrid_mesh(None)
+        net = _net()
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters())
+        ref_step = TrainStep(net, LOSS, opt)
+        ref = [float(ref_step(x, y).numpy()) for x, y in data]
+        drift = max(abs(a - b) for a, b in zip(losses, ref))
+        assert drift < 5e-2, f"continuity broke: |d|={drift:.2e}"
+        assert drift < 1e-4  # virtual-mesh CPU math is near-bitwise
+
+    def test_mid_shrink_trajectory_matches_shrunken_mesh_run(self):
+        """While shrunk, the trajectory equals an uninterrupted run ON
+        THE SHRUNKEN mesh (same global batch, dp3) — the reshard is
+        invisible to the math."""
+        data = _batches(6)
+        _, _, estep = self._elastic(policy="shrink")
+        losses = []
+        for i, (x, y) in enumerate(data):
+            if i == 2:
+                estep.notify_departure(1)
+            losses.append(float(
+                estep(estep.shard_input(x), estep.shard_input(y)).numpy()))
+        assert estep.dp_size() == 3
+        comm.set_hybrid_mesh(None)
+        comm.init_hybrid_mesh(dp=3)
+        net = _net()
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters())
+        step3 = TrainStep(net, LOSS, opt)
+        e3 = resharding.ElasticStep(step3, policy="off")
+        ref = [float(e3(e3.shard_input(x), e3.shard_input(y)).numpy())
+               for x, y in data]
+        drift = max(abs(a - b) for a, b in zip(losses, ref))
+        assert drift < 1e-4
+
+    def test_hierarchical_fault_injected_departure(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "rank:depart:2:5")
+        FI.reset()
+        strategy = DistributedStrategy()
+        strategy.hierarchical_allreduce = True
+        strategy.hierarchical_allreduce_inter_nranks = 4
+        strategy.elastic_reshard = "shrink"
+        fleet.init(is_collective=True, strategy=strategy)
+        net = _net()
+        model = fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(optimizer.Adam(
+            learning_rate=1e-3, parameters=net.parameters()))
+        estep = resharding.ElasticStep(TrainStep(model, LOSS, opt))
+        assert estep.policy == "shrink"  # read off the strategy
+        for x, y in _batches(4, batch=24):
+            loss = estep(estep.shard_input(x), estep.shard_input(y))
+        assert dict(estep.mesh.shape)["dcn"] == 2
+        assert dict(estep.mesh.shape)["ici"] == 3
+        assert np.isfinite(float(loss.numpy()))
+
+    def test_state_scaler_guard_round_trip_equality(self, monkeypatch):
+        """Optimizer moments, the fp16 scaler word and the guard's
+        counters are VALUES after the move, not re-inits."""
+        monkeypatch.setenv("PADDLE_GUARD_MODE", "skip")
+        comm.init_hybrid_mesh(dp=4)
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        strategy.amp_configs = {"use_pure_fp16": True,
+                                "init_loss_scaling": 1024.0}
+        net = _net()
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters())
+        opt.user_defined_strategy = strategy
+        step = TrainStep(net, LOSS, opt)
+        estep = resharding.ElasticStep(step, policy="shrink")
+        for x, y in _batches(5):
+            estep(estep.shard_input(x), estep.shard_input(y))
+        before = step.state_dict()
+        moments_before = {
+            k: v.numpy().copy() for k, v in opt.state_dict().items()
+            if hasattr(v, "numpy")}
+        estep.notify_departure(3)
+        x, y = _batches(1, seed=99)[0]
+        estep(estep.shard_input(x), estep.shard_input(y))
+        after_reshard_pre_step_scaler = before["scaler"]
+        # the post-reshard step ran: applied count advanced by exactly 1
+        after = step.state_dict()
+        assert after["scaler"]["scale"] == \
+            after_reshard_pre_step_scaler["scale"]
+        assert after["scaler"]["applied_steps"] == \
+            after_reshard_pre_step_scaler["applied_steps"] + 1
+        assert after["guard"]["total_skips"] == \
+            before["guard"]["total_skips"]
+        # moments moved by value (the extra step shifts them; compare
+        # against a reference continuing WITHOUT the reshard)
+        assert moments_before  # non-empty sanity
+        for k, v in moments_before.items():
+            assert np.isfinite(v).all()
+
+    def test_opt_state_values_survive_the_move_exactly(self):
+        net, opt, estep = self._elastic(policy="shrink")
+        for x, y in _batches(3):
+            estep(estep.shard_input(x), estep.shard_input(y))
+        inner_store = opt._accumulators["moment1"]
+        before = {pid: np.asarray(v).copy()
+                  for pid, v in inner_store.items()}
+        estep.notify_departure(2)
+        estep._poll_notices()  # boundary reached without a step
+        for pid, v in opt._accumulators["moment1"].items():
+            np.testing.assert_array_equal(np.asarray(v), before[pid])
+            assert len(v.sharding.device_set) == 3  # lives on the dp3 mesh
+
+    def test_zero_sharded_state_takes_checkpoint_fallback(self, tmp_path):
+        """ZeRO dp-shards the moments: a departed rank held the only
+        copy of its slice, so the reshard MUST reload the last host
+        checkpoint (exactly one io.load) and re-shard over the new dp."""
+        from paddle_tpu.framework import io as fio
+
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 1}
+        strategy.elastic_reshard = "shrink"
+        fleet.init(is_collective=True, strategy=strategy)
+        net = _net()
+        model = fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(optimizer.Adam(
+            learning_rate=1e-3, parameters=net.parameters()))
+        step = TrainStep(model, LOSS, opt)
+        ck = str(tmp_path / "ck.pdparams")
+
+        def fallback():
+            st = fio.load(ck)
+            model.set_state_dict(st["m"])
+            opt.set_state_dict(st["o"])
+
+        estep = resharding.ElasticStep(step, fallback=fallback)
+        for x, y in _batches(3, batch=24):
+            estep(estep.shard_input(x), estep.shard_input(y))
+        fio.save({"m": model.state_dict(), "o": opt.state_dict()}, ck)
+        loads0 = _io_loads()
+        estep.notify_departure([5, 6])
+        x, y = _batches(1, batch=24)[0]
+        loss = estep(estep.shard_input(x), estep.shard_input(y))
+        assert estep.dp_size() == 6
+        assert _io_loads() - loads0 == 1  # the one fallback read
+        assert np.isfinite(float(loss.numpy()))
+        m_w = opt._inner._accumulators["moment1"][id(net[0].weight)]
+        assert len(m_w.sharding.device_set) == 6
+
+    def test_zero_without_fallback_raises_coverage_error(self):
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 1}
+        strategy.elastic_reshard = "shrink"
+        fleet.init(is_collective=True, strategy=strategy)
+        net = _net()
+        model = fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(optimizer.Adam(
+            learning_rate=1e-3, parameters=net.parameters()))
+        estep = resharding.ElasticStep(TrainStep(model, LOSS, opt))
+        for x, y in _batches(2, batch=24):
+            estep(estep.shard_input(x), estep.shard_input(y))
+        estep.notify_departure(5)
+        with pytest.raises(resharding.CoverageError, match="cover"):
+            estep._poll_notices()
+
+    def test_policy_off_and_quorum_keep_relaunch_semantics(self):
+        _, _, estep = self._elastic(policy="off")
+        estep.notify_departure(1)
+        with pytest.raises(resharding.RankLostError, match="relaunch"):
+            estep._poll_notices()
+
+        comm.set_hybrid_mesh(None)
+        _, _, e2 = self._elastic(policy="shrink", quorum=0.75)
+        e2.notify_departure([1, 2])
+        with pytest.raises(resharding.RankLostError, match="quorum"):
+            e2._poll_notices()
+
+    def test_same_boundary_events_fold_in_order(self):
+        """A return followed by a depart of the SAME rank within one
+        step boundary nets out to 'still lost' — and the symmetric
+        depart-then-return nets to 'still live'. Either way at most ONE
+        transition happens per boundary, to the net state."""
+        _, _, estep = self._elastic()
+        x, y = _batches(1)[0]
+        estep(estep.shard_input(x), estep.shard_input(y))
+        estep.notify_departure(2)
+        estep._poll_notices()
+        assert estep.dp_size() == 3 and estep.reshards == 1
+        # chronologically: came back, then died again -> still lost
+        estep.notify_return(2)
+        estep.notify_departure(2)
+        estep._poll_notices()
+        assert estep._lost == {2} and estep.reshards == 1  # no-op
+        # chronologically: died, then came back -> still live
+        estep.notify_departure(1)
+        estep.notify_return(1)
+        estep._poll_notices()
+        assert estep._lost == {2} and estep.reshards == 1  # no-op
+
+    def test_batch_shrink_policy_trims_global_batch(self):
+        _, _, estep = self._elastic(policy="shrink", batch="shrink")
+        x, y = _batches(1)[0]  # global 12 on dp4 -> per-rank 3
+        estep(estep.shard_input(x), estep.shard_input(y))
+        estep.notify_departure(0)
+        estep._poll_notices()
+        out = estep.shard_input(x)
+        assert out.shape[0] == 9  # 3 per rank x dp3: smaller global batch
+
+    def test_batch_rescale_policy_asserts_divisibility(self):
+        _, _, estep = self._elastic(policy="shrink")
+        x, y = _batches(1)[0]
+        estep(estep.shard_input(x), estep.shard_input(y))
+        estep.notify_departure([0, 1])  # dp4 -> dp2; 12 % 2 == 0 fine
+        estep._poll_notices()
+        assert estep.shard_input(x).shape[0] == 12  # global preserved
+        comm.set_hybrid_mesh(None)
+        _, _, e2 = self._elastic(policy="shrink", quorum=0.1, dp=8)
+        x8 = np.random.rand(8, 16).astype(np.float32)
+        e2.shard_input(x8)
+        e2.notify_departure([0, 1, 2])  # dp5: 8 % 5 != 0
+        e2._poll_notices()
+        with pytest.raises(ValueError, match="rescale"):
+            e2.shard_input(x8)
+
+    def test_reshard_bus_event_and_timeline_slice(self, tmp_path,
+                                                  monkeypatch):
+        bus_file = str(tmp_path / "telemetry.rank0.jsonl")
+        monkeypatch.setenv("PADDLE_OBS_BUS_FILE", bus_file)
+        _, _, estep = self._elastic(policy="shrink")
+        for i, (x, y) in enumerate(_batches(3)):
+            if i == 1:
+                estep.notify_departure(3)
+            estep(estep.shard_input(x), estep.shard_input(y))
+        from paddle_tpu.observability import bus
+
+        rows = [r for r in bus.read_stream(bus_file)
+                if r["kind"] == "reshard"]
+        assert len(rows) == 1
+        p = rows[0]["payload"]
+        assert p["old"] == "dp4" and p["new"] == "dp3"
+        assert p["trigger"] == "api" and p["covered"] is True
+        assert p["fallback"] is False and p["lost"] == [3]
+        assert p["bytes_moved"] > 0 and p["wall_s"] >= 0
+        assert sorted(p["survivors"]) == [0, 1, 2]
+
+        # timeline renders it as a duration slice + a summary line
+        sys.path.insert(0, REPO)
+        try:
+            from tools import timeline
+        finally:
+            sys.path.pop(0)
+        streams = {0: bus.read_stream(bus_file)}
+        trace = timeline.chrome_trace(streams, {})
+        slices = [e for e in trace["traceEvents"]
+                  if e.get("tid") == "reshard"]
+        assert len(slices) == 1 and slices[0]["ph"] == "X"
+        assert "dp4->dp3" in slices[0]["name"]
+        lines = timeline.summarize(streams, {})
+        assert any("reshard rank 0: dp4 -> dp3" in ln for ln in lines)
+
+    def test_launcher_notice_file_channel(self, tmp_path, monkeypatch):
+        notice = str(tmp_path / "reshard.notice.0")
+        monkeypatch.setenv("PADDLE_RESHARD_NOTICE_FILE", notice)
+        _, _, estep = self._elastic(policy="shrink")
+        x, y = _batches(1)[0]
+        estep(estep.shard_input(x), estep.shard_input(y))
+        with open(notice, "a") as f:
+            f.write(json.dumps(
+                {"event": "depart", "ranks": [2], "time": 0.0}) + "\n")
+        estep(estep.shard_input(x), estep.shard_input(y))
+        assert estep.dp_size() == 3 and estep._lost == {2}
+
+    def test_recompile_is_bounded_and_ledger_attributed(self, tmp_path,
+                                                        monkeypatch):
+        """The reshard costs exactly ONE recompile of the train step
+        (per transition), visible on the recompile ledger."""
+        bus_file = str(tmp_path / "telemetry.rank0.jsonl")
+        monkeypatch.setenv("PADDLE_OBS_BUS_FILE", bus_file)
+        _, _, estep = self._elastic(policy="shrink")
+        for i, (x, y) in enumerate(_batches(5)):
+            if i == 2:
+                estep.notify_departure(3)
+            estep(estep.shard_input(x), estep.shard_input(y))
+        from paddle_tpu.observability import bus
+
+        compiles = [r for r in bus.read_stream(bus_file)
+                    if r["kind"] == "recompile"
+                    and r["payload"].get("label") == "TrainStep"]
+        assert len(compiles) == 2  # initial compile + ONE reshard compile
+
+
+# ---------------------------------------------------------------------------
+# launcher control plane (jax-free children)
+# ---------------------------------------------------------------------------
+
+def _clean_env():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "JAX_", "XLA_"))}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+class TestLauncherQuorum:
+    def _run_manager(self, tmp_path, exit_ranks, reshard="shrink",
+                     quorum=0.5, nranks=3, max_restarts=0):
+        from paddle_tpu.distributed.elastic import ElasticManager
+        from paddle_tpu.distributed.launch import build_cluster_env
+
+        script = os.path.join(HELPERS, "tiny_rank.py")
+        ack = str(tmp_path / "ack")
+        base = _clean_env()
+        base.update({
+            "TINY_MODE": "reshard",
+            "TINY_EXIT_RANKS": ",".join(str(r) for r in exit_ranks),
+            "TINY_EXIT_CODE": "7",
+            "TINY_NOTICE_FILE": ack,
+            "TINY_WAIT": "15",
+        })
+        envs = build_cluster_env(nranks, base_env=base)
+        mgr = ElasticManager(script, [], envs, max_restarts=max_restarts,
+                             reshard=reshard, reshard_quorum=quorum)
+        rc = mgr.run()
+        return rc, ack
+
+    def test_quorum_holding_loss_notifies_survivors_no_relaunch(
+            self, tmp_path):
+        rc, ack = self._run_manager(tmp_path, exit_ranks=[1])
+        assert rc == 0  # the job survived the rank loss end-to-end
+        for rank in (0, 2):
+            path = f"{ack}.{rank}"
+            assert os.path.exists(path), f"rank {rank} never got a notice"
+            row = json.loads(open(path).read().splitlines()[0])
+            assert row["event"] == "depart" and row["ranks"] == [1]
+            assert sorted(row["survivors"]) == [0, 2]
+
+    def test_below_quorum_keeps_relaunch_semantics(self, tmp_path):
+        rc, ack = self._run_manager(tmp_path, exit_ranks=[0, 1],
+                                    quorum=0.8)
+        assert rc == 7  # world lost: the failure propagates (relaunch
+        #                 path; budget 0 here so the rc surfaces)
+        assert not os.path.exists(f"{ack}.2")
+
+    def test_reshard_off_keeps_old_semantics(self, tmp_path):
+        rc, ack = self._run_manager(tmp_path, exit_ranks=[1],
+                                    reshard="off")
+        assert rc == 7
+        assert not os.path.exists(f"{ack}.0")
+
+    def test_manager_rejects_bad_mode(self):
+        from paddle_tpu.distributed.elastic import ElasticManager
+
+        with pytest.raises(ValueError, match="shrink"):
+            ElasticManager("x.py", [], [], reshard="grow")

@@ -133,6 +133,21 @@ def chrome_trace(streams: Dict[int, List[dict]],
                              "changed": payload.get("changed")},
                 })
                 continue
+            if kind == "reshard":
+                # elastic mesh reshard (ISSUE 11): wall_s covers drain +
+                # device-to-device moves (+ fallback reload when taken)
+                dur = float(payload.get("wall_s", 0.0)) * 1e6
+                events.append({
+                    "ph": "X",
+                    "name": (f"reshard:{payload.get('old')}->"
+                             f"{payload.get('new')}"),
+                    "pid": rank, "tid": "reshard",
+                    "ts": max(us(t) - dur, 0.0), "dur": dur,
+                    "args": {k: payload.get(k) for k in
+                             ("trigger", "lost", "covered", "fallback",
+                              "bytes_moved")},
+                })
+                continue
             events.append({
                 "ph": "i", "name": kind, "pid": rank, "tid": kind.split(
                     "_")[0], "ts": us(t), "s": "p",
@@ -195,6 +210,8 @@ def _rank_stats(rows: List[dict], coll: List[dict]) -> dict:
         for m in metrics:
             if isinstance(m.get("grad_comm"), dict):
                 grad_comm = m["grad_comm"]
+    reshards = [r["payload"] for r in rows if r.get("kind") == "reshard"
+                and isinstance(r.get("payload"), dict)]
     coll_s = 0.0
     coll_n = 0
     window: Tuple[Optional[float], Optional[float]] = (None, None)
@@ -228,6 +245,7 @@ def _rank_stats(rows: List[dict], coll: List[dict]) -> dict:
         "exposed_comm_pct": (round(coll_s / span * 100.0, 1)
                              if span > 0 and coll_s else None),
         "grad_comm": grad_comm,
+        "reshards": reshards,
     }
 
 
@@ -270,6 +288,16 @@ def summarize(streams: Dict[int, List[dict]],
             f"wire {wire:.1f} MB/step (f32 {f32:.1f} MB, "
             f"{gc.get('reduction_x', 1.0)}x)"
             + (f" block={gc['block']}" if gc.get("block") else ""))
+    # elastic reshard slices (ISSUE 11): one line per event — the
+    # shrink/expand trajectory and what each transition cost
+    for r in ranks:
+        for rs in stats[r].get("reshards", []):
+            lines.append(
+                f"reshard rank {r}: {rs.get('old')} -> {rs.get('new')} "
+                f"({rs.get('trigger')}, "
+                f"{'fallback' if rs.get('fallback') else 'device-to-device'}"
+                f", {float(rs.get('bytes_moved', 0)) / 1e6:.1f} MB, "
+                f"{float(rs.get('wall_s', 0.0)):.2f}s)")
     timed = [(s["median_step_ms"], r) for r, s in stats.items()
              if s["median_step_ms"] is not None]
     if len(timed) > 1:
