@@ -573,6 +573,84 @@ def _bench_decode(batch_sizes=(1, 8, 64), prompt_len=128, new_tokens=64):
     return out
 
 
+def _bench_decode_paged(prompt_len=128, new_tokens=64, block=16,
+                        chunk=32):
+    """Production-tier serving bench (ISSUE 13): the PAGED-KV decode
+    throughput next to round-10's contiguous `serve_gpt_medium_*` keys
+    (`_paged` suffix — same >10% continuity gate), the time-to-first-
+    token of a loaded engine under CHUNKED prefill
+    (`serve_gpt_medium_ttft_ms`, lower-better gated), and the KV HBM
+    bytes the paged pool actually holds vs the worst-case contiguous
+    reservation for the same slots (report-only extras — the headroom
+    PERF.md round-13 prices)."""
+    import jax.numpy as jnp  # noqa: F401 — device warm-up parity
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (
+        InferenceEngine, Request, TransformerLM, generate, paged_kv,
+    )
+
+    paddle.seed(0)
+    cap = prompt_len + new_tokens
+    cap += (-cap) % block  # engine pools splice block-aligned
+    model = TransformerLM(32000, d_model=1024, num_heads=16,
+                          num_layers=24, max_position=cap)
+    model.eval()
+    out = {}
+    B = 8
+    prompts = (np.arange(B * prompt_len) % 31000).reshape(
+        B, prompt_len).astype(np.int32)
+    from paddle_tpu.jit import DecodeStep, PrefillStep
+
+    pre = PrefillStep(model)
+    dec = DecodeStep(model)
+    prev = os.environ.get("PADDLE_SERVE_BLOCK_SIZE")
+    os.environ["PADDLE_SERVE_BLOCK_SIZE"] = str(block)
+    try:
+        # warm the SAME step objects the timed call uses (the round-10
+        # pattern): the timed interval prices decode, not trace+compile
+        _ = generate(model, prompts, 2, max_length=cap, prefill=pre,
+                     decode=dec)
+        t0 = time.perf_counter()
+        toks = generate(model, prompts, new_tokens, max_length=cap,
+                        prefill=pre, decode=dec)
+        assert toks.shape == (B, new_tokens)
+        dt = time.perf_counter() - t0
+        out["serve_gpt_medium_tokens_per_sec_b8_paged"] = round(
+            B * new_tokens / dt, 1)
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_SERVE_BLOCK_SIZE", None)
+        else:
+            os.environ["PADDLE_SERVE_BLOCK_SIZE"] = prev
+
+    # TTFT under load with chunked prefill: slots stay busy decoding
+    # while each new prompt prefills chunk-by-chunk — submit->first-
+    # token is what the router's SLO admission bounds
+    # pool sized by ACTUAL demand (prompt + 16 new tokens per slot),
+    # not capacity — the paged-vs-worstcase byte pair below is the
+    # point of the layout
+    demand = 4 * paged_kv.blocks_for(prompt_len + 16, block) + 1
+    engine = InferenceEngine(model, slots=4, max_length=cap,
+                             block_size=block, prefill_chunk=chunk,
+                             pool_blocks=demand)
+    for i in range(8):
+        p = (np.arange(prompt_len) % 31000).astype(np.int32)
+        engine.submit(Request(p, max_new_tokens=16, rid=i))
+    res = engine.run()
+    ttfts = sorted(r.ttft_ms for r in res.values())
+    out["serve_gpt_medium_ttft_ms"] = round(ttfts[len(ttfts) // 2], 2)
+    # KV HBM: what the paged pool holds vs the contiguous worst case
+    # for the same slot count (static shape arithmetic)
+    out["serve_kv_hbm_paged_bytes"] = paged_kv.pool_bytes(
+        engine._state.caches)
+    dh = model.d_model // 16
+    itemsize = 1 if os.environ.get("PADDLE_SERVE_KV_QUANT") else 4
+    out["serve_kv_hbm_worstcase_bytes"] = paged_kv.worst_case_bytes(
+        4, 16, cap, dh, itemsize=itemsize, layers=24)
+    return out
+
+
 def _bench_flash_attention(steps=500):
     """Long-context attention: the Pallas flash kernel vs XLA dense at
     S=2048 causal. The `steps` iterations run INSIDE one jitted lax.scan
@@ -815,6 +893,17 @@ def main():
         )
         extra.update(serve_bd)
         extra["serve_gpt_medium_tokens_per_sec_b8_spread"] = serve_sp
+        # production tier (ISSUE 13): paged-KV throughput next to the
+        # contiguous b8 key, TTFT under chunked prefill, and the KV
+        # HBM byte pair (paged pool vs worst-case reservation) —
+        # throughput/_ms keys gated, byte extras report-only
+        pg_tok, pg_bd, pg_sp = _repeat(
+            lambda: (lambda d: (
+                d["serve_gpt_medium_tokens_per_sec_b8_paged"], d))(
+                _bench_decode_paged())
+        )
+        extra.update(pg_bd)
+        extra["serve_gpt_medium_tokens_per_sec_b8_paged_spread"] = pg_sp
     # r04 measured the same model/optimizer at batch 64 with two-pass
     # f32-blacklisted batch norm: 41.78 ms / 64 imgs = 1531.7 imgs/sec
     extra["vs_r04_resnet50_bf16"] = round(r50_bf16_ips / 1531.7, 2)

@@ -260,15 +260,27 @@ class ParallelMultiHeadAttention(Layer):
             bias_attr=bias_attr, input_is_parallel=True,
         )
 
-    def gen_cache(self, batch_size, max_length, dtype=None):
+    def gen_cache(self, batch_size, max_length, dtype=None,
+                  block_size=None, pool_blocks=None):
         """Static-capacity decode cache (ISSUE 9): zero [B, H, cap, Dh]
         K/V buffers in the same MultiHeadAttention.Cache namedtuple the
         single-chip layer uses, laid out with heads sharded over 'mp'
         (matching the attention compute) when the mesh is real — the
-        compiled DecodeStep then updates each shard's slice in place."""
+        compiled DecodeStep then updates each shard's slice in place.
+
+        Round 13: ``block_size`` / ``PADDLE_SERVE_BLOCK_SIZE`` switches
+        to the PAGED layout (`serving.paged_kv.PagedKV`): the
+        [P, H, bs, Dh] block pool shards its heads over 'mp' exactly
+        like the contiguous buffer; the pool dim is slot-agnostic (any
+        block can belong to any slot), so it does NOT shard over the
+        dp axes — a dp job replicates the pool and dp slots index it
+        through their (replicated) tables, which is correct, just not
+        dp-elastic in HBM (the multi-host router scales hosts, not
+        per-host pools)."""
         import jax.numpy as jnp
 
         from ..nn.layers.transformer import MultiHeadAttention
+        from ..serving import paged_kv as pk
 
         H, dh = self.num_heads, self.head_dim
         from . import quantized_comm as qc
@@ -294,14 +306,37 @@ class ParallelMultiHeadAttention(Layer):
         spec = P(bspec, "mp" if (mp > 1 and H % mp == 0) else None,
                  None, None)
 
-        def place(z):
+        def place(z, s=None):
             if self.mesh.size > 1:
                 # the scale buffer's leading dims match the payload's,
                 # so one spec lays out both
-                z = jax.device_put(z, NamedSharding(self.mesh, spec))
+                z = jax.device_put(
+                    z, NamedSharding(self.mesh, spec if s is None else s))
             # _wrap, not Tensor(): the ctor's dtype inference would
             # np.asarray the buffer — a device read per cache allocation
             return Tensor._wrap(z)
+
+        bs_pg = (int(block_size) if block_size is not None
+                 else pk.block_size_default())
+        if bs_pg > 0:
+            # paged pool [P, H, bs, Dh]: heads over 'mp' (axis 1, like
+            # the contiguous buffer); pool dim + tables replicated
+            pspec = P(None, "mp" if (mp > 1 and H % mp == 0) else None,
+                      None, None)
+            pdt = None if kvq is not None else dt
+
+            def paged_buf():
+                raw = pk.paged_zero(
+                    int(batch_size), H, int(max_length), dh,
+                    block=bs_pg, pool_blocks=pool_blocks, dtype=pdt,
+                    quant=kvq,
+                )
+                kv = (qc.QuantKV(place(raw.kv.q, pspec),
+                                 place(raw.kv.scale, pspec))
+                      if kvq is not None else place(raw.kv, pspec))
+                return pk.PagedKV(kv, place(raw.table, P()))
+
+            return MultiHeadAttention.Cache(paged_buf(), paged_buf())
 
         if kvq is not None:
             # int8/fp8 block-scaled KV cache (ISSUE 10): payload +
@@ -447,8 +482,11 @@ class ParallelGPTBlock(Layer):
         out = h + self.fc2(m)
         return out if new_cache is None else (out, new_cache)
 
-    def gen_cache(self, batch_size, max_length, dtype=None):
-        return self.attn.gen_cache(batch_size, max_length, dtype)
+    def gen_cache(self, batch_size, max_length, dtype=None,
+                  block_size=None, pool_blocks=None):
+        return self.attn.gen_cache(batch_size, max_length, dtype,
+                                   block_size=block_size,
+                                   pool_blocks=pool_blocks)
 
 
 def split(x, size, operation: str, axis: int = 0, num_partitions: Optional[int] = None,

@@ -3,7 +3,10 @@ fluid/dygraph/jit.py and dygraph_to_static/)."""
 from .control_flow import case, cond, scan, switch_case, while_loop  # noqa: F401
 from .functional_call import functional_call, named_state, raw_state  # noqa: F401
 from .program import InputSpec, StaticFunction, declarative, to_static  # noqa: F401
-from .decode_step import DecodeState, DecodeStep, PrefillStep  # noqa: F401
+from .decode_step import (  # noqa: F401
+    DecodeState, DecodeStep, PrefillStep, SpecDecodeState,
+    SpeculativeDecodeStep,
+)
 from .recompute import recompute  # noqa: F401
 from .save_load import TranslatedLayer, load, save  # noqa: F401
 from .train_step import TrainStep  # noqa: F401

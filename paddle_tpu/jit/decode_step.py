@@ -39,7 +39,8 @@ from ..core import autograd as AG
 from ..core.tensor import Tensor
 from .functional_call import _swapped
 
-__all__ = ["DecodeState", "DecodeStep", "PrefillStep"]
+__all__ = ["DecodeState", "DecodeStep", "PrefillStep",
+           "SpecDecodeState", "SpeculativeDecodeStep", "spec_k_default"]
 
 
 def _raw_tree(tree):
@@ -200,22 +201,30 @@ class _CompiledDecodeBase:
             _ledger.install_backend_listener()
 
     # -- the pure forward segment -----------------------------------------
-    def _fwd(self, p_raws, b_raws, ids, cache_raws, pos):
-        """Model forward with the KV-cache seam as a pure function of
-        (params, buffers, ids, caches, pos) -> (logits, new caches)."""
+    def _fwd_objs(self, model, p_objs, b_objs, p_raws, b_raws, ids,
+                  cache_raws, pos, label=None):
+        """A model forward with the KV-cache seam as a pure function of
+        (params, buffers, ids, caches, pos) -> (logits, new caches).
+        Parameterized over the model so SpeculativeDecodeStep can run
+        the draft AND the target inside one program."""
         from .. import profiler as _prof
 
-        objs = self._p_objs + self._b_objs
+        objs = p_objs + b_objs
         caches = _wrap_tree(cache_raws)
         with AG.trace_mode(), \
-                _prof.device_annotation(f"{self._label}::forward"), \
+                _prof.device_annotation(
+                    label or f"{self._label}::forward"), \
                 _swapped(objs, list(p_raws) + list(b_raws)):
-            out, new_caches = self.model(
+            out, new_caches = model(
                 Tensor._wrap(ids), cache=caches, pos=Tensor._wrap(pos)
             )
             logits = out._data if isinstance(out, Tensor) else out
             new_raws = _raw_tree(new_caches)
         return logits, new_raws
+
+    def _fwd(self, p_raws, b_raws, ids, cache_raws, pos):
+        return self._fwd_objs(self.model, self._p_objs, self._b_objs,
+                              p_raws, b_raws, ids, cache_raws, pos)
 
     def _instrumented(self, donate, out_shardings):
         from ..observability import ledger as _ledger
@@ -323,30 +332,43 @@ class PrefillStep(_CompiledDecodeBase):
     masks every position > pos AND overwrites position p on the very
     step whose query sits at p (write-then-attend), so a stale row is
     never read.
+
+    Round 13 (chunked prefill): ``start`` ([B] int32, default zeros)
+    writes the chunk at positions start..start+len-1 instead of 0 —
+    the prefill-with-history continuation the engine interleaves with
+    decode windows. ``start`` is a traced argument of the SAME program
+    (zeros for a whole-prompt prefill), so chunking adds no compiles
+    beyond the chunk shape itself.
     """
 
     _label = "PrefillStep"
 
-    def _step_fn(self, p_raws, b_raws, cache_raws, ids, length):
+    def _step_fn(self, p_raws, b_raws, cache_raws, ids, length, start):
         logits, new_caches = self._fwd(
             p_raws, b_raws, ids, cache_raws,
-            jnp.zeros((ids.shape[0],), jnp.int32),
+            jnp.asarray(start, jnp.int32),
         )
         idx = jnp.clip(length - 1, 0, ids.shape[1] - 1)
         last = jnp.take_along_axis(
             logits, idx[:, None, None], axis=1
         )[:, 0, :].astype(jnp.float32)
-        return last, new_caches, jnp.asarray(length, jnp.int32)
+        return last, new_caches, jnp.asarray(start + length, jnp.int32)
 
-    def __call__(self, caches, ids, lengths):
-        """-> (last_logits [B, V] f32, new cache pytree, pos [B])."""
+    def __call__(self, caches, ids, lengths, start=None):
+        """-> (last_logits [B, V] f32, new cache pytree, pos [B]).
+        ``last_logits`` are the logits of the last REAL token of this
+        chunk; ``pos`` = start + lengths (the next write position)."""
         cache_raws = _raw_tree(caches)
+        ids = jnp.asarray(ids, jnp.int32)
+        if start is None:
+            start = jnp.zeros((int(ids.shape[0]),), jnp.int32)
         args = (
             tuple(p._data for p in self._p_objs),
             tuple(b._data for b in self._b_objs),
             cache_raws,
-            jnp.asarray(ids, jnp.int32),
+            ids,
             jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(start, jnp.int32),
         )
         if self._jitted is None:
             donate = (2,) if self._donate else ()
@@ -354,3 +376,201 @@ class PrefillStep(_CompiledDecodeBase):
             self._jitted = self._instrumented(donate, out_sh)
         self._n_steps += 1
         return self._jitted(*args)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (ISSUE 13 tentpole c)
+# ---------------------------------------------------------------------------
+
+
+def spec_k_default() -> int:
+    """``PADDLE_SERVE_SPEC_K`` — tokens the draft model proposes per
+    speculative round (default 4)."""
+    import os
+
+    try:
+        return max(int(os.environ.get("PADDLE_SERVE_SPEC_K", "4")), 1)
+    except ValueError:
+        return 4
+
+
+class SpecDecodeState:
+    """Device-resident loop state of the speculative decode: the target
+    model's caches AND the draft model's caches ride together (both
+    position-synced to the accepted sequence), plus the usual per-slot
+    vectors. Greedy-only — the accept rule compares the draft's argmax
+    against the target's argmax, which is what makes the output
+    TOKEN-EXACT vs the non-speculative DecodeStep (the acceptance
+    contract); sampled slots take the plain DecodeStep."""
+
+    FIELDS = ("caches", "draft_caches", "pos", "tok", "done", "eos",
+              "budget")
+    __slots__ = FIELDS
+
+    def __init__(self, caches, draft_caches, pos, tok, done, eos,
+                 budget):
+        self.caches = caches
+        self.draft_caches = draft_caches
+        self.pos = pos
+        self.tok = tok
+        self.done = done
+        self.eos = eos
+        self.budget = budget
+
+    def astuple(self):
+        return tuple(getattr(self, f) for f in self.FIELDS)
+
+    @classmethod
+    def make(cls, caches, draft_caches, first_tokens, pos, *,
+             eos_id=None, budget=None):
+        tok = jnp.asarray(first_tokens, jnp.int32)
+        B = int(tok.shape[0])
+
+        def vec(v, dtype):
+            return jnp.broadcast_to(jnp.asarray(v, dtype), (B,))
+
+        eos = -1 if eos_id is None else eos_id
+        return cls(
+            caches=_raw_tree(caches),
+            draft_caches=_raw_tree(draft_caches),
+            pos=jnp.asarray(pos, jnp.int32),
+            tok=tok,
+            done=jnp.zeros((B,), bool),
+            eos=vec(eos, jnp.int32),
+            budget=vec(NO_BUDGET if budget is None else budget,
+                       jnp.int32),
+        )
+
+
+class SpeculativeDecodeStep(_CompiledDecodeBase):
+    """One compiled speculative round: the DRAFT model proposes ``k``
+    tokens autoregressively (k unrolled single-token forwards inside
+    THIS program), the TARGET model scores all ``k+1`` inputs in one
+    forward, and the accept/reject fold happens IN-GRAPH — the host
+    never sees a drafted token, so the device->host transfer count is
+    independent of ``k`` and of how many drafts survive (the DecodeStep
+    contract, extended).
+
+    Greedy acceptance: drafted token ``d_i`` survives while every
+    earlier draft matched the target's argmax; the round emits the
+    target's own argmax at each surviving position plus its correction
+    at the first mismatch — by construction EXACTLY the token sequence
+    the non-speculative greedy DecodeStep emits, just 1..k+1 tokens per
+    program dispatch instead of 1 (the acceptance-rate win PERF.md
+    round-13 prices). ``emitted`` comes back as [B, k+1] with ``-1``
+    sentinels past each slot's accepted count (and everywhere for done
+    slots) — the engine/generate readback compacts them exactly like
+    the windowed non-speculative sentinels.
+
+    Capacity contract: each round writes ``k+1`` rows at pos..pos+k
+    (rejected rows are overwritten before they can ever be attended —
+    the same write-then-attend invariant PrefillStep's padding relies
+    on), so caches need ``k`` rows of headroom past the last real
+    token. ``generate()``/the engine reserve it.
+    """
+
+    _label = "SpeculativeDecodeStep"
+
+    def __init__(self, model, draft_model, *, k=None, donate=True):
+        super().__init__(model, donate=donate)
+        self.draft_model = draft_model
+        self.k = int(k) if k is not None else spec_k_default()
+        if self.k < 1:
+            # the env path clamps to >= 1 (spec_k_default); the explicit
+            # path must not crash obscurely inside jnp.stack at trace
+            raise ValueError(
+                f"SpeculativeDecodeStep needs k >= 1 draft tokens per "
+                f"round (got {self.k})")
+        self._dp_objs = list(draft_model.parameters())
+        self._db_objs = list(
+            dict(draft_model.named_buffers()).values())
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        from ..distributed import comm as _comm
+
+        mesh = _comm.hybrid_mesh()
+        if mesh is not None and mesh.size > 1:
+            repl = NamedSharding(mesh, _P())
+            for o in self._dp_objs + self._db_objs:
+                if not isinstance(
+                    getattr(o._data, "sharding", None), NamedSharding
+                ):
+                    o._data = jax.device_put(o._data, repl)
+
+    def _step_fn(self, p_raws, b_raws, dp_raws, db_raws, cache_raws,
+                 dcache_raws, pos, tok, done, eos, budget):
+        K = self.k
+        # -- draft: K unrolled single-token greedy forwards ------------
+        cur, dc = tok, dcache_raws
+        drafts = []
+        for i in range(K):
+            dlogits, dc = self._fwd_objs(
+                self.draft_model, self._dp_objs, self._db_objs,
+                dp_raws, db_raws, cur[:, None], dc, pos + i,
+                label="SpeculativeDecodeStep::draft",
+            )
+            cur = jnp.argmax(
+                dlogits[:, -1, :].astype(jnp.float32), -1
+            ).astype(jnp.int32)
+            drafts.append(cur)
+        drafts = jnp.stack(drafts, axis=1)  # [B, K]
+        # -- target: ONE forward over all K+1 inputs -------------------
+        inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
+        tlogits, new_caches = self._fwd(
+            p_raws, b_raws, inputs, cache_raws, pos
+        )
+        g = jnp.argmax(
+            tlogits.astype(jnp.float32), -1
+        ).astype(jnp.int32)  # [B, K+1] target greedy at each position
+        # -- in-graph accept/reject ------------------------------------
+        # d_i survives while every draft before it (and itself) matched
+        # the target's argmax; the emitted tokens are the target's own
+        # choices g_1..g_{n+1}, so equality with non-speculative greedy
+        # is by construction, not by luck
+        match = (drafts == g[:, :K]).astype(jnp.int32)
+        n_acc = jnp.cumprod(match, axis=1).sum(axis=1)  # [B] 0..K
+        n_emit = jnp.minimum(n_acc + 1, jnp.maximum(budget, 0))
+        n_emit = jnp.where(done, 0, n_emit)
+        j = jnp.arange(K + 1, dtype=jnp.int32)
+        base = j[None, :] < n_emit[:, None]
+        eos_hit = base & (g == eos[:, None])
+        first_eos = jnp.where(
+            eos_hit.any(axis=1), jnp.argmax(eos_hit, axis=1),
+            jnp.int32(K + 1))
+        emit_mask = base & (j[None, :] <= first_eos[:, None])
+        emit = jnp.where(emit_mask, g, jnp.int32(-1))
+        n_final = emit_mask.sum(axis=1).astype(pos.dtype)
+        new_pos = pos + n_final
+        new_budget = budget - n_final.astype(budget.dtype)
+        new_done = done | eos_hit.any(axis=1) | (new_budget <= 0)
+        last_idx = jnp.clip(n_final - 1, 0, K)
+        feed = jnp.take_along_axis(g, last_idx[:, None], axis=1)[:, 0]
+        feed = jnp.where(new_done, jnp.int32(0), feed)
+        return emit, (new_caches, dc, new_pos, feed, new_done,
+                      new_budget)
+
+    def __call__(self, state: SpecDecodeState):
+        """-> (emitted [B, k+1] int32 with -1 sentinels, new state)."""
+        state = SpecDecodeState(*_commit_tree(state.astuple()))
+        args = (
+            tuple(p._data for p in self._p_objs),
+            tuple(b._data for b in self._b_objs),
+            tuple(p._data for p in self._dp_objs),
+            tuple(b._data for b in self._db_objs),
+            state.caches, state.draft_caches, state.pos, state.tok,
+            state.done, state.eos, state.budget,
+        )
+        if self._jitted is None:
+            donate = (4, 5) if self._donate else ()
+            out_sh = (
+                None,
+                (_pin(state.caches), _pin(state.draft_caches),
+                 _pin(state.pos), _pin(state.tok), _pin(state.done),
+                 _pin(state.budget)),
+            )
+            self._jitted = self._instrumented(donate, out_sh)
+        self._n_steps += 1
+        emit, (caches, dcaches, pos, tok, done, budget) = \
+            self._jitted(*args)
+        return emit, SpecDecodeState(caches, dcaches, pos, tok, done,
+                                     state.eos, budget)

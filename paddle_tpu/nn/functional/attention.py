@@ -402,10 +402,38 @@ def cache_update(cache, new, pos):
     payload at the full cache shape + per-row-block f32 scales, ISSUE
     10) quantizes the new rows along the head dim and writes payload and
     scales with the same per-slot slice — the HBM-resident buffer the
-    decode streams every step stays narrow."""
+    decode streams every step stays narrow.
+
+    A PAGED cache (``serving.paged_kv.PagedKV`` — fixed-size block pool
+    + per-slot block table, ISSUE 13) routes the same append through
+    the table as one scatter (``paged_write``): position ``p`` lands in
+    physical block ``table[b, p // bs]``. Same constant shapes, same
+    single trace, same donatable buffers — only the storage layout
+    changes, so DecodeStep/PrefillStep and the engine splice are
+    untouched. The quantized form composes (a QuantKV pool inside the
+    PagedKV carries payload and scales in the same block layout)."""
     import jax.numpy as jnp
 
     from ...distributed import quantized_comm as qc
+    from ...serving import paged_kv as pk
+
+    if isinstance(cache, pk.PagedKV):
+        if isinstance(cache.kv, qc.QuantKV):
+            def fpq(kq, ks, tab, u, p):
+                out = pk.paged_write(qc.QuantKV(kq, ks), tab, u,
+                                     jnp.asarray(p, jnp.int32))
+                return out.q, out.scale
+
+            oq, osc = AG.apply_nondiff(
+                fpq, (cache.kv.q, cache.kv.scale, cache.table, new, pos)
+            )
+            return pk.PagedKV(qc.QuantKV(oq, osc), cache.table)
+
+        def fp(kv, tab, u, p):
+            return pk.paged_write(kv, tab, u, jnp.asarray(p, jnp.int32))
+
+        out = AG.apply_nondiff(fp, (cache.kv, cache.table, new, pos))
+        return pk.PagedKV(out, cache.table)
 
     def write(c, u, p):
         return jax.vmap(
@@ -440,15 +468,28 @@ def cached_attention(query, key, value, pos, *, scale=None):
     head); a Pallas tile would be degenerate, and a TRACED offset cannot
     feed the flash kernel's static q_offset seam. Static end-aligned
     Sq != Sk shapes (prefill-with-history) route through the flash
-    kernel via `flash_plan` instead. Inference-only (no VJP)."""
+    kernel via `flash_plan` instead. Inference-only (no VJP).
+
+    A PAGED cache (``PagedKV``, ISSUE 13) gathers the slot's view
+    [B, H, nmax*bs, D] from the block pool through the table first (one
+    gather; a quantized pool gathers narrow payload + scales and
+    dequantizes the view) — unwritten or trash-mapped rows carry
+    garbage, but they all sit at kpos > qpos so the SAME position mask
+    that hides not-yet-written contiguous rows hides them."""
     import jax.numpy as jnp
 
     from ...distributed import quantized_comm as qc
+    from ...serving import paged_kv as pk
 
     sc = scale if scale is not None else int(query.shape[-1]) ** -0.5
-    quantized = isinstance(key, qc.QuantKV)
+    paged = isinstance(key, pk.PagedKV)
+    quantized = isinstance(key.kv if paged else key, qc.QuantKV)
     Sq = int(query.shape[2])
-    Sk = int((key.q if quantized else key).shape[2])
+    if paged:
+        pool = key.kv.q if quantized else key.kv
+        Sk = int(key.table.shape[1]) * int(pool.shape[2])
+    else:
+        Sk = int((key.q if quantized else key).shape[2])
 
     def core(qr, kr, vr, pr):
         s = jnp.einsum("bhqd,bhkd->bhqk", qr, kr) * sc
@@ -462,6 +503,29 @@ def cached_attention(query, key, value, pos, *, scale=None):
     from ... import profiler as _prof
 
     with _prof.device_annotation("attention::cached"):
+        if paged:
+            # block-table gather first: the pool stays the HBM-resident
+            # form, the [B, H, nmax*bs, D] view is a transient of this
+            # step only (quantized pools gather narrow then dequantize)
+            if quantized:
+                def fpq(qr, kq, ks, kt, vq, vs, vt, pr):
+                    kr = pk.paged_gather(qc.QuantKV(kq, ks), kt,
+                                         qr.dtype)
+                    vr = pk.paged_gather(qc.QuantKV(vq, vs), vt,
+                                         qr.dtype)
+                    return core(qr, kr, vr, pr)
+
+                return AG.apply_nondiff(fpq, (
+                    query, key.kv.q, key.kv.scale, key.table,
+                    value.kv.q, value.kv.scale, value.table, pos))
+
+            def fpg(qr, kk, kt, vk, vt, pr):
+                return core(qr, pk.paged_gather(kk, kt),
+                            pk.paged_gather(vk, vt), pr)
+
+            return AG.apply_nondiff(
+                fpg, (query, key.kv, key.table, value.kv, value.table,
+                      pos))
         if quantized:
             # dequantize-on-read: the score math runs at the query
             # dtype, but the buffer the step streams from HBM (the

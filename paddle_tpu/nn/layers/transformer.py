@@ -134,14 +134,25 @@ class MultiHeadAttention(Layer):
         return transpose(x, [0, 2, 1, 3])  # B, H, T, D
 
     def gen_cache(self, key=None, value=None, type=None, max_length=None,
-                  batch_size=None, dtype=None):
+                  batch_size=None, dtype=None, block_size=None,
+                  pool_blocks=None):
         """Paddle-compatible `gen_cache` grown a STATIC-CAPACITY form
         (ISSUE 9): with ``max_length`` the returned ``Cache`` holds
         zero-filled [B, H, max_length, Dh] buffers that decode WRITES
         INTO at per-slot positions (forward's ``pos`` kwarg) — constant
         shapes, so the compiled DecodeStep traces once and the buffers
         are donatable. Without it, the legacy zero-length concat cache
-        (shape grows per step — eager-only) is returned."""
+        (shape grows per step — eager-only) is returned.
+
+        Round 13: ``block_size`` (or the ``PADDLE_SERVE_BLOCK_SIZE``
+        env default, static-capacity form only) switches the storage to
+        the PAGED layout — a [P, H, bs, Dh] block pool + [B, nmax]
+        block table (`serving.paged_kv.PagedKV`) behind the same
+        ``cache_update``/``cached_attention`` seam. ``pool_blocks``
+        sizes the pool explicitly (tables start all-trash; the engine's
+        BlockPool assigns per request — HBM scales with actual length);
+        the default identity mapping reserves full capacity per slot.
+        Composes with the int8/fp8 quantized form."""
         if type == MultiHeadAttention.StaticCache:
             k = self._split_heads(self._proj(key, 1))
             v = self._split_heads(
@@ -164,6 +175,31 @@ class MultiHeadAttention(Layer):
             # serving form — a legacy concat-cache caller in the same
             # process never opted in and keeps its full-width cache
             kvq = None
+        from ...serving import paged_kv as pk
+
+        # paged layout (ISSUE 13): explicit block_size wins; the env
+        # default applies only to the static-capacity serving form
+        bs_pg = (int(block_size) if block_size is not None
+                 else (pk.block_size_default() if cap > 0 else 0))
+        if bs_pg > 0:
+            if cap == 0:
+                raise ValueError(
+                    "a paged KV cache needs the static-capacity form: "
+                    "pass max_length="
+                )
+            pdt = None if kvq is not None else (dtype or self._dtype)
+
+            def paged_buf():
+                raw = pk.paged_zero(
+                    B, self.num_heads, cap, self.head_dim, block=bs_pg,
+                    pool_blocks=pool_blocks, dtype=pdt, quant=kvq,
+                )
+                kv = (qc.QuantKV(Tensor._wrap(raw.kv.q),
+                                 Tensor._wrap(raw.kv.scale))
+                      if kvq is not None else Tensor._wrap(raw.kv))
+                return pk.PagedKV(kv, Tensor._wrap(raw.table))
+
+            return MultiHeadAttention.Cache(paged_buf(), paged_buf())
         if kvq is not None:
             # int8/fp8 block-scaled KV cache (ISSUE 10): narrow payload
             # at the cache shape + per-row-block f32 scales, reusing the

@@ -164,9 +164,15 @@ class DecodeMetricsSampler:
     Rows:
       ``decode_metrics``  per readback window: decode steps, emitted
         tokens, tokens/sec over the window wall clock, inflight slots,
-        queue depth;
+        queue depth; round 13 adds TTFT of the requests that reached
+        their first token inside the window (submit -> first token:
+        the SLO the router schedules against) and the paged block-pool
+        gauges (blocks in use / total, cumulative freed, deferred
+        admissions) — all host-side values the engine already holds at
+        its readback, so the transfer count stays bitwise unchanged
+        (the counted-np.asarray assert covers the grown row);
       ``decode_request``  per completed request: generated tokens,
-        end-to-end latency, prefill share, per-token mean.
+        end-to-end latency, prefill share, TTFT, per-token mean.
     """
 
     def __init__(self):
@@ -174,7 +180,9 @@ class DecodeMetricsSampler:
         self._windows = 0
 
     def window(self, *, steps: int, tokens: int, wall_s: float,
-               inflight: int, queue_depth: int) -> None:
+               inflight: int, queue_depth: int, ttft_ms=None,
+               blocks_in_use=None, blocks_total=None, blocks_freed=None,
+               admit_deferred=None) -> None:
         if not self.enabled or not bus.enabled():
             return
         self._windows += 1
@@ -187,16 +195,31 @@ class DecodeMetricsSampler:
         if wall_s > 0:
             payload["tokens_per_sec"] = round(tokens / wall_s, 1)
             payload["step_ms"] = round(wall_s / max(steps, 1) * 1e3, 3)
+        if ttft_ms:  # requests admitted this window (host wall clocks)
+            payload["ttft_ms"] = round(max(ttft_ms), 3)
+            payload["ttft_ms_mean"] = round(
+                sum(ttft_ms) / len(ttft_ms), 3)
+        if blocks_total:  # paged pool occupancy/eviction gauges
+            payload["blocks_in_use"] = int(blocks_in_use or 0)
+            payload["blocks_total"] = int(blocks_total)
+            payload["block_occupancy"] = round(
+                (blocks_in_use or 0) / blocks_total, 4)
+            payload["blocks_freed"] = int(blocks_freed or 0)
+        if admit_deferred:
+            payload["admit_deferred"] = int(admit_deferred)
         bus.emit("decode_metrics", payload, step=self._windows)
 
     def request_done(self, *, rid, tokens: int, latency_ms: float,
-                     prefill_ms: float) -> None:
+                     prefill_ms: float, ttft_ms=None) -> None:
         if not self.enabled or not bus.enabled():
             return
-        bus.emit("decode_request", {
+        payload = {
             "rid": rid,
             "tokens": int(tokens),
             "latency_ms": round(latency_ms, 3),
             "prefill_ms": round(prefill_ms, 3),
             "ms_per_token": round(latency_ms / max(tokens, 1), 3),
-        }, step=self._windows)
+        }
+        if ttft_ms is not None:
+            payload["ttft_ms"] = round(ttft_ms, 3)
+        bus.emit("decode_request", payload, step=self._windows)

@@ -19,14 +19,29 @@ package does the same for the decode direction:
   length-bucketed prefill with the bucketed compile cache, per-request
   stop conditions and sampling params, `decode_metrics` telemetry on
   the readback cadence.
+
+Round 13 (ISSUE 13) grows it into the production tier:
+
+- `paged_kv` — fixed-size-block KV pool + per-slot block tables behind
+  the same cache seam (HBM tracks actual context, appends are
+  defrag-free, freed blocks serve the next request immediately);
+- chunked prefill + TTFT accounting in the engine
+  (`PADDLE_SERVE_PREFILL_CHUNK`), speculative decoding in `generate`
+  (`draft_model=`, `jit.SpeculativeDecodeStep` — greedy token-exact);
+- `router` — the multi-host front end: admission control, SLO-aware
+  host choice driven by the `decode_metrics` bus rows, a jax-free
+  worker for the launcher-driven multi-process dryrun.
 """
+from . import paged_kv  # noqa: F401
 from . import sampling  # noqa: F401
 from .engine import (  # noqa: F401
     GeneratedResult, GenerationConfig, InferenceEngine, Request, generate,
 )
 from .model import TransformerLM  # noqa: F401
+from .router import FileHost, LocalHost, Router  # noqa: F401
 
 __all__ = [
     "sampling", "TransformerLM", "generate", "GenerationConfig",
-    "Request", "InferenceEngine", "GeneratedResult",
+    "Request", "InferenceEngine", "GeneratedResult", "paged_kv",
+    "Router", "LocalHost", "FileHost",
 ]

@@ -1,4 +1,5 @@
-"""Decode loop + continuous-batching inference engine (ISSUE 9).
+"""Decode loop + continuous-batching inference engine (ISSUE 9,
+production tier ISSUE 13).
 
 Two layers on top of the compiled `jit.PrefillStep`/`jit.DecodeStep`
 pair:
@@ -8,7 +9,9 @@ pair:
   compiled prefill, one compiled single-token step, DEVICE-RESIDENT
   loop state. With ``sync_every=0`` (the default without a stop token)
   the host touches the device exactly once after the loop — zero
-  per-token transfers, asserted in tests/test_serving.py.
+  per-token transfers, asserted in tests/test_serving.py. With a
+  ``draft_model`` the greedy loop runs `jit.SpeculativeDecodeStep`
+  instead: 1..k+1 tokens per dispatch, token-exact vs the plain step.
 
 - :class:`InferenceEngine` — slot-based continuous batching: a fixed
   [slots, H, cap, Dh] cache pool, per-request prefill into a length
@@ -19,10 +22,29 @@ pair:
   and host readbacks only on the ``PADDLE_SERVE_SYNC_EVERY`` cadence —
   the same cadence `decode_metrics` telemetry rides (zero extra syncs).
 
+Round 13 grows the engine into the production tier:
+
+- **paged KV pool** (``PADDLE_SERVE_BLOCK_SIZE`` / ctor args): the
+  cache is a `serving.paged_kv` block pool + per-slot tables; a
+  request's whole block budget (``prompt + max_new_tokens``) is
+  allocated at insert and freed at retire, so HBM tracks ACTUAL
+  context, not slots x capacity, and a too-full pool DEFERS admission
+  instead of overcommitting (the router's per-host admission signal);
+- **chunked prefill** (``PADDLE_SERVE_PREFILL_CHUNK``): long prompts
+  prefill in fixed-size chunks interleaved with decode windows
+  through `PrefillStep`'s ``start`` seam, so one long prompt can no
+  longer stall every inflight request for its whole prefill — the
+  TTFT bound under load;
+- **TTFT accounting**: submit -> first-token latency per request,
+  riding the existing readback cadence onto `decode_metrics`.
+
 Env knobs (documented in README):
-  ``PADDLE_SERVE_SYNC_EVERY``  decode steps per engine readback (16)
-  ``PADDLE_SERVE_BUCKETS``     prefill length buckets ("16,32,64,128,
-                               256,512,1024")
+  ``PADDLE_SERVE_SYNC_EVERY``    decode steps per engine readback (16)
+  ``PADDLE_SERVE_BUCKETS``       prefill length buckets ("16,32,64,128,
+                                 256,512,1024")
+  ``PADDLE_SERVE_BLOCK_SIZE``    KV block size; 0 = contiguous cache
+  ``PADDLE_SERVE_PREFILL_CHUNK`` prefill chunk length; 0 = whole-prompt
+  ``PADDLE_SERVE_SPEC_K``        draft tokens per speculative round (4)
 """
 from __future__ import annotations
 
@@ -37,14 +59,20 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..jit.decode_step import DecodeState, DecodeStep, PrefillStep
+from ..jit.decode_step import (
+    DecodeState, DecodeStep, PrefillStep, SpecDecodeState,
+    SpeculativeDecodeStep, spec_k_default,
+)
+from . import paged_kv as pk
 from . import sampling
 
 __all__ = ["GenerationConfig", "generate", "Request", "GeneratedResult",
-           "InferenceEngine", "prefill_buckets", "bucket_for"]
+           "InferenceEngine", "prefill_buckets", "bucket_for",
+           "prefill_chunk_default"]
 
 _SYNC_ENV = "PADDLE_SERVE_SYNC_EVERY"
 _BUCKETS_ENV = "PADDLE_SERVE_BUCKETS"
+_CHUNK_ENV = "PADDLE_SERVE_PREFILL_CHUNK"
 
 
 def sync_every_default() -> int:
@@ -52,6 +80,15 @@ def sync_every_default() -> int:
         return max(int(os.environ.get(_SYNC_ENV, "16")), 1)
     except ValueError:
         return 16
+
+
+def prefill_chunk_default() -> int:
+    """``PADDLE_SERVE_PREFILL_CHUNK`` — prompt tokens per chunked-
+    prefill piece; 0 (default) prefills whole prompts in one program."""
+    try:
+        return max(int(os.environ.get(_CHUNK_ENV, "0")), 0)
+    except ValueError:
+        return 0
 
 
 def prefill_buckets() -> List[int]:
@@ -101,10 +138,64 @@ def _pad_prompts(prompts, pad_to, pad_id=0):
     return ids, lens
 
 
+def _spec_generate(model, draft_model, rows, n_new, cfg, cap, bucket,
+                   sync_every, spec_k, prefill, decode):
+    """The speculative greedy loop behind :func:`generate`: one
+    `SpeculativeDecodeStep` dispatch emits 1..k+1 tokens per slot; the
+    host compacts the -1 sentinels AFTER the loop, so transfers scale
+    with readback windows exactly like the plain loop."""
+    B = len(rows)
+    ids, lens = _pad_prompts(rows, bucket)
+    pre = prefill if prefill is not None else PrefillStep(model)
+    step = decode if isinstance(decode, SpeculativeDecodeStep) else \
+        SpeculativeDecodeStep(model, draft_model, k=spec_k)
+    # the draft prefill reuses across calls through the step object —
+    # the same compile-cache seam `prefill`/`decode` give the target
+    dpre = getattr(step, "_draft_prefill", None)
+    if dpre is None:
+        dpre = step._draft_prefill = PrefillStep(draft_model)
+    caches = model.gen_cache(B, cap)
+    dcaches = draft_model.gen_cache(B, cap)
+    last, cache_raws, pos = pre(caches, ids, lens)
+    _, dcache_raws, _ = dpre(dcaches, ids, lens)
+    first = sampling.greedy(last)
+    state = SpecDecodeState.make(
+        cache_raws, dcache_raws, first, pos, eos_id=cfg.eos_id,
+        budget=n_new - 1)
+    state.done = first == state.eos
+    state.tok = jnp.where(state.done, jnp.int32(0), first)
+
+    emits = [first[:, None]]
+    # None -> the default cadence (the in-graph budget guarantees
+    # termination, so early-exit checks only save wasted rounds); an
+    # EXPLICIT 0 keeps the round-9 contract — zero mid-loop host syncs,
+    # one readback after the loop
+    sync = sync_every_default() if sync_every is None \
+        else max(int(sync_every), 0)
+    since = 0
+    # each round emits >= 1 token per live slot, so n_new - 1 rounds
+    # always exhaust the budget; the done check on the sync cadence
+    # exits as soon as acceptance ran ahead of that worst case
+    for _ in range(n_new - 1):
+        emit, state = step(state)
+        emits.append(emit)
+        since += 1
+        if sync and since >= sync:
+            since = 0
+            if bool(np.asarray(state.done).all()):
+                break
+    seq = np.asarray(jnp.concatenate(emits, axis=1))
+    out = np.full((B, n_new), -1, np.int32)
+    for b in range(B):
+        row = [int(t) for t in seq[b] if t >= 0]
+        out[b, : min(len(row), n_new)] = row[:n_new]
+    return out
+
+
 def generate(model, input_ids, max_new_tokens=None, *, config=None,
              temperature=0.0, top_k=0, top_p=1.0, eos_id=None, seed=0,
              max_length=None, sync_every=None, return_logits=False,
-             prefill=None, decode=None):
+             prefill=None, decode=None, draft_model=None, spec_k=None):
     """Decode ``max_new_tokens`` tokens for a whole batch.
 
     Returns [B, max_new_tokens] int32 numpy tokens (``-1`` marks
@@ -118,6 +209,13 @@ def generate(model, input_ids, max_new_tokens=None, *, config=None,
     done mask every ``PADDLE_SERVE_SYNC_EVERY`` steps to exit early.
     ``prefill``/``decode`` accept pre-built step objects so repeated
     calls share their compile caches.
+
+    ``draft_model`` switches the loop to SPECULATIVE decoding (ISSUE
+    13): greedy-only (the in-graph accept rule compares argmaxes —
+    token-exact vs the plain step by construction), ``spec_k`` drafts
+    per round (default ``PADDLE_SERVE_SPEC_K``). The cache reserves
+    ``spec_k`` rows of headroom for the round's in-flight rejected
+    writes.
     """
     cfg = config if config is not None else GenerationConfig(
         temperature=temperature, top_k=top_k, top_p=top_p,
@@ -129,6 +227,43 @@ def generate(model, input_ids, max_new_tokens=None, *, config=None,
     rows = [np.asarray(p, np.int32).reshape(-1) for p in input_ids]
     B = len(rows)
     max_len = max(r.size for r in rows)
+    if draft_model is not None:
+        if np.any(np.asarray(cfg.temperature, np.float32) > 0.0):
+            raise ValueError(
+                "speculative decoding is greedy-only (the accept rule "
+                "compares argmaxes); pass temperature<=0 or drop "
+                "draft_model")
+        if return_logits:
+            raise ValueError(
+                "return_logits is not supported with draft_model: the "
+                "speculative step folds target logits into the accept "
+                "decision in-graph")
+        draft_model.eval()
+        if isinstance(decode, SpeculativeDecodeStep):
+            # the prebuilt step's own k drives how many rows each round
+            # writes — headroom MUST follow it, not the env default
+            # (a larger k than the reserved headroom would clamp-write
+            # over live rows near the end of generation)
+            if spec_k is not None and int(spec_k) != decode.k:
+                raise ValueError(
+                    f"spec_k={spec_k} conflicts with the prebuilt "
+                    f"decode step's k={decode.k}")
+            K = decode.k
+        else:
+            K = int(spec_k) if spec_k is not None else spec_k_default()
+        # + K headroom: a round writes k+1 rows at pos..pos+k and the
+        # rejected tail must land inside the buffer (write-then-attend
+        # masks it until overwritten)
+        cap = int(max_length) if max_length is not None \
+            else max_len + n_new + K
+        if max_len + n_new + K > cap:
+            raise ValueError(
+                f"max_length={cap} cannot hold prompt ({max_len}) + "
+                f"{n_new} new tokens + spec_k={K} headroom")
+        bucket = bucket_for(max_len, cap)
+        return _spec_generate(model, draft_model, rows, n_new, cfg,
+                              cap, bucket, sync_every, K, prefill,
+                              decode)
     cap = int(max_length) if max_length is not None \
         else max_len + n_new
     if max_len + n_new > cap + 1:
@@ -197,16 +332,20 @@ class Request:
         self.top_p = float(top_p)
         self.eos_id = -1 if eos_id is None else int(eos_id)
         self.rid = next(_rid_counter) if rid is None else rid
+        self.t_submit: Optional[float] = None  # set by engine.submit
 
 
 class GeneratedResult:
     """Completed request: generated ids + latency accounting."""
 
-    def __init__(self, rid, tokens, prefill_ms, total_ms):
+    def __init__(self, rid, tokens, prefill_ms, total_ms, ttft_ms=None):
         self.rid = rid
         self.tokens = list(tokens)
         self.prefill_ms = prefill_ms
         self.total_ms = total_ms
+        #: submit -> first generated token (includes queue wait +
+        #: chunked prefill; the SLO the router schedules against)
+        self.ttft_ms = prefill_ms if ttft_ms is None else ttft_ms
 
     @property
     def ms_per_token(self):
@@ -215,13 +354,32 @@ class GeneratedResult:
 
 
 class _Slot:
-    __slots__ = ("req", "t_start", "prefill_ms", "tokens")
+    __slots__ = ("req", "t_start", "prefill_ms", "tokens", "ttft_ms")
 
-    def __init__(self, req, t_start, prefill_ms, first_token):
+    def __init__(self, req, t_start, prefill_ms, first_token,
+                 ttft_ms=None):
         self.req = req
         self.t_start = t_start
         self.prefill_ms = prefill_ms
         self.tokens = [int(first_token)]
+        self.ttft_ms = prefill_ms if ttft_ms is None else ttft_ms
+
+
+class _Pending:
+    """A chunked prefill in flight: the slot and (paged) blocks are
+    RESERVED, the batch-1 cache fills one chunk per engine turn."""
+
+    __slots__ = ("req", "slot", "blocks", "raws", "consumed", "t0",
+                 "prefill_s")
+
+    def __init__(self, req, slot, blocks, raws, t0):
+        self.req = req
+        self.slot = slot
+        self.blocks = blocks
+        self.raws = raws
+        self.consumed = 0
+        self.t0 = t0
+        self.prefill_s = 0.0
 
 
 class InferenceEngine:
@@ -234,23 +392,77 @@ class InferenceEngine:
     batch 1 through the length-bucketed `PrefillStep` and is spliced
     into the pool by a small compiled insert program (cache buffers
     donated end to end).
+
+    Round 13 (paged pool): with ``block_size`` (or the env default) the
+    cache is a `paged_kv` block pool of ``pool_blocks`` blocks; each
+    admitted request takes exactly ``ceil((prompt + max_new) / bs)``
+    blocks for its lifetime, so a pool sized for the EXPECTED token
+    load serves more slots than worst-case reservation would — and when
+    it can't cover the next request, admission DEFERS (the queue holds)
+    instead of overcommitting. Retired slots release their blocks and
+    their table rows are redirected to the trash block, so the done
+    slot's keep-alive writes can never corrupt a reallocated block.
+
+    Round 13 (chunked prefill): with ``prefill_chunk`` (or the env
+    default) prompts longer than one chunk prefill incrementally —
+    one chunk per engine turn, decode windows in between — bounding
+    every inflight request's added latency by one chunk's compute
+    instead of one full prompt's.
     """
 
     def __init__(self, model, *, slots=4, max_length=256,
-                 sync_every=None, seed=0):
+                 sync_every=None, seed=0, block_size=None,
+                 pool_blocks=None, prefill_chunk=None):
         model.eval()
         self.model = model
         self.slots = int(slots)
         self.max_length = int(max_length)
         self.sync_every = (sync_every_default() if sync_every is None
                            else max(int(sync_every), 1))
+        self.block_size = (int(block_size) if block_size is not None
+                           else pk.block_size_default())
+        self.prefill_chunk = (int(prefill_chunk)
+                              if prefill_chunk is not None
+                              else prefill_chunk_default())
         self._prefill = PrefillStep(model)
         self._decode = DecodeStep(model)
         self._insert_jitted = None
         self._queue: deque = deque()
         self._active: Dict[int, _Slot] = {}
+        self._pending: Dict[int, _Pending] = {}
         self._key = jax.random.PRNGKey(seed)
-        caches = model.gen_cache(self.slots, self.max_length)
+        self._pool: Optional[pk.BlockPool] = None
+        self._slot_blocks: Dict[int, List[int]] = {}
+        self._nmax = 0
+        self._admit_deferred = 0
+        self._ttft_window: List[float] = []
+        if self.prefill_chunk > 0 and \
+                self.max_length % self.prefill_chunk:
+            # every chunk writes a full C-wide window; with cap % C != 0
+            # the LAST chunk of a near-capacity prompt would overrun the
+            # cache and dynamic_update_slice would clamp the start —
+            # silently overwriting earlier prompt rows. Alignment makes
+            # ceil(L/C)*C <= cap for every admissible L.
+            raise ValueError(
+                f"max_length={self.max_length} must be a multiple of "
+                f"prefill_chunk={self.prefill_chunk} (the final chunk "
+                f"writes a full chunk-wide window)")
+        if self.block_size > 0:
+            if self.max_length % self.block_size:
+                raise ValueError(
+                    f"max_length={self.max_length} must be a multiple "
+                    f"of block_size={self.block_size} (the batch-1 "
+                    f"prefill cache splices block-aligned)")
+            self._nmax = pk.num_blocks(self.max_length, self.block_size)
+            total = (pool_blocks if pool_blocks is not None
+                     else self.slots * self._nmax + 1)
+            self._pool = pk.BlockPool(total)
+            caches = model.gen_cache(
+                self.slots, self.max_length,
+                block_size=self.block_size, pool_blocks=total)
+        else:
+            caches = model.gen_cache(self.slots, self.max_length,
+                                     block_size=0)
         self._state = DecodeState.make(
             caches, first_tokens=np.zeros(self.slots, np.int32),
             pos=np.zeros(self.slots, np.int32), seed=seed)
@@ -267,20 +479,55 @@ class InferenceEngine:
         self._metrics = DecodeMetricsSampler()
 
     # -- public API --------------------------------------------------------
+    def needed_blocks(self, req: Request) -> int:
+        """Blocks the paged pool charges ``req`` (0 when contiguous)."""
+        if self._pool is None:
+            return 0
+        return pk.blocks_for(
+            req.prompt_ids.size + req.max_new_tokens, self.block_size)
+
+    def free_blocks(self) -> Optional[int]:
+        return None if self._pool is None else self._pool.free
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def inflight(self) -> int:
+        return len(self._active) + len(self._pending)
+
     def submit(self, req: Request) -> None:
         if req.prompt_ids.size + req.max_new_tokens > self.max_length:
             raise ValueError(
                 f"request {req.rid}: prompt ({req.prompt_ids.size}) + "
                 f"max_new_tokens ({req.max_new_tokens}) exceeds "
                 f"max_length={self.max_length}")
+        if self._pool is not None and \
+                self.needed_blocks(req) > self._pool.total:
+            raise ValueError(
+                f"request {req.rid} needs {self.needed_blocks(req)} KV "
+                f"blocks but the pool only has {self._pool.total} — it "
+                f"can never be admitted")
+        req.t_submit = time.perf_counter()
         self._queue.append(req)
 
     def run(self) -> Dict[object, GeneratedResult]:
         """Drain the queue; returns rid -> GeneratedResult."""
         results: Dict[object, GeneratedResult] = {}
-        while self._queue or self._active:
-            self._fill_free_slots(results)
+        while self._queue or self._active or self._pending:
+            self._advance_prefills(results)
+            progress = self._fill_free_slots(results)
             if not self._active:
+                if not self._pending and not progress and self._queue:
+                    # nothing inflight and the head request can't start:
+                    # with a paged pool this would spin forever (blocks
+                    # can only come back from retiring work, and there
+                    # is none) — fail loudly instead
+                    req = self._queue[0]
+                    raise RuntimeError(
+                        f"request {req.rid} cannot be admitted: needs "
+                        f"{self.needed_blocks(req)} blocks, "
+                        f"{self.free_blocks()} free, nothing inflight "
+                        f"to free more")
                 continue
             window = self._window()
             t0 = time.perf_counter()
@@ -295,10 +542,19 @@ class InferenceEngine:
             done = np.asarray(self._state.done)
             dt = time.perf_counter() - t0
             self._collect(tok_block, done, results)
+            ttfts, self._ttft_window = self._ttft_window, []
             self._metrics.window(
                 steps=window, tokens=int((tok_block >= 0).sum()),
                 wall_s=dt, inflight=len(self._active),
-                queue_depth=len(self._queue))
+                queue_depth=len(self._queue),
+                ttft_ms=ttfts,
+                blocks_in_use=(None if self._pool is None
+                               else self._pool.in_use),
+                blocks_total=(None if self._pool is None
+                              else self._pool.total),
+                blocks_freed=(None if self._pool is None
+                              else self._pool.freed_total),
+                admit_deferred=self._admit_deferred)
         return results
 
     # -- internals ---------------------------------------------------------
@@ -316,37 +572,114 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _fill_free_slots(self, results) -> None:
+    def _slot_cache(self):
+        """A CONTIGUOUS batch-1 cache for one request's prefill (the
+        pool may be paged; the splice re-blocks it)."""
+        return self.model.gen_cache(1, self.max_length, block_size=0)
+
+    def _advance_prefills(self, results) -> None:
+        """One chunk per pending prefill per engine turn: the chunked-
+        prefill interleave that bounds how long a decode window can be
+        delayed by somebody else's long prompt."""
+        for slot in list(self._pending):
+            job = self._pending[slot]
+            C = self.prefill_chunk
+            L = job.req.prompt_ids.size
+            t0 = time.perf_counter()
+            take = min(C, L - job.consumed)
+            chunk = np.zeros((1, C), np.int32)
+            chunk[0, :take] = job.req.prompt_ids[
+                job.consumed: job.consumed + take]
+            last, job.raws, _ = self._prefill(
+                job.raws, chunk, np.asarray([take], np.int32),
+                start=np.asarray([job.consumed], np.int32))
+            job.consumed += take
+            job.prefill_s += time.perf_counter() - t0
+            if job.consumed >= L:
+                del self._pending[slot]
+                self._activate(slot, job.req, job.raws, last,
+                               blocks=job.blocks, t_enq=job.t0,
+                               prefill_ms=job.prefill_s * 1e3,
+                               results=results)
+
+    def _fill_free_slots(self, results) -> bool:
         if not self._queue:
-            return
-        free = [s for s in range(self.slots) if s not in self._active]
+            return False
+        progress = False
+        free = [s for s in range(self.slots)
+                if s not in self._active and s not in self._pending]
         for slot in free:
             if not self._queue:
                 break
-            req = self._queue.popleft()
+            req = self._queue[0]
+            blocks = None
+            if self._pool is not None:
+                blocks = self._pool.alloc(self.needed_blocks(req))
+                if blocks is None:
+                    # pool can't cover the head request: DEFER admission
+                    # (blocks come back when inflight work retires) —
+                    # head-of-line on purpose: skipping ahead would
+                    # starve long-context requests under load
+                    self._admit_deferred += 1
+                    break
+            self._queue.popleft()
+            progress = True
+            L = req.prompt_ids.size
+            if self.prefill_chunk > 0 and L > self.prefill_chunk:
+                self._pending[slot] = _Pending(
+                    req, slot, blocks, self._slot_cache(),
+                    time.perf_counter())
+                continue
             t0 = time.perf_counter()
-            first = self._insert(slot, req)
-            prefill_ms = (time.perf_counter() - t0) * 1e3
-            if first == req.eos_id or req.max_new_tokens <= 1:
-                # degenerate request: done at its first token
-                results[req.rid] = GeneratedResult(
-                    req.rid, [first], prefill_ms, prefill_ms)
-                self._metrics.request_done(
-                    rid=req.rid, tokens=1, latency_ms=prefill_ms,
-                    prefill_ms=prefill_ms)
-                self._state.done = self._state.done.at[slot].set(True)
-            else:
-                self._active[slot] = _Slot(req, t0, prefill_ms, first)
+            bucket = bucket_for(L, self.max_length)
+            ids, lens = _pad_prompts([req.prompt_ids], bucket)
+            last, slot_raws, _ = self._prefill(self._slot_cache(), ids,
+                                               lens)
+            self._activate(slot, req, slot_raws, last, blocks=blocks,
+                           t_enq=t0,
+                           prefill_ms=(time.perf_counter() - t0) * 1e3,
+                           results=results)
+        return progress
 
-    def _insert(self, slot: int, req: Request) -> int:
-        """Prefill one request and splice it into the pool slot.
+    def _activate(self, slot, req, slot_raws, last, *, blocks, t_enq,
+                  prefill_ms, results) -> None:
+        """Sample the first token, splice the prefilled cache into the
+        pool, and either park the request in its slot or (degenerate:
+        eos/1-token budget) finish it immediately."""
+        first = self._insert(slot, req, slot_raws, last, blocks)
+        now = time.perf_counter()
+        ttft_ms = ((now - req.t_submit) * 1e3
+                   if req.t_submit is not None else prefill_ms)
+        self._ttft_window.append(ttft_ms)
+        if first == req.eos_id or req.max_new_tokens <= 1:
+            # degenerate request: done at its first token
+            results[req.rid] = GeneratedResult(
+                req.rid, [first], prefill_ms, prefill_ms, ttft_ms)
+            self._metrics.request_done(
+                rid=req.rid, tokens=1, latency_ms=prefill_ms,
+                prefill_ms=prefill_ms, ttft_ms=ttft_ms)
+            self._state.done = self._state.done.at[slot].set(True)
+            self._release(slot, blocks)
+        else:
+            if blocks is not None:
+                self._slot_blocks[slot] = blocks
+            self._active[slot] = _Slot(req, t_enq, prefill_ms, first,
+                                       ttft_ms)
+
+    def _release(self, slot, blocks) -> None:
+        """Give a retired slot's blocks back and redirect its table
+        rows to trash BEFORE the blocks can be reallocated — the done
+        slot keeps issuing keep-alive writes at its frozen position."""
+        if self._pool is None or blocks is None:
+            return
+        self._state.caches = pk.retire_tables(self._state.caches, slot)
+        self._pool.release(blocks)
+
+    def _insert(self, slot: int, req: Request, slot_raws, last,
+                blocks) -> int:
+        """Splice one prefilled batch-1 cache into the pool slot.
         Returns its first generated token (the one per-request host
         read — per REQUEST, not per token)."""
-        L = req.prompt_ids.size
-        bucket = bucket_for(L, self.max_length)
-        ids, lens = _pad_prompts([req.prompt_ids], bucket)
-        slot_caches = self.model.gen_cache(1, self.max_length)
-        last, slot_raws, _ = self._prefill(slot_caches, ids, lens)
         sub = self._next_key()
         first = sampling.sample(
             last, sub,
@@ -357,14 +690,22 @@ class InferenceEngine:
             from ..observability import ledger as _ledger
 
             donate = (0,) if jax.default_backend() != "cpu" else ()
+            fn = _paged_insert_fn if self._pool is not None \
+                else _insert_fn
             self._insert_jitted = _ledger.instrument(
-                jax.jit(_insert_fn, donate_argnums=donate,
-                        static_argnums=()),
+                jax.jit(fn, donate_argnums=donate, static_argnums=()),
                 label="CacheInsert", donate=donate)
         st = self._state
+        L = req.prompt_ids.size
+        extra = ()
+        if self._pool is not None:
+            row = np.zeros((self._nmax,), np.int32)
+            row[: len(blocks)] = blocks  # trash-padded past allocation
+            extra = (jnp.asarray(row),)
         (caches, pos, tok, done, temp, top_k, top_p, eos, budget) = \
             self._insert_jitted(
                 st.caches, slot_raws, jnp.asarray(slot, jnp.int32),
+                *extra,
                 st.pos, st.tok, st.done, st.temperature, st.top_k,
                 st.top_p, st.eos, st.budget,
                 jnp.asarray(L, jnp.int32),
@@ -396,11 +737,14 @@ class InferenceEngine:
             st = self._active.pop(slot)
             total_ms = (time.perf_counter() - st.t_start) * 1e3
             results[st.req.rid] = GeneratedResult(
-                st.req.rid, st.tokens, st.prefill_ms, total_ms)
+                st.req.rid, st.tokens, st.prefill_ms, total_ms,
+                st.ttft_ms)
             self._metrics.request_done(
                 rid=st.req.rid, tokens=len(st.tokens),
-                latency_ms=total_ms, prefill_ms=st.prefill_ms)
+                latency_ms=total_ms, prefill_ms=st.prefill_ms,
+                ttft_ms=st.ttft_ms)
             self._state.done = self._state.done.at[slot].set(True)
+            self._release(slot, self._slot_blocks.pop(slot, None))
 
 
 def _insert_fn(cache_raws, slot_raws, slot, pos, tok, done, temp, top_k,
@@ -415,6 +759,35 @@ def _insert_fn(cache_raws, slot_raws, slot, pos, tok, done, temp, top_k,
             batch_leaf, slot_leaf.astype(batch_leaf.dtype), slot, axis=0)
 
     caches = jax.tree_util.tree_map(splice, cache_raws, slot_raws)
+    return (
+        caches,
+        pos.at[slot].set(length),
+        tok.at[slot].set(first_tok),
+        done.at[slot].set(False),
+        temp.at[slot].set(t_val),
+        top_k.at[slot].set(k_val),
+        top_p.at[slot].set(p_val),
+        eos.at[slot].set(e_val),
+        budget.at[slot].set(b_val),
+    )
+
+
+def _paged_insert_fn(cache_raws, slot_raws, slot, table_row, pos, tok,
+                     done, temp, top_k, top_p, eos, budget, length,
+                     first_tok, t_val, k_val, p_val, e_val, b_val):
+    """The paged CacheInsert: scatter the CONTIGUOUS batch-1 prefilled
+    cache into the pool blocks named by ``table_row`` and point the
+    slot's table at them (`paged_kv.paged_splice` — one scatter per
+    leaf). ``slot`` AND ``table_row`` ride as traced values, so every
+    slot and every allocation shape shares ONE compile; the state-vector
+    resets are identical to the contiguous form."""
+    def splice(paged_leaf, slot_subtree):
+        return pk.paged_splice(paged_leaf, slot_subtree, slot,
+                               table_row)
+
+    caches = jax.tree_util.tree_map(
+        splice, cache_raws, slot_raws,
+        is_leaf=lambda v: isinstance(v, pk.PagedKV))
     return (
         caches,
         pos.at[slot].set(length),

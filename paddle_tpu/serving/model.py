@@ -67,11 +67,14 @@ class TransformerLM(nn.Layer):
             new_caches.append(nc)
         return self.head(self.ln_f(h)), new_caches
 
-    def gen_cache(self, batch_size, max_length, dtype=None):
+    def gen_cache(self, batch_size, max_length, dtype=None,
+                  block_size=None, pool_blocks=None):
         if int(max_length) > self.max_position:
             raise ValueError(
                 f"cache capacity {max_length} exceeds max_position="
                 f"{self.max_position} (the position table)"
             )
-        return [blk.gen_cache(batch_size, max_length, dtype)
+        return [blk.gen_cache(batch_size, max_length, dtype,
+                              block_size=block_size,
+                              pool_blocks=pool_blocks)
                 for blk in self.blocks]

@@ -1,0 +1,531 @@
+"""Multi-host SLO-aware request router (ISSUE 13 tentpole d).
+
+The layer above :class:`serving.InferenceEngine`: one engine serves one
+host's chips; "millions of users" need a front end that spreads
+requests over MANY hosts, refuses work it cannot serve inside the SLO
+(admission control beats collapse), and notices a degraded host from
+its own telemetry. This module closes the loop the observability plane
+opened in rounds 9/10: the `decode_metrics` bus rows every engine
+already emits on its readback cadence (tokens/sec, inflight slots,
+queue depth — and, round 13, TTFT and block-pool occupancy) ARE the
+router's scheduling signal. Nothing new is measured; the router reads
+what serving already publishes.
+
+Pieces:
+
+- :class:`LocalHost` — an in-process engine endpoint (single-host
+  deployments and the fast test matrix);
+- :class:`FileHost` — a mailbox endpoint to a host WORKER process
+  (``inbox/*.json`` requests in, ``outbox/*.json`` results back,
+  stats read from the worker's per-rank telemetry stream) — the
+  multi-process dryrun transport; production would swap a real RPC in
+  behind the same three methods;
+- :class:`Router` — per-host queues + admission control
+  (``PADDLE_SERVE_ADMIT_QUEUE`` / ``PADDLE_SERVE_ADMIT_TTFT_MS``) +
+  SLO-aware host choice (predicted wait from the freshest
+  ``decode_metrics`` row), `router_metrics` telemetry (queue depth per
+  host — tools/timeline.py renders it as a counter track), and the
+  ``serve`` fault-injection site (``serve:burst:nth[:n]``,
+  ``serve:slow_host:nth[:rank]``) so the admission and degradation
+  paths are testable from the fault matrix;
+- :func:`worker_main` — the jax-free simulated host worker the
+  launcher-driven dryrun spawns (loads the bus standalone, same
+  pattern as the observability dryrun children): polls its inbox,
+  "decodes" at a configured rate, emits REAL `decode_metrics` /
+  `decode_request` rows, honors ``serve:slow_host`` degradation.
+
+Run as a script (what `distributed.launch` spawns)::
+
+    python paddle_tpu/serving/router.py <repo_root> <mailbox_base> \
+        [rate_tokens_per_sec] [poll_s]
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["HostStats", "LocalHost", "FileHost", "Router",
+           "admit_queue_default", "admit_ttft_ms_default", "worker_main"]
+
+_ADMIT_QUEUE_ENV = "PADDLE_SERVE_ADMIT_QUEUE"
+_ADMIT_TTFT_ENV = "PADDLE_SERVE_ADMIT_TTFT_MS"
+
+
+def admit_queue_default() -> int:
+    """``PADDLE_SERVE_ADMIT_QUEUE`` — max queued requests per host
+    before the router refuses new work (default 64)."""
+    try:
+        return max(int(os.environ.get(_ADMIT_QUEUE_ENV, "64")), 1)
+    except ValueError:
+        return 64
+
+
+def admit_ttft_ms_default() -> float:
+    """``PADDLE_SERVE_ADMIT_TTFT_MS`` — reject when every host's
+    predicted time-to-first-token exceeds this bound (0 = queue-depth
+    admission only, the default)."""
+    try:
+        return max(float(os.environ.get(_ADMIT_TTFT_ENV, "0")), 0.0)
+    except ValueError:
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# standalone-safe module loading (the worker runs WITHOUT the package:
+# no jax import on the serving control plane — same discipline as the
+# observability dryrun children and tools/timeline.py)
+# ---------------------------------------------------------------------------
+
+
+def _load_rel(modname: str, *parts: str):
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(here), *parts)
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bus():
+    try:
+        from ..observability import bus
+
+        return bus
+    except ImportError:
+        return _load_rel("_pdtpu_obs_bus", "observability", "bus.py")
+
+
+def _fault():
+    try:
+        from ..utils import fault_injection
+
+        return fault_injection
+    except ImportError:
+        return _load_rel("_pdtpu_fault", "utils", "fault_injection.py")
+
+
+# ---------------------------------------------------------------------------
+# host endpoints
+# ---------------------------------------------------------------------------
+
+
+class HostStats:
+    """One host's freshest serving signal, as the router sees it."""
+
+    __slots__ = ("queue_depth", "inflight", "tokens_per_sec", "ttft_ms",
+                 "age_s", "submitted")
+
+    def __init__(self, queue_depth=0, inflight=0, tokens_per_sec=None,
+                 ttft_ms=None, age_s=None, submitted=0):
+        self.queue_depth = queue_depth
+        self.inflight = inflight
+        self.tokens_per_sec = tokens_per_sec
+        self.ttft_ms = ttft_ms
+        self.age_s = age_s
+        self.submitted = submitted
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+def _req_fields(req) -> dict:
+    """Engine Request / plain dict -> the wire fields a host needs."""
+    if isinstance(req, dict):
+        d = dict(req)
+        d.setdefault("max_new_tokens", 16)
+        return d
+    return {
+        "rid": req.rid,
+        "prompt_ids": [int(t) for t in req.prompt_ids],
+        "max_new_tokens": req.max_new_tokens,
+        "temperature": req.temperature,
+        "top_k": req.top_k,
+        "top_p": req.top_p,
+        "eos_id": req.eos_id,
+    }
+
+
+class LocalHost:
+    """In-process endpoint over one :class:`InferenceEngine`."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._submitted = 0
+
+    def submit(self, req) -> None:
+        from .engine import Request
+
+        if isinstance(req, dict):
+            d = _req_fields(req)
+            req = Request(
+                d.get("prompt_ids", [0]),
+                max_new_tokens=d["max_new_tokens"],
+                temperature=d.get("temperature", 0.0),
+                top_k=d.get("top_k", 0), top_p=d.get("top_p", 1.0),
+                eos_id=(None if d.get("eos_id", -1) in (-1, None)
+                        else d["eos_id"]),
+                rid=d.get("rid"))
+        self.engine.submit(req)
+        self._submitted += 1
+
+    def stats(self) -> HostStats:
+        # live engine counters — fresher than any bus row could be
+        return HostStats(
+            queue_depth=self.engine.queue_depth(),
+            inflight=self.engine.inflight(),
+            age_s=0.0, submitted=self._submitted)
+
+    def drain(self) -> Dict:
+        return self.engine.run()
+
+
+class FileHost:
+    """Mailbox endpoint to a worker process: requests as one JSON file
+    each under ``<dir>/inbox``, results back under ``<dir>/outbox``,
+    stats from the worker's ``telemetry.rank{N}.jsonl`` stream (the
+    SAME rows the engine emits — the router schedules on telemetry, not
+    on a private side channel)."""
+
+    def __init__(self, host_dir: str, rank: int,
+                 obs_dir: Optional[str] = None):
+        self.host_dir = host_dir
+        self.rank = int(rank)
+        self.obs_dir = obs_dir or host_dir
+        self.inbox = os.path.join(host_dir, "inbox")
+        self.outbox = os.path.join(host_dir, "outbox")
+        os.makedirs(self.inbox, exist_ok=True)
+        os.makedirs(self.outbox, exist_ok=True)
+        self._submitted = 0
+        # incremental stream tail: the router polls stats per submit
+        # AND per tick, and the stream grows one row per worker poll —
+        # re-parsing from byte 0 every time would be quadratic over a
+        # long-running router, so only freshly appended COMPLETE lines
+        # are read and the last decode_metrics row is cached
+        self._tail_offset = 0
+        self._last_metrics: Optional[dict] = None
+
+    def submit(self, req) -> None:
+        d = _req_fields(req)
+        self._submitted += 1
+        path = os.path.join(
+            self.inbox, f"req_{self._submitted:06d}_{d.get('rid')}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+        os.replace(tmp, path)  # atomic: the worker never sees a torn file
+
+    def _stream_path(self) -> str:
+        return os.path.join(self.obs_dir,
+                            f"telemetry.rank{self.rank}.jsonl")
+
+    def _tail_new_rows(self, path: str) -> None:
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._tail_offset)
+                chunk = f.read()
+        except OSError:
+            return
+        end = chunk.rfind(b"\n")  # a torn trailing line stays unread
+        if end < 0:
+            return
+        self._tail_offset += end + 1
+        for line in chunk[: end + 1].splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and \
+                    rec.get("kind") == "decode_metrics":
+                self._last_metrics = rec
+
+    def stats(self) -> HostStats:
+        path = self._stream_path()
+        if os.path.exists(path):
+            self._tail_new_rows(path)
+        last = self._last_metrics
+        if last is None:
+            return HostStats(age_s=None, submitted=self._submitted)
+        p = last.get("payload") or {}
+        t = last.get("time")
+        return HostStats(
+            queue_depth=int(p.get("queue_depth", 0)),
+            inflight=int(p.get("inflight_slots", 0)),
+            tokens_per_sec=p.get("tokens_per_sec"),
+            ttft_ms=p.get("ttft_ms"),
+            age_s=(time.time() - t) if isinstance(t, (int, float))
+            else None,
+            submitted=self._submitted)
+
+    def results(self) -> List[dict]:
+        out = []
+        for name in sorted(os.listdir(self.outbox)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.outbox, name)
+            try:
+                with open(path) as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+            os.remove(path)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+
+class Router:
+    """Admission-controlled, SLO-aware request spreading over hosts.
+
+    Scheduling: pick the host minimizing PREDICTED WAIT — pending work
+    (queued + inflight requests, times the router's average new-token
+    estimate) over the host's published tokens/sec; hosts that have
+    never published fall back to queue-depth ordering. A host whose
+    queue is at ``admit_queue``, and (when ``admit_ttft_ms`` > 0) a
+    host whose predicted wait exceeds the TTFT SLO, is NOT eligible;
+    when no host is eligible the request is REJECTED (returned None,
+    counted) — under a burst the router sheds load instead of building
+    an unbounded queue whose every entry misses the SLO. In-router
+    bookkeeping (`_pending_guess`) bridges the telemetry lag between
+    submits inside one tick: a submit counts against its host until a
+    fresher bus row arrives.
+
+    ``serve`` fault-injection events are drained on every
+    :meth:`tick`: a ``burst`` submits ``n`` synthetic probe requests
+    through the normal admission path (the admission matrix's prey);
+    ``slow_host`` is consumed by the WORKER side (degradation shows up
+    here through the telemetry it causes, not through a flag).
+    """
+
+    def __init__(self, hosts, *, admit_queue=None, admit_ttft_ms=None,
+                 avg_new_tokens=16, burst_prompt_len=4,
+                 burst_new_tokens=None):
+        self.hosts = list(hosts)
+        if not self.hosts:
+            raise ValueError("Router needs at least one host")
+        self.admit_queue = (admit_queue_default()
+                            if admit_queue is None else int(admit_queue))
+        self.admit_ttft_ms = (admit_ttft_ms_default()
+                              if admit_ttft_ms is None
+                              else float(admit_ttft_ms))
+        self.avg_new_tokens = max(int(avg_new_tokens), 1)
+        self.burst_prompt_len = int(burst_prompt_len)
+        self.burst_new_tokens = (burst_new_tokens
+                                 if burst_new_tokens is not None
+                                 else self.avg_new_tokens)
+        self.admitted = 0
+        self.rejected = 0
+        self._ticks = 0
+        self._burst_rid = 0
+        # submits this router made that the host telemetry cannot have
+        # absorbed yet; decays when a fresher stats row shows up
+        self._pending_guess = [0] * len(self.hosts)
+        self._last_submit_t = [0.0] * len(self.hosts)
+
+    # -- scheduling --------------------------------------------------------
+    def _predicted_wait_ms(self, st: HostStats, extra: int) -> float:
+        pending = st.queue_depth + st.inflight + extra
+        if st.tokens_per_sec and st.tokens_per_sec > 0:
+            return (pending * self.avg_new_tokens /
+                    st.tokens_per_sec) * 1e3
+        # no throughput signal yet: rank by pending work alone (1ms per
+        # pending request keeps the units comparable)
+        return float(pending)
+
+    def _eligible(self, idx: int, st: HostStats) -> bool:
+        depth = st.queue_depth + self._pending_guess[idx]
+        if depth >= self.admit_queue:
+            return False
+        if self.admit_ttft_ms > 0 and self._predicted_wait_ms(
+                st, self._pending_guess[idx]) > self.admit_ttft_ms:
+            return False
+        return True
+
+    def _refresh_guess(self, idx: int, st: HostStats) -> None:
+        # a stats row OBSERVED after our last submit already counts
+        # that submit in its queue depth — stop double counting
+        if st.age_s is not None and (
+                time.time() - st.age_s) >= self._last_submit_t[idx]:
+            self._pending_guess[idx] = 0
+
+    def submit(self, req) -> Optional[int]:
+        """Route one request; returns the host index, or None when
+        admission control rejected it (all hosts over limit)."""
+        stats = []
+        for i, h in enumerate(self.hosts):
+            st = h.stats()
+            self._refresh_guess(i, st)
+            stats.append(st)
+        candidates = [i for i, st in enumerate(stats)
+                      if self._eligible(i, st)]
+        if not candidates:
+            self.rejected += 1
+            self._emit_admit(None, stats)
+            return None
+        best = min(candidates, key=lambda i: self._predicted_wait_ms(
+            stats[i], self._pending_guess[i]))
+        self.hosts[best].submit(req)
+        self._pending_guess[best] += 1
+        self._last_submit_t[best] = time.time()
+        self.admitted += 1
+        return best
+
+    # -- control loop ------------------------------------------------------
+    def tick(self) -> List[Optional[int]]:
+        """One scheduling tick: drain armed ``serve`` fault events
+        (each ``burst`` submits its synthetic requests through normal
+        admission) and publish `router_metrics`. Returns the burst
+        routing outcomes (host index or None per synthetic request)."""
+        fi = _fault()
+        self._ticks += 1
+        outcomes: List[Optional[int]] = []
+        for action, arg in fi.consume_serve_events():
+            if action != "burst":
+                continue  # slow_host is the worker's event
+            n = int(arg) if arg else 8
+            for _ in range(n):
+                self._burst_rid += 1
+                outcomes.append(self.submit({
+                    "rid": f"burst{self._burst_rid}",
+                    "prompt_ids": list(range(self.burst_prompt_len)),
+                    "max_new_tokens": self.burst_new_tokens,
+                }))
+        self._emit_metrics()
+        return outcomes
+
+    # -- telemetry ---------------------------------------------------------
+    def _emit_metrics(self) -> None:
+        bus = _bus()
+        if not bus.enabled():
+            return
+        payload = {
+            "hosts": len(self.hosts),
+            "admitted": self.admitted,
+            "rejected": self.rejected,
+        }
+        total = 0
+        for i, h in enumerate(self.hosts):
+            st = h.stats()
+            depth = st.queue_depth + self._pending_guess[i]
+            payload[f"host{i}_queue_depth"] = depth
+            total += depth
+        payload["queue_depth_total"] = total
+        bus.emit("router_metrics", payload, step=self._ticks)
+
+    def _emit_admit(self, host: Optional[int], stats) -> None:
+        bus = _bus()
+        if not bus.enabled():
+            return
+        bus.emit("router_admit", {
+            "host": host,
+            "outcome": "rejected" if host is None else "admitted",
+            "depths": [s.queue_depth for s in stats],
+            "admit_queue": self.admit_queue,
+            "admit_ttft_ms": self.admit_ttft_ms,
+        }, step=self._ticks)
+
+
+# ---------------------------------------------------------------------------
+# the dryrun host worker (jax-free: the serving CONTROL plane must not
+# pay an interpreter-plus-jax startup per host in the launcher matrix)
+# ---------------------------------------------------------------------------
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    """Simulated host worker for the launcher-driven multi-process
+    dryrun: polls ``<base>/host{rank}/inbox``, queues requests, decodes
+    them at ``rate`` tokens/sec of simulated work, and emits the SAME
+    telemetry rows a real engine does — ``decode_metrics`` per poll
+    (tokens/sec, queue depth, inflight, TTFT) and ``decode_request``
+    per completion — into its launcher-provisioned per-rank bus stream.
+    A ``serve:slow_host:nth[:rank]`` fault rule matching this rank
+    multiplies its simulated work 20x: the degradation the router must
+    route around, visible ONLY through telemetry. Exits when
+    ``<base>/stop`` appears and the inbox is drained."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 2:
+        print("usage: router.py <repo_root> <mailbox_base> "
+              "[rate] [poll_s]", file=sys.stderr)
+        return 2
+    base = argv[1]
+    rate = float(argv[2]) if len(argv) > 2 else 2000.0
+    poll_s = float(argv[3]) if len(argv) > 3 else 0.02
+    bus = _bus()
+    fi = _fault()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    host_dir = os.path.join(base, f"host{rank}")
+    inbox = os.path.join(host_dir, "inbox")
+    outbox = os.path.join(host_dir, "outbox")
+    os.makedirs(inbox, exist_ok=True)
+    os.makedirs(outbox, exist_ok=True)
+    stop_path = os.path.join(base, "stop")
+    queue: List[dict] = []
+    seen = set()
+    slow = 1.0
+    windows = 0
+    while True:
+        for action, arg in fi.consume_serve_events():
+            if action == "slow_host" and (arg or 0) == rank:
+                slow = 20.0
+        for name in sorted(os.listdir(inbox)):
+            if not name.endswith(".json") or name in seen:
+                continue
+            seen.add(name)
+            try:
+                with open(os.path.join(inbox, name)) as f:
+                    req = json.load(f)
+            except (OSError, ValueError):
+                continue
+            req["t_arrive"] = time.time()
+            queue.append(req)
+        served_tokens = 0
+        t0 = time.perf_counter()
+        if queue:
+            req = queue.pop(0)
+            n = int(req.get("max_new_tokens", 16))
+            # simulated decode: n tokens at rate tokens/sec (slowed
+            # when degraded) — wall clock the telemetry prices
+            time.sleep(n / rate * slow)
+            served_tokens = n
+            ttft_ms = (time.time() - req["t_arrive"]) * 1e3
+            bus.emit("decode_request", {
+                "rid": req.get("rid"), "tokens": n,
+                "latency_ms": round(ttft_ms, 3),
+                "prefill_ms": 0.0,
+                "ttft_ms": round(ttft_ms, 3),
+                "ms_per_token": round(ttft_ms / max(n, 1), 3),
+            })
+            out = {"rid": req.get("rid"), "tokens": n, "rank": rank,
+                   "ttft_ms": round(ttft_ms, 3)}
+            path = os.path.join(outbox, f"done_{req.get('rid')}.json")
+            with open(path + ".tmp", "w") as f:
+                json.dump(out, f)
+            os.replace(path + ".tmp", path)
+        windows += 1
+        dt = time.perf_counter() - t0
+        payload = {
+            "steps": 1,
+            "tokens": served_tokens,
+            "inflight_slots": 1 if served_tokens else 0,
+            "queue_depth": len(queue),
+        }
+        if served_tokens and dt > 0:
+            payload["tokens_per_sec"] = round(served_tokens / dt, 1)
+        bus.emit("decode_metrics", payload, step=windows)
+        if not queue and os.path.exists(stop_path):
+            leftover = [n for n in os.listdir(inbox)
+                        if n.endswith(".json") and n not in seen]
+            if not leftover:
+                return 0
+        if not served_tokens:
+            time.sleep(poll_s)
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

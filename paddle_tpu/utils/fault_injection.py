@@ -13,7 +13,9 @@
   collective timeout watchdog's prey), ``grad`` (once per compiled
   TrainStep call, host side — the numerical-guard matrix's prey),
   ``rank`` (once per elastic step-boundary check,
-  distributed/resharding.py — the reshard matrix's prey).
+  distributed/resharding.py — the reshard matrix's prey), ``serve``
+  (once per serving-router scheduling tick / host-worker poll,
+  serving/router.py — the admission-control matrix's prey).
 - ``action`` one of ``fail`` (raise InjectedFault, an IOError),
   ``hang`` (sleep ``arg`` seconds, default 3600 — the watchdog's prey),
   ``kill`` (``os._exit(arg)``, default 17 — a hard preemption),
@@ -32,7 +34,13 @@
   reshard path consumes at its next step boundary — ``arg`` selects the
   logical rank, default the last rank, so
   ``PADDLE_FAULT_SPEC="rank:depart:3:1"`` loses rank 1 at step 3 and
-  ``rank:depart:3:1,rank:return:6:1`` brings it back at step 6).
+  ``rank:depart:3:1,rank:return:6:1`` brings it back at step 6), or
+  ``burst`` / ``slow_host`` (``serve`` only: arm a serving-tier event
+  the router/worker drains at its next tick — ``serve:burst:2:8``
+  injects an 8-request burst at the router's 2nd tick (admission
+  control's prey), ``serve:slow_host:1:0`` degrades host rank 0 from
+  its 1st poll (the SLO scheduler routes away from it); ``arg``
+  defaults: burst 8 requests, slow_host rank 0).
 - ``nth``    1-based per-process call count at which the rule fires
   (each call to a site increments that site's counter), so a relaunched
   attempt that resumes later in training naturally skips the fault.
@@ -55,11 +63,11 @@ from typing import Dict, List, Optional
 
 __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
            "has_site", "consume_grad_action", "consume_rank_events",
-           "GRAD_POISONS", "reset"]
+           "consume_serve_events", "GRAD_POISONS", "reset"]
 
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
 _ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
-            "spike", "depart", "return")
+            "spike", "depart", "return", "burst", "slow_host")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
 # grad poison only makes sense where a compiled step consumes the flag
@@ -69,6 +77,10 @@ _GRAD_SITES = ("grad",)
 # path polls for notices (resharding.py step-boundary check)
 _RANK_ACTIONS = ("depart", "return")
 _RANK_SITES = ("rank",)
+# serving-tier events only make sense where the router/worker polls
+# for them (serving/router.py scheduling tick / host-worker loop)
+_SERVE_ACTIONS = ("burst", "slow_host")
+_SERVE_SITES = ("serve",)
 # sites that pass a file path to fault_point (the only places a corrupt
 # rule can bite) — a corrupt rule elsewhere would be a silent no-op, so
 # the parser rejects it loudly instead
@@ -99,6 +111,7 @@ class FaultInjector:
         self._counts: Dict[str, int] = {}
         self.flags: set = set()  # armed markers (e.g. "desync")
         self.rank_events: List = []  # armed (action, rank|None), ordered
+        self.serve_events: List = []  # armed (action, arg|None), ordered
         for item in filter(None, (s.strip() for s in spec.split(","))):
             parts = item.split(":")
             if len(parts) < 3:
@@ -132,6 +145,11 @@ class FaultInjector:
                 raise ValueError(
                     f"{action} rule targets un-instrumented site {site!r} "
                     f"(rank-event sites: {_RANK_SITES})"
+                )
+            if action in _SERVE_ACTIONS and site not in _SERVE_SITES:
+                raise ValueError(
+                    f"{action} rule targets un-instrumented site {site!r} "
+                    f"(serving-event sites: {_SERVE_SITES})"
                 )
             arg = parts[3] if len(parts) > 3 else None
             self._rules.append(_Rule(site, action, nth, arg))
@@ -177,6 +195,13 @@ class FaultInjector:
                   f"{'' if rank is None else f':{rank}'} at {tag}",
                   file=sys.stderr, flush=True)
             self.rank_events.append((r.action, rank))
+            return
+        if r.action in _SERVE_ACTIONS:
+            arg = int(r.arg) if r.arg else None
+            print(f"fault_injection: arming serve:{r.action}"
+                  f"{'' if arg is None else f':{arg}'} at {tag}",
+                  file=sys.stderr, flush=True)
+            self.serve_events.append((r.action, arg))
             return
         if r.action == "desync":
             target = int(r.arg) if r.arg else 0
@@ -244,6 +269,19 @@ def consume_rank_events() -> List:
     if inj is None or not inj.rank_events:
         return []
     out, inj.rank_events = inj.rank_events, []
+    return out
+
+
+def consume_serve_events() -> List:
+    """Fire the ``serve`` site for this router tick / worker poll and
+    drain any armed serving events; returns an ordered list of
+    ``(action, arg)`` pairs (``arg`` is None when the rule named none —
+    the consumer picks its default: burst size 8, host rank 0)."""
+    fault_point("serve")
+    inj = _active
+    if inj is None or not inj.serve_events:
+        return []
+    out, inj.serve_events = inj.serve_events, []
     return out
 
 

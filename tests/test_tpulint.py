@@ -319,6 +319,80 @@ class TestDecodeStepContract:
         assert not [f for f in findings if not f.suppressed]
 
 
+class TestSpeculativePagedContract:
+    """ISSUE 13 satellite: `SpeculativeDecodeStep._step_fn` and the
+    paged-attention path ride the SAME astutil `*Step` compiled-by-
+    contract suffix list — no new rule needed. The fixture pair encodes
+    the paged failure mode: a PER-BLOCK HOST LOOP over the block table
+    (np.asarray on the table, python iteration over traced blocks)
+    flags; the shipped ONE-GATHER form (`pool[table]`) is quiet."""
+
+    # the tempting-but-wrong paged decode: walk the block table on the
+    # host, one device read per block per token
+    PRE_FIX = """
+        import jax
+        import numpy as np
+
+        class SpeculativeDecodeStep:
+            def _step_fn(self, p_raws, pool, table, pos, tok):
+                rows = []
+                for b in np.asarray(table[0]):   # host read per block
+                    rows.append(pool[int(b)])
+                k = jax.numpy.stack(rows)
+                logits = (k * tok).sum(-1)
+                return logits.argmax(-1), pool, pos + 1
+    """
+    # the shipped shape: the table gather stays in-graph — one scatter
+    # to write, one gather to read, nothing touches the host
+    FIXED = """
+        import jax
+        import jax.numpy as jnp
+
+        class SpeculativeDecodeStep:
+            def _step_fn(self, p_raws, pool, table, pos, tok):
+                view = pool[table]               # block-table gather
+                k = view.reshape(view.shape[0], -1, view.shape[-1])
+                logits = (k * tok[:, None, None]).sum(-1)
+                drafts = jnp.argmax(logits, -1)
+                return drafts, pool, pos + 1
+    """
+
+    def test_step_fn_compiled_by_contract(self):
+        """`SpeculativeDecodeStep` matches the existing `*Step` suffix
+        list — the jit call living in the base class changes nothing."""
+        import ast
+
+        from tools.tpulint import astutil
+
+        graph = astutil.ModuleGraph(
+            ast.parse(textwrap.dedent(self.PRE_FIX)))
+        assert ("SpeculativeDecodeStep", "_step_fn") in graph.compiled
+
+    def test_per_block_host_loop_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX},
+                      rule="host-sync-in-step")
+        msgs = "\n".join(f.message for f in names(fs,
+                                                  "host-sync-in-step"))
+        assert "np.asarray" in msgs or "int()" in msgs, msgs
+
+    def test_block_table_gather_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED},
+                      rule="host-sync-in-step")
+        assert not names(fs, "host-sync-in-step")
+
+    def test_real_tier_modules_quiet(self):
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "serving",
+                          "paged_kv.py"),
+             os.path.join(REPO, "paddle_tpu", "serving", "router.py"),
+             os.path.join(REPO, "paddle_tpu", "jit",
+                          "decode_step.py")],
+            root=REPO,
+        )
+        assert not errors
+        assert not [f for f in findings if not f.suppressed]
+
+
 class TestDonationAlias:
     # PR-5 pre-fix: the guard carry donated alongside params/opt state
     PRE_FIX_CARRY = """

@@ -84,6 +84,10 @@ def read_flight_dumps(obs_dir: str) -> Dict[int, List[dict]]:
 _COUNTERS = ("loss", "step_ms", "tokens_per_sec", "examples_per_sec",
              "gnorm")
 
+#: counter tracks extracted from decode_metrics payloads (ISSUE 13)
+_DECODE_COUNTERS = ("tokens_per_sec", "queue_depth", "inflight_slots",
+                    "ttft_ms", "blocks_in_use", "block_occupancy")
+
 
 def chrome_trace(streams: Dict[int, List[dict]],
                  dumps: Dict[int, List[dict]]) -> dict:
@@ -122,6 +126,29 @@ def chrome_trace(streams: Dict[int, List[dict]],
                     events.append({"ph": "C", "name": "step_metrics",
                                    "pid": rank, "ts": us(t),
                                    "args": args})
+                continue
+            if kind == "decode_metrics":
+                # serving readback-window gauges (ISSUE 13): decode
+                # throughput, engine queue/inflight, TTFT, paged
+                # block-pool occupancy — one counter track per rank
+                args = {k: payload[k] for k in _DECODE_COUNTERS
+                        if isinstance(payload.get(k), (int, float))}
+                if args:
+                    events.append({"ph": "C", "name": "decode_metrics",
+                                   "pid": rank, "ts": us(t),
+                                   "args": args})
+                continue
+            if kind == "router_metrics":
+                # the router's per-host queue depths as ONE counter
+                # track: the load-balance picture at a glance (a slow
+                # host's line climbs while the others stay flat)
+                args = {k: payload[k] for k in sorted(payload)
+                        if "queue_depth" in k
+                        and isinstance(payload[k], (int, float))}
+                if args:
+                    events.append({
+                        "ph": "C", "name": "router_queue_depth",
+                        "pid": rank, "ts": us(t), "args": args})
                 continue
             if kind == "recompile":
                 dur = float(payload.get("compile_wall_s", 0.0)) * 1e6
