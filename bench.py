@@ -570,6 +570,19 @@ def _bench_decode(batch_sizes=(1, 8, 64), prompt_len=128, new_tokens=64):
         lat[len(lat) // 2], 2)
     out["serve_gpt_medium_token_p99_ms"] = round(
         lat[min(int(len(lat) * 0.99), len(lat) - 1)], 2)
+    # the fleet monitor's online log-histogram digest over the SAME
+    # samples (ISSUE 14): report-only `_digest` keys pin the stored-vs-
+    # merged-counts agreement each round (never gated — the `_ms` pair
+    # above is the gated truth; the digest is bin-quantized)
+    from paddle_tpu.observability.monitor import LogHistogram
+
+    hist = LogHistogram()
+    for v in lat:
+        hist.add(v)
+    out["serve_gpt_medium_token_p50_ms_digest"] = round(
+        hist.percentile(50), 2)
+    out["serve_gpt_medium_token_p99_ms_digest"] = round(
+        hist.percentile(99), 2)
     return out
 
 

@@ -40,6 +40,13 @@ TPU-native additions over the reference watch loop:
   jax.distributed world on this runtime): the launcher treats it as
   shrink and leaves re-absorption to jobs that inject returns in
   process.
+- **embedded fleet monitor** (ISSUE 14): when an observability dir
+  exists (``--log_dir`` or ``PADDLE_OBS_DIR``), a monitor thread at
+  rank −1 tails every child's bus stream live — straggler ranking,
+  online percentile digests, incident correlation
+  (``observability/monitor.py``); kill attribution folds the active
+  incident chain in, and the final incident/snapshot rows are flushed
+  before the manager returns. ``PADDLE_MON=0`` disables.
 """
 from __future__ import annotations
 
@@ -62,6 +69,11 @@ try:  # telemetry bus (stdlib-pure too); tolerate exotic standalone loads
 except ImportError:  # pragma: no cover - package always carries it
     _obs_bus = None
 
+try:  # the live fleet monitor (ISSUE 14, stdlib-pure as well)
+    from ..observability import monitor as _obs_monitor
+except ImportError:  # pragma: no cover - package always carries it
+    _obs_monitor = None
+
 
 def _emit(kind: str, **payload) -> None:
     """Launcher-side bus event (rank -1). Lands only when the operator
@@ -82,6 +94,7 @@ _LOGDIR_ENV = "PADDLE_LOG_DIR"
 _RESHARD_MODE_ENV = "PADDLE_RESHARD_MODE"
 _RESHARD_QUORUM_ENV = "PADDLE_RESHARD_QUORUM"
 _RESHARD_NOTICE_ENV = "PADDLE_RESHARD_NOTICE_FILE"
+_MON_ENV = "PADDLE_MON"
 
 #: exit code the manager reports when the watchdog had to put a rank down
 HUNG_RC = 98
@@ -172,7 +185,8 @@ class ElasticManager:
                  poll_interval: float = 0.1,
                  coll_timeout: Optional[float] = None,
                  reshard: Optional[str] = None,
-                 reshard_quorum: Optional[float] = None):
+                 reshard_quorum: Optional[float] = None,
+                 monitor: Optional[bool] = None):
         def _envf(name, default):
             raw = os.environ.get(name, "")
             return float(raw) if raw.strip() else default
@@ -202,6 +216,17 @@ class ElasticManager:
                 f"reshard={self.reshard!r}: want off|shrink|shrink_expand")
         self.reshard_quorum = (reshard_quorum if reshard_quorum is not None
                                else _envf(_RESHARD_QUORUM_ENV, 0.5))
+        if monitor is None:
+            monitor = os.environ.get(_MON_ENV, "1").strip().lower() \
+                not in ("0", "false", "off")
+        self.monitor_enabled = bool(monitor)
+        #: the embedded live fleet monitor (rank −1, next to the
+        #: watchdog — ISSUE 14); started at first spawn when an obs
+        #: dir exists, so kill attribution can ask it for incident
+        #: context and the incident rows land before the manager exits
+        self.monitor = None
+        self._mon_thread: Optional[threading.Thread] = None
+        self._mon_stop = threading.Event()
         self._run_dir = None          # heartbeat-file home, made lazily
         self._procs: List[RankProc] = []
         self._retired: List[RankProc] = []  # resharded-away ranks
@@ -287,10 +312,52 @@ class ElasticManager:
                                         ev_path=ev, guard_ev_path=gev,
                                         notice_path=notice))
         self._spawn_total = len(self._procs)
+        self._start_monitor(obs_dir)
         _emit("elastic_spawn", attempt=attempt,
               ranks=[rp.rank for rp in self._procs],
               pids=[rp.proc.pid for rp in self._procs],
               obs_dir=obs_dir)
+
+    # -- embedded fleet monitor (ISSUE 14) --------------------------------
+    def _start_monitor(self, obs_dir: Optional[str]) -> None:
+        """Tail the children's bus streams from the launcher (rank −1,
+        next to the watchdog): straggler ranking, percentile digests,
+        and incident correlation DURING the run. One monitor for the
+        whole job — relaunch attempts append to the same streams."""
+        if (self.monitor is not None or not self.monitor_enabled
+                or not obs_dir or _obs_monitor is None):
+            return
+        try:
+            self.monitor = _obs_monitor.FleetMonitor(obs_dir, emit=True)
+        except Exception:  # noqa: BLE001 — monitoring never blocks spawn
+            self.monitor = None
+            return
+
+        def _loop():
+            while not self._mon_stop.wait(self.monitor.poll_s):
+                try:
+                    self.monitor.poll()
+                    self.monitor.maybe_snapshot()
+                except Exception:  # noqa: BLE001 — keep tailing
+                    pass
+
+        self._mon_thread = threading.Thread(
+            target=_loop, name="pdtpu-fleet-monitor", daemon=True)
+        self._mon_thread.start()
+
+    def _stop_monitor(self) -> None:
+        """Final drain BEFORE the manager returns: the open incident is
+        force-closed and written, so a failure in the job's last window
+        still gets its `incident` row."""
+        if self.monitor is None:
+            return
+        self._mon_stop.set()
+        if self._mon_thread is not None:
+            self._mon_thread.join(timeout=5.0)
+        try:
+            self.monitor.finalize()
+        except Exception:  # noqa: BLE001 — diagnostics stay best-effort
+            pass
 
     # -- teardown ---------------------------------------------------------
     def _kill_rank(self, rp: RankProc, why: str) -> None:
@@ -346,16 +413,35 @@ class ElasticManager:
         for path in (rp.ev_path, rp.guard_ev_path):
             if path:
                 events.extend(comm_monitor.read_events(path))
-        if not events:
+        # the embedded fleet monitor's incident context (ISSUE 14):
+        # sitting next to the watchdog means the kill attribution sees
+        # the cross-rank chain ("rank 3 recompile storm → dp collective
+        # stall") for free — drain its streams once so events from the
+        # dying rank's last seconds are in
+        incident = None
+        if self.monitor is not None:
+            try:
+                self.monitor.poll()
+                incident = self.monitor.incident_context(rp.rank)
+            except Exception:  # noqa: BLE001 — attribution best-effort
+                incident = None
+        if not events and not incident:
             return
-        ev = max(events, key=lambda e: e.get("time", 0.0))
-        what = (ev.get("detail") or ev.get("describe")
-                or ev.get("event", "?"))
+        if events:
+            ev = max(events, key=lambda e: e.get("time", 0.0))
+            cause = ev.get("event", "?")
+            what = (ev.get("detail") or ev.get("describe") or cause)
+        else:
+            cause, what = "incident", incident
         _emit("elastic_attribution", rank=rp.rank, why=why,
-              cause=ev.get("event", "?"), detail=what)
+              cause=cause, detail=what, incident=incident)
         print(
             f"paddle_tpu.elastic: rank {rp.rank} {why} attributed to "
-            f"{ev.get('event', '?')}: {what}",
+            f"{cause}: {what}"
+            # when there were no monitor events, `what` already IS the
+            # incident chain — don't print it twice
+            + (f" [incident: {incident}]" if incident and events
+               else ""),
             file=sys.stderr, flush=True)
 
     # -- reshard notice channel (quorum-holding rank loss) ----------------
@@ -542,6 +628,7 @@ class ElasticManager:
                     return PREEMPT_RC
                 attempt += 1
         finally:
+            self._stop_monitor()  # incident rows land BEFORE exit
             self._teardown("manager exit")
             for sig, h in old_handlers.items():
                 signal.signal(sig, h)

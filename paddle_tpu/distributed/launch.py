@@ -64,7 +64,8 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
            backend: str = None, node_rank: int = None,
            elastic_retries: int = 0, watchdog_timeout: float = None,
            log_dir: str = None, coll_timeout: float = None,
-           reshard: str = None, reshard_quorum: float = None) -> int:
+           reshard: str = None, reshard_quorum: float = None,
+           monitor: bool = None) -> int:
     """Spawn THIS node's ranks and babysit them (launch_collective :208).
 
     `node_rank` selects which host of `ips` this invocation is (default
@@ -105,6 +106,12 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
       round trip. `reshard_quorum` (or PADDLE_RESHARD_QUORUM, default
       0.5) is the minimum surviving fraction; below it the loss is a
       world loss and the relaunch path above applies.
+    - `monitor` (or PADDLE_MON, default on) embeds the live fleet
+      monitor (observability/monitor.py) in the manager whenever an
+      observability dir exists (`log_dir` or PADDLE_OBS_DIR): per-rank
+      stream tailing, straggler ranking, percentile digests, and
+      `incident` rows correlating co-occurring failures across ranks —
+      flushed before launch() returns.
     """
     if node_rank is None:
         node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
@@ -121,7 +128,7 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
         max_restarts=int(elastic_retries),
         watchdog_timeout=watchdog_timeout, log_dir=log_dir,
         coll_timeout=coll_timeout, reshard=reshard,
-        reshard_quorum=reshard_quorum,
+        reshard_quorum=reshard_quorum, monitor=monitor,
     )
     return mgr.run()
 
@@ -168,6 +175,11 @@ def main(argv=None):
                         help="minimum surviving fraction for an in-job "
                              "reshard (default: $PADDLE_RESHARD_QUORUM "
                              "or 0.5)")
+    parser.add_argument("--monitor", type=str, default=None,
+                        choices=("on", "off"),
+                        help="embed the live fleet monitor when an "
+                             "observability dir exists (default: "
+                             "$PADDLE_MON or on)")
     parser.add_argument("script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -178,6 +190,8 @@ def main(argv=None):
         watchdog_timeout=args.watchdog_timeout, log_dir=args.log_dir,
         coll_timeout=args.coll_timeout, reshard=args.reshard,
         reshard_quorum=args.reshard_quorum,
+        monitor=(None if args.monitor is None
+                 else args.monitor == "on"),
     )
     sys.exit(rc)
 

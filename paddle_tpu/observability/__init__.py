@@ -16,6 +16,11 @@ metrics, recompile ledger.
 - :mod:`.mfu` — achieved-FLOPs from ``lowered.cost_analysis()`` against
   a per-device peak table (the PERF.md attribution protocol,
   mechanized).
+- :mod:`.monitor` — the LIVE fleet monitor (ISSUE 14): incremental
+  per-rank stream cursors, straggler ranking, online percentile
+  digests, and the incident correlator; embedded in the elastic
+  launcher or standalone via
+  ``python -m paddle_tpu.observability.monitor``. Stdlib-pure.
 
 Capture-on-anomaly device tracing lives in :mod:`paddle_tpu.profiler`
 (it owns the ``jax.profiler`` surface); ``tools/timeline.py`` merges
@@ -23,10 +28,12 @@ the per-rank streams into a chrome trace + summary.
 """
 from __future__ import annotations
 
-from . import bus, ledger, metrics, mfu
-from .bus import current_step, emit, read_stream, set_step
+from . import bus, ledger, metrics, mfu, monitor
+from .bus import current_step, emit, emit_span, read_stream, set_step
+from .monitor import FleetMonitor
 
 __all__ = [
-    "bus", "metrics", "ledger", "mfu",
-    "emit", "set_step", "current_step", "read_stream",
+    "bus", "metrics", "ledger", "mfu", "monitor",
+    "emit", "emit_span", "set_step", "current_step", "read_stream",
+    "FleetMonitor",
 ]

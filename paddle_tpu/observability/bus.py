@@ -47,8 +47,8 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = [
-    "SCHEMA_VERSION", "enabled", "bus_path", "emit", "set_step",
-    "current_step", "read_stream", "rank_streams", "reset",
+    "SCHEMA_VERSION", "enabled", "bus_path", "emit", "emit_span",
+    "set_step", "current_step", "read_stream", "rank_streams", "reset",
 ]
 
 SCHEMA_VERSION = 1
@@ -103,6 +103,34 @@ def reset() -> None:
     _step = None
 
 
+def _mon_fault_action() -> Optional[str]:
+    """ISSUE 14 satellite: the ``mon`` fault-injection site — a
+    ``mon:drop:nth`` / ``mon:dup:nth`` rule drops or duplicates the
+    nth bus row this process writes, so the monitor's incremental
+    cursor and skew logic are testable under the standard spec
+    grammar. Resolved lazily and only when a spec is armed; the bus
+    stays stdlib-pure and standalone-loadable (the injector is looked
+    up in sys.modules when the package context is absent)."""
+    if not os.environ.get("PADDLE_FAULT_SPEC"):
+        return None
+    fi = None
+    try:
+        from ..utils import fault_injection as fi  # package context
+    except (ImportError, ValueError):
+        import sys as _sys
+
+        for name in ("fault_injection", "_pdtpu_fault"):
+            fi = _sys.modules.get(name)
+            if fi is not None:
+                break
+    if fi is None or not hasattr(fi, "consume_mon_action"):
+        return None
+    try:
+        return fi.consume_mon_action()
+    except Exception:  # noqa: BLE001 — diagnostics stay best-effort
+        return None
+
+
 def emit(kind: str, payload: Optional[Dict] = None, *,
          step: Optional[int] = None, rank: Optional[int] = None,
          legacy_env: Optional[str] = None) -> None:
@@ -125,6 +153,9 @@ def emit(kind: str, payload: Optional[Dict] = None, *,
     path = bus_path(rank=r)
     if not path:
         return
+    action = _mon_fault_action()
+    if action == "drop":
+        return  # the injected lost line — the monitor must survive it
     row = {
         "v": SCHEMA_VERSION,
         "kind": kind,
@@ -137,10 +168,30 @@ def emit(kind: str, payload: Optional[Dict] = None, *,
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        line = json.dumps(row, default=str) + "\n"
+        if action == "dup":
+            line += line  # the injected duplicated line
         with _lock, open(path, "a") as f:
-            f.write(json.dumps(row, default=str) + "\n")
+            f.write(line)
     except (OSError, TypeError, ValueError):
         pass
+
+
+def emit_span(name: str, trace_id, payload: Optional[Dict] = None, *,
+              step: Optional[int] = None,
+              rank: Optional[int] = None) -> None:
+    """One request-scoped ``span`` row (ISSUE 14): a named phase in a
+    request's life (``router_submit``, ``admit``, ``prefill``,
+    ``decode_window``, ``retire``), keyed by the ``trace_id`` that
+    Router.submit threads through the mailbox/engine path. Host-side
+    by contract, exactly like :func:`emit` — never call from a
+    compiled step body (tpulint's host-sync rule flags it). No-op
+    without a trace id so untraced paths stay row-free."""
+    if trace_id is None:
+        return
+    p = {"name": name, "trace_id": trace_id}
+    p.update(payload or {})
+    emit("span", p, step=step, rank=rank)
 
 
 def read_stream(path: str) -> List[dict]:

@@ -210,7 +210,8 @@ class DecodeMetricsSampler:
         bus.emit("decode_metrics", payload, step=self._windows)
 
     def request_done(self, *, rid, tokens: int, latency_ms: float,
-                     prefill_ms: float, ttft_ms=None) -> None:
+                     prefill_ms: float, ttft_ms=None,
+                     trace_id=None) -> None:
         if not self.enabled or not bus.enabled():
             return
         payload = {
@@ -222,4 +223,35 @@ class DecodeMetricsSampler:
         }
         if ttft_ms is not None:
             payload["ttft_ms"] = round(ttft_ms, 3)
+        if trace_id is not None:
+            # the request's terminal span: timeline/monitor stitch it to
+            # the router_submit/admit/prefill spans by this id
+            payload["trace_id"] = trace_id
         bus.emit("decode_request", payload, step=self._windows)
+
+    # -- request-scoped spans (ISSUE 14) -----------------------------------
+    def span(self, name: str, *, trace_id, rid=None, **extra) -> None:
+        """One engine-phase span row for a traced request (admission,
+        prefill, prefill_chunk, retire). Host-side values only — the
+        engine calls this at points where it already holds the numbers
+        (submit, activate, collect), so tracing adds zero device
+        reads. No-op for untraced requests (``trace_id`` None)."""
+        if not self.enabled or not bus.enabled() or trace_id is None:
+            return
+        payload = dict(extra)
+        if rid is not None:
+            payload["rid"] = rid
+        bus.emit_span(name, trace_id, payload, step=self._windows)
+
+    def window_span(self, trace_ids, *, steps: int) -> None:
+        """One row per readback window naming every traced inflight
+        request (the decode-window phase) — row count scales with
+        windows, not tokens or requests, the same cadence contract as
+        ``decode_metrics``."""
+        if not self.enabled or not bus.enabled():
+            return
+        ids = [t for t in trace_ids if t is not None]
+        if not ids:
+            return
+        bus.emit("span", {"name": "decode_window", "trace_ids": ids,
+                          "steps": int(steps)}, step=self._windows)

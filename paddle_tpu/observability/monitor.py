@@ -1,0 +1,937 @@
+"""Live fleet monitor — online cross-rank aggregation over the bus
+streams (ISSUE 14 tentpole).
+
+Rounds 9–13 made each rank observable (per-rank JSONL bus, MFU,
+recompile ledger, anomaly traces) and the router already *consumes* one
+bus row per host — but the system as a whole was only observable
+post-hoc, via ``tools/timeline.py`` after the job ended. Pod-scale
+failures are cross-rank phenomena (one straggling host, one storming
+compiler, one desynced collective — the MLPerf-on-TPU-pods experience,
+PAPERS.md) that no single per-rank stream can name while the job is
+still running. This module tails every rank's stream *during* the run
+and maintains the cross-rank state the per-rank emitters cannot:
+
+- **incremental cursors** (:class:`StreamCursor`): one byte offset per
+  rank file, torn-line safe (a rank killed mid-write never corrupts the
+  merge), truncation/rotation resets — the same machinery
+  ``serving.router.FileHost.stats()`` uses (it now imports it from
+  here);
+- **step-front + straggler ranking**: per-rank last-step and an EWMA of
+  ``step_ms`` (from ``step_metrics`` *and* ``decode_metrics`` rows, so
+  training and serving fleets both rank); each new sample recomputes a
+  leave-one-out z-score against the rest of the fleet, and a rank that
+  stays past ``PADDLE_MON_Z`` for ``PADDLE_MON_STRAGGLER_N``
+  consecutive windows is named a persistent straggler (a notable event
+  the incident correlator folds in);
+- **online percentile digests** (:class:`LogHistogram`): fixed-bin log
+  histograms for step_ms / per-token latency / TTFT — p50/p99 come
+  from merged bin counts, not stored samples, so per-rank digests merge
+  into fleet digests at snapshot time in O(bins), never O(events);
+- **incident correlator** (:class:`IncidentCorrelator`): co-occurring
+  notable events (guard trips, recompile storms, collective
+  timeouts/desyncs, reshard notices, watchdog kills, router admission
+  rejections, straggler namings) within ``PADDLE_MON_WINDOW`` seconds
+  fold into ONE ``incident`` bus row carrying the time-ordered causal
+  chain — "rank 3 recompile_storm → rank 0 coll_timeout → rank -1
+  router_admit rejected" — instead of N disconnected rows on N
+  streams.
+
+Runs EMBEDDED in the elastic launcher (``distributed/elastic.py``
+starts a monitor thread at rank −1, next to the watchdog — kill
+attribution gets the incident context for free; ``PADDLE_MON=0``
+disables) or STANDALONE::
+
+    python -m paddle_tpu.observability.monitor --obs_dir <dir> [--once]
+
+writing a plain-text status snapshot + JSON dump every
+``PADDLE_MON_SNAPSHOT_EVERY`` seconds (``monitor.status.txt`` /
+``monitor.snapshot.json`` next to the streams when emitting; stdout for
+the CLI). The monitor only ever READS the per-rank streams — tail-only
+file I/O on the launcher/login host, zero device reads, zero new work
+on any rank's step path (asserted by the counted-``np.asarray`` test).
+
+Stdlib-pure and standalone-loadable (no jax, no package imports) like
+``bus.py`` — safe on a login node against a dir rsync'd off the pod.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "StreamCursor", "LogHistogram", "IncidentCorrelator", "FleetMonitor",
+    "snapshot_every_default", "straggler_n_default", "z_default",
+    "incident_window_default", "poll_default", "main",
+]
+
+SCHEMA_VERSION = 1  # mirrors bus.SCHEMA_VERSION (stdlib-pure on purpose)
+
+_SNAPSHOT_ENV = "PADDLE_MON_SNAPSHOT_EVERY"
+_STRAGGLER_N_ENV = "PADDLE_MON_STRAGGLER_N"
+_Z_ENV = "PADDLE_MON_Z"
+_WINDOW_ENV = "PADDLE_MON_WINDOW"
+_POLL_ENV = "PADDLE_MON_POLL"
+
+#: kinds the monitor itself writes — never re-ingested (a monitor
+#: tailing its own launcher stream must not feed on its own output)
+_SELF_KINDS = ("incident", "mon_snapshot")
+
+_FALLBACK_WRITE_LOCK = threading.Lock()
+
+
+def _launcher_write_lock():
+    """The telemetry bus's append lock when the package is importable:
+    the EMBEDDED monitor shares its process (and, when the operator
+    exported PADDLE_OBS_DIR, the very launcher file) with bus.emit —
+    an unshared lock could interleave a large incident row with an
+    elastic_* row into two torn lines. Standalone loads fall back to a
+    module-local lock."""
+    try:
+        from . import bus as _bus
+
+        return _bus._lock
+    except Exception:  # noqa: BLE001 — standalone load, no package
+        return _FALLBACK_WRITE_LOCK
+
+
+def _envf(name: str, default: float) -> float:
+    try:
+        raw = os.environ.get(name, "").strip()
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def snapshot_every_default() -> float:
+    """``PADDLE_MON_SNAPSHOT_EVERY`` — seconds between status snapshots
+    (default 30; 0 disables periodic snapshots, the final one at
+    :meth:`FleetMonitor.finalize` still happens)."""
+    return max(_envf(_SNAPSHOT_ENV, 30.0), 0.0)
+
+
+def straggler_n_default() -> int:
+    """``PADDLE_MON_STRAGGLER_N`` — consecutive over-threshold windows
+    before a laggard is named a persistent straggler (default 3)."""
+    return max(int(_envf(_STRAGGLER_N_ENV, 3)), 1)
+
+
+def z_default() -> float:
+    """``PADDLE_MON_Z`` — leave-one-out step_ms z-score past which a
+    rank counts as lagging its fleet for one window (default 3)."""
+    return _envf(_Z_ENV, 3.0)
+
+
+def incident_window_default() -> float:
+    """``PADDLE_MON_WINDOW`` — seconds of quiet that close an incident;
+    notable events closer than this fold into one (default 5)."""
+    return max(_envf(_WINDOW_ENV, 5.0), 0.1)
+
+
+def poll_default() -> float:
+    """``PADDLE_MON_POLL`` — seconds between stream polls (default 0.5)."""
+    return max(_envf(_POLL_ENV, 0.5), 0.05)
+
+
+# ---------------------------------------------------------------------------
+# incremental stream cursor
+# ---------------------------------------------------------------------------
+
+
+class StreamCursor:
+    """Tail one JSONL stream incrementally: only freshly appended
+    COMPLETE lines are parsed (a torn trailing line stays unread until
+    its newline lands), and a file that SHRANK below the cursor
+    (truncation, rotation-in-place) resets to byte 0 instead of reading
+    garbage from the middle of a new line. Re-parsing from byte 0 per
+    poll would be quadratic over a long run — this is the FileHost
+    stats machinery, shared."""
+
+    __slots__ = ("path", "offset")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+
+    def poll(self) -> List[dict]:
+        """Every complete row appended since the last poll."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self.offset:
+            self.offset = 0  # truncated/rotated underneath us: restart
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offset)
+                chunk = f.read()
+        except OSError:
+            return []
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []
+        self.offset += end + 1
+        rows: List[dict] = []
+        for line in chunk[: end + 1].splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn or corrupt line mid-stream: skip it
+            if isinstance(rec, dict) and "kind" in rec:
+                rows.append(rec)
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# fixed-bin log histogram (online percentiles from merged counts)
+# ---------------------------------------------------------------------------
+
+
+class LogHistogram:
+    """Fixed log-spaced bins over (lo, hi]: value -> bin by one log, a
+    percentile by one cumulative scan over sparse counts. Two digests
+    with the same geometry MERGE by adding counts — the fleet p99 is
+    computed from merged per-rank counts, never from stored samples.
+    Relative error is bounded by half a bin (~3.7% at 32 bins/decade)."""
+
+    __slots__ = ("lo", "bins_per_decade", "nbins", "counts", "n",
+                 "vmin", "vmax", "total")
+
+    def __init__(self, lo: float = 1e-3, hi: float = 1e7,
+                 bins_per_decade: int = 32):
+        self.lo = float(lo)
+        self.bins_per_decade = int(bins_per_decade)
+        self.nbins = int(math.ceil(
+            math.log10(hi / lo) * self.bins_per_decade)) + 1
+        self.counts: Dict[int, int] = {}
+        self.n = 0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+        self.total = 0.0
+
+    def _index(self, v: float) -> int:
+        if v <= self.lo:
+            return 0
+        i = int(math.log10(v / self.lo) * self.bins_per_decade)
+        return min(max(i, 0), self.nbins - 1)
+
+    def _rep(self, i: int) -> float:
+        # geometric midpoint of the bin — halves the worst-case error
+        return self.lo * 10.0 ** ((i + 0.5) / self.bins_per_decade)
+
+    def add(self, v) -> None:
+        if not isinstance(v, (int, float)) or v != v or v < 0:
+            return
+        i = self._index(float(v))
+        self.counts[i] = self.counts.get(i, 0) + 1
+        self.n += 1
+        self.total += float(v)
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def merge(self, other: "LogHistogram") -> "LogHistogram":
+        if (other.lo != self.lo
+                or other.bins_per_decade != self.bins_per_decade):
+            raise ValueError("cannot merge histograms with different "
+                             "bin geometry")
+        for i, c in other.counts.items():
+            self.counts[i] = self.counts.get(i, 0) + c
+        self.n += other.n
+        self.total += other.total
+        for v in (other.vmin, other.vmax):
+            if v is None:
+                continue
+            self.vmin = v if self.vmin is None else min(self.vmin, v)
+            self.vmax = v if self.vmax is None else max(self.vmax, v)
+        return self
+
+    def percentile(self, q: float) -> Optional[float]:
+        """The q-th percentile (0..100) from bin counts; exact min/max
+        are tracked separately so the tails never leave the data."""
+        if self.n == 0:
+            return None
+        target = max(q, 0.0) / 100.0 * self.n
+        cum = 0
+        for i in sorted(self.counts):
+            cum += self.counts[i]
+            if cum >= target:
+                rep = self._rep(i)
+                lo = self.vmin if self.vmin is not None else rep
+                hi = self.vmax if self.vmax is not None else rep
+                return min(max(rep, lo), hi)
+        return self.vmax
+
+    def summary(self) -> Optional[dict]:
+        if self.n == 0:
+            return None
+        return {
+            "count": self.n,
+            "p50": round(self.percentile(50.0), 4),
+            "p99": round(self.percentile(99.0), 4),
+            "mean": round(self.total / self.n, 4),
+            "max": round(self.vmax, 4),
+        }
+
+
+# ---------------------------------------------------------------------------
+# incident correlation
+# ---------------------------------------------------------------------------
+
+
+class _Incident:
+    __slots__ = ("id", "events", "total", "t_first", "t_last",
+                 "seen_wall", "all_ranks")
+
+    def __init__(self, iid: int, ev: dict, wall: float):
+        self.id = iid
+        self.events = [ev]
+        self.total = 1
+        self.t_first = ev["time"]
+        self.t_last = ev["time"]
+        self.seen_wall = wall
+        self.all_ranks = {ev["rank"]}
+
+    def add(self, ev: dict, wall: float) -> None:
+        if len(self.events) < 64:  # a storm must not grow one row forever
+            self.events.append(ev)
+        self.total += 1  # folded-in count, even past the storage cap
+        self.all_ranks.add(ev["rank"])
+        self.t_first = min(self.t_first, ev["time"])
+        self.t_last = max(self.t_last, ev["time"])
+        self.seen_wall = wall
+
+    def ranks(self) -> List[int]:
+        return sorted(self.all_ranks)
+
+    def chain(self) -> str:
+        evs = sorted(self.events, key=lambda e: e["time"])
+        parts = []
+        for e in evs:
+            s = f"rank {e['rank']} {e['kind']}"
+            if e.get("detail"):
+                s += f" ({str(e['detail'])[:80]})"
+            parts.append(s)
+        if self.total > len(self.events):
+            parts.append(f"… +{self.total - len(self.events)} more")
+        return " → ".join(parts)
+
+    def payload(self) -> dict:
+        p = {
+            "id": self.id,
+            "t_start": self.t_first,
+            "t_end": self.t_last,
+            "ranks": self.ranks(),
+            "count": self.total,
+            "chain": self.chain(),
+            "events": [
+                {"kind": e["kind"], "rank": e["rank"],
+                 "step": e.get("step"), "time": e["time"],
+                 "detail": e.get("detail")}
+                for e in sorted(self.events, key=lambda e: e["time"])
+            ],
+        }
+        if self.total > len(self.events):
+            p["truncated"] = True  # events list holds the first 64 only
+        return p
+
+
+class IncidentCorrelator:
+    """Fold notable events closer than ``window_s`` into one incident.
+
+    Joining requires BOTH clocks to agree: the events' own EMIT times
+    must fall within the window (the documented semantics — a post-hoc
+    catch-up poll that reads a whole run in one pass must NOT merge a
+    guard trip and an unrelated stall hours apart into one chain) AND
+    the monitor's ingest clock must still be inside the window (live
+    mode: an open incident goes stale after ``window_s`` of quiet even
+    if a much later event would have landed near it on the emit axis).
+    The causal chain orders by the events' own wall times."""
+
+    def __init__(self, window_s: Optional[float] = None):
+        self.window_s = (incident_window_default()
+                         if window_s is None else float(window_s))
+        self.open: Optional[_Incident] = None
+        self.closed: List[dict] = []
+        self._next_id = 1
+
+    def _joins(self, ev: dict, now: float) -> bool:
+        if self.open is None:
+            return False
+        if now - self.open.seen_wall > self.window_s:
+            return False  # stale on the ingest clock
+        t = ev["time"]
+        return (self.open.t_first - self.window_s <= t
+                <= self.open.t_last + self.window_s)
+
+    def add(self, ev: dict) -> Optional[dict]:
+        """Fold one notable event in; returns the payload of an open
+        incident this event displaced (the caller must publish it —
+        either its quiet window elapsed between ticks, or the new
+        event is far away on the emit axis), else None."""
+        now = time.time()
+        if self._joins(ev, now):
+            self.open.add(ev, now)
+            return None
+        closed = self._close()
+        self.open = _Incident(self._next_id, ev, now)
+        self._next_id += 1
+        return closed
+
+    def _close(self) -> Optional[dict]:
+        if self.open is None:
+            return None
+        payload = self.open.payload()
+        self.closed.append(payload)
+        self.open = None
+        return payload
+
+    def tick(self, now: Optional[float] = None) -> Optional[dict]:
+        """Close (and return) the open incident once its quiet window
+        elapsed; None while it is still accreting."""
+        now = time.time() if now is None else now
+        if self.open is not None and \
+                now - self.open.seen_wall > self.window_s:
+            return self._close()
+        return None
+
+    def flush(self) -> Optional[dict]:
+        """Force-close the open incident (finalize / process exit)."""
+        return self._close()
+
+
+# ---------------------------------------------------------------------------
+# notable-event extraction (what the correlator feeds on)
+# ---------------------------------------------------------------------------
+
+
+def _notable_detail(kind: str, payload: dict) -> Optional[str]:
+    """A short human detail for a notable row, or None when the row is
+    routine. The kinds here are exactly the cross-rank failure modes
+    the per-rank emitters already publish."""
+    if kind.startswith("guard_"):
+        return str(payload.get("detail") or payload.get("reason")
+                   or "numerical guard event")
+    if kind == "recompile_storm":
+        return str(payload.get("detail") or "recompile storm")
+    if kind in ("coll_timeout", "coll_desync", "barrier_timeout",
+                "barrier_desync"):
+        op = payload.get("op") or payload.get("detail") or kind
+        seq = payload.get("seq")
+        return f"{op}" + (f" seq {seq}" if seq is not None else "")
+    if kind == "reshard":
+        return (f"{payload.get('old')}->{payload.get('new')} "
+                f"({payload.get('trigger')})")
+    if kind in ("elastic_watchdog_kill",):
+        return f"heartbeat stale {payload.get('stale_s')}s"
+    if kind in ("elastic_reshard_notice",):
+        return f"ranks {payload.get('ranks')} {payload.get('event')}"
+    if kind in ("elastic_attribution",):
+        return f"{payload.get('cause')}: {payload.get('detail')}"
+    if kind == "router_admit" and payload.get("outcome") == "rejected":
+        return f"admission rejected (depths {payload.get('depths')})"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-rank online state
+# ---------------------------------------------------------------------------
+
+_EWMA_ALPHA = 0.3
+#: z-score denominator floor, relative to the fleet mean — keeps a
+#: microsecond of jitter in a lock-step fleet from minting stragglers
+_Z_REL_FLOOR = 0.05
+
+
+class _RankView:
+    __slots__ = ("rank", "front", "last_time", "events", "guard",
+                 "recompiles", "ewma", "z", "laggard_windows",
+                 "straggler", "step_hist", "token_hist", "ttft_hist",
+                 "last_step_ms")
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.front: Optional[int] = None
+        self.last_time: Optional[float] = None
+        self.events = 0
+        self.guard = 0
+        self.recompiles = 0
+        self.ewma: Optional[float] = None
+        self.z: Optional[float] = None
+        self.laggard_windows = 0
+        self.straggler = False
+        self.step_hist = LogHistogram()
+        self.token_hist = LogHistogram()
+        self.ttft_hist = LogHistogram()
+        self.last_step_ms: Optional[float] = None
+
+    def note_step_ms(self, ms: float) -> None:
+        self.last_step_ms = ms
+        self.step_hist.add(ms)
+        self.ewma = ms if self.ewma is None else (
+            (1.0 - _EWMA_ALPHA) * self.ewma + _EWMA_ALPHA * ms)
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+
+
+class FleetMonitor:
+    """Tail every rank stream in ``obs_dir`` and keep cross-rank state.
+
+    ``emit=True`` (the embedded launcher mode) appends ``incident`` /
+    ``mon_snapshot`` rows to the launcher stream (rank −1) and writes
+    ``monitor.status.txt`` + ``monitor.snapshot.json`` on the snapshot
+    cadence; the standalone CLI defaults to read-only so re-runs over a
+    finished dir never pollute what they analyze."""
+
+    def __init__(self, obs_dir: str, *, emit: bool = False,
+                 snapshot_every: Optional[float] = None,
+                 straggler_n: Optional[int] = None,
+                 z_thresh: Optional[float] = None,
+                 window_s: Optional[float] = None,
+                 poll_s: Optional[float] = None):
+        self.obs_dir = obs_dir
+        self.emit = bool(emit)
+        self.snapshot_every = (snapshot_every_default()
+                               if snapshot_every is None
+                               else float(snapshot_every))
+        self.straggler_n = (straggler_n_default() if straggler_n is None
+                            else max(int(straggler_n), 1))
+        self.z_thresh = z_default() if z_thresh is None else float(z_thresh)
+        self.window_s = (incident_window_default() if window_s is None
+                         else float(window_s))
+        self.poll_s = poll_default() if poll_s is None else float(poll_s)
+        self.correlator = IncidentCorrelator(self.window_s)
+        self.ranks: Dict[int, _RankView] = {}
+        self._cursors: Dict[str, StreamCursor] = {}
+        self._rank_of: Dict[str, int] = {}
+        self._last_snapshot = 0.0
+        self._rows_seen = 0
+        #: serializes poll/finalize/snapshot against each other — the
+        #: embedded monitor's thread and the manager's attribution path
+        #: (`_attribute` polls for fresh incident context) both drive
+        #: the same cursors; an unlocked double-poll would advance an
+        #: offset twice and reset the cursor to byte 0
+        self._lock = threading.RLock()
+        self._write_lock = _launcher_write_lock()
+        #: the last snapshot dict write_snapshot() built (CLI --json)
+        self.last_snapshot: Optional[dict] = None
+
+    # -- stream discovery + ingestion -------------------------------------
+    def _discover(self) -> None:
+        try:
+            names = sorted(os.listdir(self.obs_dir))
+        except OSError:
+            return
+        for name in names:
+            if name in self._cursors:
+                continue
+            if name == "telemetry.launcher.jsonl":
+                rank = -1
+            elif name.startswith("telemetry.rank") and \
+                    name.endswith(".jsonl"):
+                try:
+                    rank = int(name[len("telemetry.rank"):-len(".jsonl")])
+                except ValueError:
+                    continue
+            else:
+                continue
+            path = os.path.join(self.obs_dir, name)
+            self._cursors[name] = StreamCursor(path)
+            self._rank_of[name] = rank
+
+    def poll(self) -> int:
+        """One tail pass over every stream; returns rows ingested. Also
+        ticks the correlator so a quiet window closes (and emits) the
+        open incident. Thread-safe: the embedded monitor thread and
+        the manager's attribution path may both call in.
+
+        Rows from ALL streams are merged by their emit time before
+        ingestion: a catch-up poll (the standalone ``--once`` CLI, or
+        attaching to a long-running job) must replay the fleet in the
+        order things happened — per-stream sequential ingestion would
+        compute the first stream's z-scores against an empty fleet and
+        could never name that rank a straggler."""
+        with self._lock:
+            self._discover()
+            batch = []
+            for name in list(self._cursors):
+                rank = self._rank_of[name]
+                for row in self._cursors[name].poll():
+                    batch.append((row.get("time", 0.0) if isinstance(
+                        row.get("time"), (int, float)) else 0.0,
+                        rank, row))
+            batch.sort(key=lambda e: e[0])
+            for _, rank, row in batch:
+                self._ingest(rank, row)
+            self._rows_seen += len(batch)
+            closed = self.correlator.tick()
+            if closed is not None:
+                self._publish_incident(closed)
+            return len(batch)
+
+    def _ingest(self, rank: int, row: dict) -> None:
+        kind = str(row.get("kind", ""))
+        if kind in _SELF_KINDS:
+            return  # never feed on our own output
+        rv = self.ranks.get(rank)
+        if rv is None:
+            rv = self.ranks[rank] = _RankView(rank)
+        rv.events += 1
+        step = row.get("step")
+        if isinstance(step, int):
+            rv.front = step if rv.front is None else max(rv.front, step)
+        t = row.get("time")
+        if isinstance(t, (int, float)):
+            rv.last_time = t if rv.last_time is None else max(
+                rv.last_time, t)
+        payload = row.get("payload") or {}
+        if not isinstance(payload, dict):
+            payload = {}
+        if kind in ("step_metrics", "decode_metrics"):
+            ms = payload.get("step_ms")
+            if isinstance(ms, (int, float)):
+                rv.note_step_ms(float(ms))
+                self._straggler_check(rv, row)
+            ttft = payload.get("ttft_ms")
+            if isinstance(ttft, (int, float)):
+                rv.ttft_hist.add(float(ttft))
+        elif kind == "decode_request":
+            mpt = payload.get("ms_per_token")
+            if isinstance(mpt, (int, float)):
+                rv.token_hist.add(float(mpt))
+            ttft = payload.get("ttft_ms")
+            if isinstance(ttft, (int, float)):
+                rv.ttft_hist.add(float(ttft))
+        if kind.startswith("guard_"):
+            rv.guard += 1
+        elif kind == "recompile":
+            rv.recompiles += 1
+        detail = _notable_detail(kind, payload)
+        if detail is not None:
+            self._notable(kind, rank, row.get("step"),
+                          t if isinstance(t, (int, float)) else
+                          time.time(), detail)
+
+    # -- straggler ranking -------------------------------------------------
+    def _zscore(self, rv: _RankView) -> Optional[float]:
+        """Leave-one-out z: this rank's EWMA against the REST of the
+        fleet. With the suspect excluded the baseline stays tight, so
+        one straggler scores huge while the healthy majority — whose
+        baseline INCLUDES the straggler — stays near zero; a plain
+        all-ranks z saturates at 1.0 on a two-rank fleet."""
+        others = [o.ewma for o in self.ranks.values()
+                  if o is not rv and o.ewma is not None]
+        if rv.ewma is None or not others:
+            return None
+        mean = sum(others) / len(others)
+        var = sum((x - mean) ** 2 for x in others) / len(others)
+        floor = max(_Z_REL_FLOOR * abs(mean), 1e-6)
+        return (rv.ewma - mean) / max(math.sqrt(var), floor)
+
+    def _straggler_check(self, rv: _RankView, row: dict) -> None:
+        z = self._zscore(rv)
+        rv.z = z
+        if z is None:
+            return
+        if z >= self.z_thresh:
+            rv.laggard_windows += 1
+        else:
+            rv.laggard_windows = 0
+            rv.straggler = False  # recovered: eligible to be named again
+            return
+        if rv.laggard_windows >= self.straggler_n and not rv.straggler:
+            rv.straggler = True
+            med = self._fleet_median_ewma()
+            t = row.get("time")
+            self._notable(
+                "straggler", rv.rank, row.get("step"),
+                t if isinstance(t, (int, float)) else time.time(),
+                f"step_ms ewma {rv.ewma:.1f} vs fleet median "
+                f"{med:.1f} for {rv.laggard_windows} windows "
+                f"(z={z:.1f})")
+
+    def _fleet_median_ewma(self) -> float:
+        vals = sorted(o.ewma for o in self.ranks.values()
+                      if o.ewma is not None)
+        # lower middle on even counts: a 2-rank fleet's baseline must
+        # read as the healthy rank, not the straggler itself
+        return vals[(len(vals) - 1) // 2] if vals else 0.0
+
+    # -- incidents ---------------------------------------------------------
+    def _notable(self, kind, rank, step, t, detail) -> None:
+        closed = self.correlator.add(
+            {"kind": kind, "rank": rank, "step": step, "time": t,
+             "detail": detail})
+        if closed is not None:
+            # a stale open incident this event displaced (its quiet
+            # window elapsed between ticks) still gets its row
+            self._publish_incident(closed)
+
+    def _publish_incident(self, payload: dict) -> None:
+        print(f"paddle_tpu.monitor: incident #{payload['id']} "
+              f"ranks {payload['ranks']}: {payload['chain']}",
+              file=sys.stderr, flush=True)
+        self._write_row("incident", payload)
+
+    def incident_context(self, rank: Optional[int] = None,
+                         within_s: float = 60.0) -> Optional[str]:
+        """The freshest incident chain involving ``rank`` (any rank
+        when None) — what the launcher folds into its kill
+        attribution. A fresh incident on OTHER ranks is still returned
+        (cross-rank causality is the point), but anything older than
+        ``within_s`` is never offered: a stale chain would be a false
+        causal attribution."""
+        with self._lock:
+            cands: List[dict] = list(self.correlator.closed)
+            if self.correlator.open is not None:
+                cands.append(self.correlator.open.payload())
+        now = time.time()
+        fresh = [p for p in cands if now - p["t_end"] <= within_s]
+        for p in reversed(fresh):
+            if rank is None or rank in p["ranks"]:
+                return p["chain"]
+        return fresh[-1]["chain"] if fresh else None
+
+    # -- output ------------------------------------------------------------
+    def _write_row(self, kind: str, payload: dict) -> None:
+        """Append one launcher-stream (rank −1) bus row directly — the
+        monitor must land rows in the CHILDREN's obs dir even when the
+        launcher process itself has no PADDLE_OBS_DIR exported, so it
+        does not route through bus.emit's env lookup."""
+        if not self.emit:
+            return
+        row = {"v": SCHEMA_VERSION, "kind": kind, "step": None,
+               "time": time.time(), "rank": -1, "payload": payload}
+        try:
+            path = os.path.join(self.obs_dir, "telemetry.launcher.jsonl")
+            with self._write_lock, open(path, "a") as f:
+                f.write(json.dumps(row, default=str) + "\n")
+        except (OSError, TypeError, ValueError):
+            pass  # diagnostics never take the launcher down
+
+    def snapshot_dict(self) -> dict:
+        with self._lock:
+            return self._snapshot_dict_locked()
+
+    def _snapshot_dict_locked(self) -> dict:
+        ranks = {}
+        fronts = []
+        fleet_step = LogHistogram()
+        fleet_token = LogHistogram()
+        fleet_ttft = LogHistogram()
+        for r in sorted(self.ranks):
+            rv = self.ranks[r]
+            if r >= 0 and rv.front is not None:
+                fronts.append(rv.front)
+            fleet_step.merge(rv.step_hist)
+            fleet_token.merge(rv.token_hist)
+            fleet_ttft.merge(rv.ttft_hist)
+            ranks[str(r)] = {
+                "front": rv.front,
+                "events": rv.events,
+                "step_ms_ewma": (None if rv.ewma is None
+                                 else round(rv.ewma, 3)),
+                "z": None if rv.z is None else round(rv.z, 2),
+                "laggard_windows": rv.laggard_windows,
+                "straggler": rv.straggler,
+                "guard": rv.guard,
+                "recompiles": rv.recompiles,
+                "step_ms": rv.step_hist.summary(),
+            }
+        timed = sorted(
+            ((rv.ewma, r) for r, rv in self.ranks.items()
+             if rv.ewma is not None and r >= 0), reverse=True)
+        open_inc = self.correlator.open
+        return {
+            "time": time.time(),
+            "ranks": ranks,
+            "step_front": {
+                "min": min(fronts) if fronts else None,
+                "max": max(fronts) if fronts else None,
+                "skew": (max(fronts) - min(fronts)) if fronts else None,
+            },
+            "slowest": [[r, round(e, 3)] for e, r in timed[:3]],
+            "stragglers": sorted(r for r, rv in self.ranks.items()
+                                 if rv.straggler),
+            "digests": {
+                "step_ms": fleet_step.summary(),
+                "token_ms": fleet_token.summary(),
+                "ttft_ms": fleet_ttft.summary(),
+            },
+            "incidents": {
+                "open": 0 if open_inc is None else 1,
+                "closed": len(self.correlator.closed),
+                "recent": [p["chain"] for p in
+                           (self.correlator.closed[-3:] +
+                            ([open_inc.payload()] if open_inc else []))],
+            },
+            "rows_seen": self._rows_seen,
+        }
+
+    def snapshot_text(self, snap: Optional[dict] = None) -> str:
+        s = self.snapshot_dict() if snap is None else snap
+        sf = s["step_front"]
+        lines = [
+            f"fleet monitor @ {time.strftime('%H:%M:%S')} — "
+            f"{sum(1 for r in s['ranks'] if int(r) >= 0)} rank(s), "
+            f"step front [{sf['min']}..{sf['max']}] skew {sf['skew']}, "
+            f"incidents {s['incidents']['open']} open / "
+            f"{s['incidents']['closed']} closed, "
+            f"{s['rows_seen']} rows",
+            f"{'rank':>4}  {'front':>6}  {'step_ms':>9}  {'p50':>8}  "
+            f"{'p99':>8}  {'z':>6}  {'guard':>5}  {'recomp':>6}  flags",
+        ]
+        for r in sorted(s["ranks"], key=int):
+            rv = s["ranks"][r]
+            h = rv["step_ms"] or {}
+            fmt = lambda v, nd=2: ("-" if v is None else
+                                   f"{v:.{nd}f}" if isinstance(v, float)
+                                   else str(v))
+            flags = ""
+            if rv["straggler"]:
+                flags = f"STRAGGLER ({rv['laggard_windows']} windows)"
+            lines.append(
+                f"{r:>4}  {fmt(rv['front']):>6}  "
+                f"{fmt(rv['step_ms_ewma']):>9}  "
+                f"{fmt(h.get('p50')):>8}  {fmt(h.get('p99')):>8}  "
+                f"{fmt(rv['z']):>6}  {rv['guard']:>5}  "
+                f"{rv['recompiles']:>6}  {flags}")
+        for key, label in (("step_ms", "fleet step_ms"),
+                           ("token_ms", "fleet token_ms"),
+                           ("ttft_ms", "fleet ttft_ms")):
+            d = s["digests"][key]
+            if d:
+                lines.append(
+                    f"{label}: p50 {d['p50']:g} / p99 {d['p99']:g} "
+                    f"(n={d['count']}, max {d['max']:g})")
+        for r in s["stragglers"]:
+            rv = s["ranks"][str(r)]
+            lines.append(
+                f"straggler: rank {r} (step_ms ewma "
+                f"{rv['step_ms_ewma']}, z={rv['z']}, "
+                f"{rv['laggard_windows']} windows)")
+        for chain in s["incidents"]["recent"]:
+            lines.append(f"incident: {chain}")
+        return "\n".join(lines)
+
+    def maybe_snapshot(self, now: Optional[float] = None) -> Optional[str]:
+        """On the snapshot cadence: build the snapshot, write the
+        status/JSON files (when emitting), and return the text."""
+        if self.snapshot_every <= 0:
+            return None
+        now = time.time() if now is None else now
+        if now - self._last_snapshot < self.snapshot_every:
+            return None
+        self._last_snapshot = now
+        return self.write_snapshot()
+
+    def write_snapshot(self, snap: Optional[dict] = None) -> str:
+        snap = self.snapshot_dict() if snap is None else snap
+        self.last_snapshot = snap
+        text = self.snapshot_text(snap)
+        if self.emit:
+            try:
+                with open(os.path.join(self.obs_dir,
+                                       "monitor.status.txt"), "w") as f:
+                    f.write(text + "\n")
+                with open(os.path.join(self.obs_dir,
+                                       "monitor.snapshot.json"),
+                          "w") as f:
+                    json.dump(snap, f, default=str)
+            except OSError:
+                pass
+            self._write_row("mon_snapshot", {
+                "stragglers": snap["stragglers"],
+                "skew": snap["step_front"]["skew"],
+                "incidents_closed": snap["incidents"]["closed"],
+            })
+        return text
+
+    def finalize(self) -> dict:
+        """Final drain before process exit: one last poll, force-close
+        the open incident (so a failure in the last window still gets
+        its row), and write the final snapshot."""
+        with self._lock:
+            self.poll()
+            closed = self.correlator.flush()
+            if closed is not None:
+                self._publish_incident(closed)
+            snap = self.snapshot_dict()
+            if self.emit:
+                self.write_snapshot(snap)
+            else:
+                self.last_snapshot = snap
+            return snap
+
+
+# ---------------------------------------------------------------------------
+# standalone CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.observability.monitor",
+        description="live fleet monitor over an observability dir")
+    ap.add_argument("--obs_dir", required=True,
+                    help="PADDLE_OBS_DIR of the (running or finished) "
+                         "job")
+    ap.add_argument("--once", action="store_true",
+                    help="one poll + one snapshot, then exit (post-hoc "
+                         "analysis of a finished dir)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the snapshot as JSON instead of text")
+    ap.add_argument("--emit", action="store_true",
+                    help="also append incident/snapshot rows + status "
+                         "files into the obs dir (the embedded-monitor "
+                         "behavior; default read-only)")
+    ap.add_argument("--snapshot_every", type=float, default=None,
+                    help="seconds between snapshots (default "
+                         "$PADDLE_MON_SNAPSHOT_EVERY or 30)")
+    ap.add_argument("--poll", type=float, default=None,
+                    help="seconds between stream polls (default "
+                         "$PADDLE_MON_POLL or 0.5)")
+    ap.add_argument("--max_seconds", type=float, default=None,
+                    help="exit after this long (default: run until ^C)")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.obs_dir):
+        print(f"monitor: {args.obs_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    mon = FleetMonitor(args.obs_dir, emit=args.emit,
+                       snapshot_every=args.snapshot_every,
+                       poll_s=args.poll)
+    if args.once:
+        snap = mon.finalize()  # finalize's own poll drains the dir
+        print(json.dumps(snap, default=str) if args.json
+              else mon.snapshot_text(snap))
+        return 0
+    t0 = time.time()
+    try:
+        while True:
+            mon.poll()
+            text = mon.maybe_snapshot()
+            if text is not None:
+                print(json.dumps(mon.last_snapshot, default=str)
+                      if args.json else text, flush=True)
+            if args.max_seconds is not None and \
+                    time.time() - t0 >= args.max_seconds:
+                break
+            time.sleep(mon.poll_s)
+    except KeyboardInterrupt:
+        pass
+    snap = mon.finalize()
+    print(json.dumps(snap, default=str) if args.json
+          else mon.snapshot_text(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

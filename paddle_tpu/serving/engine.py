@@ -324,7 +324,8 @@ class Request:
     """One generation request for the engine."""
 
     def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
-                 top_k=0, top_p=1.0, eos_id=None, rid=None):
+                 top_k=0, top_p=1.0, eos_id=None, rid=None,
+                 trace_id=None):
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -332,6 +333,11 @@ class Request:
         self.top_p = float(top_p)
         self.eos_id = -1 if eos_id is None else int(eos_id)
         self.rid = next(_rid_counter) if rid is None else rid
+        #: request-scoped trace id (ISSUE 14): Router.submit stamps one
+        #: so the engine's admission/prefill/decode-window/retire span
+        #: rows and the decode_request row stitch into one life; None
+        #: (direct engine use) keeps the span stream empty
+        self.trace_id = trace_id
         self.t_submit: Optional[float] = None  # set by engine.submit
 
 
@@ -541,6 +547,11 @@ class InferenceEngine:
             tok_block = np.asarray(jnp.stack(emits, axis=0))
             done = np.asarray(self._state.done)
             dt = time.perf_counter() - t0
+            # decode-window span for traced requests: emitted on the
+            # SAME readback cadence (host values only, zero new reads)
+            self._metrics.window_span(
+                [s.req.trace_id for s in self._active.values()],
+                steps=window)
             self._collect(tok_block, done, results)
             ttfts, self._ttft_window = self._ttft_window, []
             self._metrics.window(
@@ -595,6 +606,11 @@ class InferenceEngine:
                 start=np.asarray([job.consumed], np.int32))
             job.consumed += take
             job.prefill_s += time.perf_counter() - t0
+            self._metrics.span(
+                "prefill_chunk", trace_id=job.req.trace_id,
+                rid=job.req.rid, slot=slot, consumed=job.consumed,
+                prompt_len=L,
+                chunk_ms=round((time.perf_counter() - t0) * 1e3, 3))
             if job.consumed >= L:
                 del self._pending[slot]
                 self._activate(slot, job.req, job.raws, last,
@@ -624,6 +640,11 @@ class InferenceEngine:
                     break
             self._queue.popleft()
             progress = True
+            self._metrics.span(
+                "admit", trace_id=req.trace_id, rid=req.rid, slot=slot,
+                queue_wait_ms=(
+                    round((time.perf_counter() - req.t_submit) * 1e3, 3)
+                    if req.t_submit is not None else None))
             L = req.prompt_ids.size
             if self.prefill_chunk > 0 and L > self.prefill_chunk:
                 self._pending[slot] = _Pending(
@@ -651,13 +672,20 @@ class InferenceEngine:
         ttft_ms = ((now - req.t_submit) * 1e3
                    if req.t_submit is not None else prefill_ms)
         self._ttft_window.append(ttft_ms)
+        self._metrics.span(
+            "prefill", trace_id=req.trace_id, rid=req.rid, slot=slot,
+            prefill_ms=round(prefill_ms, 3), ttft_ms=round(ttft_ms, 3))
         if first == req.eos_id or req.max_new_tokens <= 1:
             # degenerate request: done at its first token
             results[req.rid] = GeneratedResult(
                 req.rid, [first], prefill_ms, prefill_ms, ttft_ms)
+            self._metrics.span(
+                "retire", trace_id=req.trace_id, rid=req.rid,
+                slot=slot, tokens=1)
             self._metrics.request_done(
                 rid=req.rid, tokens=1, latency_ms=prefill_ms,
-                prefill_ms=prefill_ms, ttft_ms=ttft_ms)
+                prefill_ms=prefill_ms, ttft_ms=ttft_ms,
+                trace_id=req.trace_id)
             self._state.done = self._state.done.at[slot].set(True)
             self._release(slot, blocks)
         else:
@@ -739,10 +767,13 @@ class InferenceEngine:
             results[st.req.rid] = GeneratedResult(
                 st.req.rid, st.tokens, st.prefill_ms, total_ms,
                 st.ttft_ms)
+            self._metrics.span(
+                "retire", trace_id=st.req.trace_id, rid=st.req.rid,
+                slot=slot, tokens=len(st.tokens))
             self._metrics.request_done(
                 rid=st.req.rid, tokens=len(st.tokens),
                 latency_ms=total_ms, prefill_ms=st.prefill_ms,
-                ttft_ms=st.ttft_ms)
+                ttft_ms=st.ttft_ms, trace_id=st.req.trace_id)
             self._state.done = self._state.done.at[slot].set(True)
             self._release(slot, self._slot_blocks.pop(slot, None))
 

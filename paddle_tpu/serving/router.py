@@ -42,6 +42,7 @@ Run as a script (what `distributed.launch` spawns)::
 from __future__ import annotations
 
 import importlib.util
+import itertools
 import json
 import os
 import sys
@@ -50,6 +51,11 @@ from typing import Dict, List, Optional
 
 __all__ = ["HostStats", "LocalHost", "FileHost", "Router",
            "admit_queue_default", "admit_ttft_ms_default", "worker_main"]
+
+#: process-wide trace-id counter: ids are pid-qualified, so the counter
+#: must be shared by every Router in the process or two routers over
+#: one obs dir would mint colliding ids
+_trace_counter = itertools.count(1)
 
 _ADMIT_QUEUE_ENV = "PADDLE_SERVE_ADMIT_QUEUE"
 _ADMIT_TTFT_ENV = "PADDLE_SERVE_ADMIT_TTFT_MS"
@@ -86,6 +92,9 @@ def _load_rel(modname: str, *parts: str):
     path = os.path.join(os.path.dirname(here), *parts)
     spec = importlib.util.spec_from_file_location(modname, path)
     mod = importlib.util.module_from_spec(spec)
+    # registered so the standalone modules can find each other (the
+    # bus's mon-fault hook looks the injector up in sys.modules)
+    sys.modules[modname] = mod
     spec.loader.exec_module(mod)
     return mod
 
@@ -106,6 +115,15 @@ def _fault():
         return fault_injection
     except ImportError:
         return _load_rel("_pdtpu_fault", "utils", "fault_injection.py")
+
+
+def _monitor():
+    try:
+        from ..observability import monitor
+
+        return monitor
+    except ImportError:
+        return _load_rel("_pdtpu_mon", "observability", "monitor.py")
 
 
 # ---------------------------------------------------------------------------
@@ -133,7 +151,10 @@ class HostStats:
 
 
 def _req_fields(req) -> dict:
-    """Engine Request / plain dict -> the wire fields a host needs."""
+    """Engine Request / plain dict -> the wire fields a host needs.
+    ``trace_id`` rides the mailbox row so a worker's span and
+    decode_request rows stitch to the router's — the trace follows the
+    request across the process boundary."""
     if isinstance(req, dict):
         d = dict(req)
         d.setdefault("max_new_tokens", 16)
@@ -146,6 +167,7 @@ def _req_fields(req) -> dict:
         "top_k": req.top_k,
         "top_p": req.top_p,
         "eos_id": req.eos_id,
+        "trace_id": getattr(req, "trace_id", None),
     }
 
 
@@ -168,7 +190,7 @@ class LocalHost:
                 top_k=d.get("top_k", 0), top_p=d.get("top_p", 1.0),
                 eos_id=(None if d.get("eos_id", -1) in (-1, None)
                         else d["eos_id"]),
-                rid=d.get("rid"))
+                rid=d.get("rid"), trace_id=d.get("trace_id"))
         self.engine.submit(req)
         self._submitted += 1
 
@@ -204,8 +226,10 @@ class FileHost:
         # AND per tick, and the stream grows one row per worker poll —
         # re-parsing from byte 0 every time would be quadratic over a
         # long-running router, so only freshly appended COMPLETE lines
-        # are read and the last decode_metrics row is cached
-        self._tail_offset = 0
+        # are read and the last decode_metrics row is cached. The
+        # cursor machinery is the fleet monitor's (ISSUE 14): same
+        # torn-line and truncation semantics, one implementation.
+        self._cursor = _monitor().StreamCursor(self._stream_path())
         self._last_metrics: Optional[dict] = None
 
     def submit(self, req) -> None:
@@ -222,30 +246,10 @@ class FileHost:
         return os.path.join(self.obs_dir,
                             f"telemetry.rank{self.rank}.jsonl")
 
-    def _tail_new_rows(self, path: str) -> None:
-        try:
-            with open(path, "rb") as f:
-                f.seek(self._tail_offset)
-                chunk = f.read()
-        except OSError:
-            return
-        end = chunk.rfind(b"\n")  # a torn trailing line stays unread
-        if end < 0:
-            return
-        self._tail_offset += end + 1
-        for line in chunk[: end + 1].splitlines():
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(rec, dict) and \
-                    rec.get("kind") == "decode_metrics":
-                self._last_metrics = rec
-
     def stats(self) -> HostStats:
-        path = self._stream_path()
-        if os.path.exists(path):
-            self._tail_new_rows(path)
+        for rec in self._cursor.poll():
+            if rec.get("kind") == "decode_metrics":
+                self._last_metrics = rec
         last = self._last_metrics
         if last is None:
             return HostStats(age_s=None, submitted=self._submitted)
@@ -328,6 +332,27 @@ class Router:
         self._pending_guess = [0] * len(self.hosts)
         self._last_submit_t = [0.0] * len(self.hosts)
 
+    # -- request-scoped tracing (ISSUE 14) ---------------------------------
+    def _stamp_trace(self, req):
+        """Give the request a trace id (unless the caller brought one):
+        the key every downstream span — FileHost mailbox row, engine
+        admission/prefill/decode-window/retire events, decode_request —
+        carries, so the monitor and tools/timeline.py can render one
+        request's life across processes. pid-qualified so ids from
+        several routers over one obs dir never collide."""
+        if isinstance(req, dict):
+            tid = req.get("trace_id")
+            if not tid:
+                tid = req["trace_id"] = self._new_trace_id()
+            return tid, req.get("rid")
+        tid = getattr(req, "trace_id", None)
+        if not tid:
+            tid = req.trace_id = self._new_trace_id()
+        return tid, getattr(req, "rid", None)
+
+    def _new_trace_id(self) -> str:
+        return f"t{os.getpid():x}-{next(_trace_counter):05d}"
+
     # -- scheduling --------------------------------------------------------
     def _predicted_wait_ms(self, st: HostStats, extra: int) -> float:
         pending = st.queue_depth + st.inflight + extra
@@ -356,7 +381,9 @@ class Router:
 
     def submit(self, req) -> Optional[int]:
         """Route one request; returns the host index, or None when
-        admission control rejected it (all hosts over limit)."""
+        admission control rejected it (all hosts over limit). Stamps a
+        ``trace_id`` on the request (the root of its span chain)."""
+        tid, rid = self._stamp_trace(req)
         stats = []
         for i, h in enumerate(self.hosts):
             st = h.stats()
@@ -366,14 +393,19 @@ class Router:
                       if self._eligible(i, st)]
         if not candidates:
             self.rejected += 1
-            self._emit_admit(None, stats)
+            self._emit_admit(None, stats, tid, rid)
             return None
         best = min(candidates, key=lambda i: self._predicted_wait_ms(
             stats[i], self._pending_guess[i]))
+        # the prediction that actually drove the choice — captured
+        # BEFORE this submit bumps the pending guess
+        predicted = self._predicted_wait_ms(stats[best],
+                                            self._pending_guess[best])
         self.hosts[best].submit(req)
         self._pending_guess[best] += 1
         self._last_submit_t[best] = time.time()
         self.admitted += 1
+        self._emit_span(tid, rid, best, predicted)
         return best
 
     # -- control loop ------------------------------------------------------
@@ -418,7 +450,8 @@ class Router:
         payload["queue_depth_total"] = total
         bus.emit("router_metrics", payload, step=self._ticks)
 
-    def _emit_admit(self, host: Optional[int], stats) -> None:
+    def _emit_admit(self, host: Optional[int], stats, trace_id=None,
+                    rid=None) -> None:
         bus = _bus()
         if not bus.enabled():
             return
@@ -428,6 +461,21 @@ class Router:
             "depths": [s.queue_depth for s in stats],
             "admit_queue": self.admit_queue,
             "admit_ttft_ms": self.admit_ttft_ms,
+            "trace_id": trace_id,
+            "rid": rid,
+        }, step=self._ticks)
+
+    def _emit_span(self, trace_id, rid, host: int,
+                   predicted_wait_ms: float) -> None:
+        """The root span of an admitted request's life: which host the
+        SLO scheduler picked and what it predicted."""
+        bus = _bus()
+        if not bus.enabled():
+            return
+        bus.emit_span("router_submit", trace_id, {
+            "rid": rid,
+            "host": host,
+            "predicted_wait_ms": round(predicted_wait_ms, 3),
         }, step=self._ticks)
 
 
@@ -468,11 +516,20 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     queue: List[dict] = []
     seen = set()
     slow = 1.0
+    straggle_s = 0.0
     windows = 0
     while True:
         for action, arg in fi.consume_serve_events():
             if action == "slow_host" and (arg or 0) == rank:
                 slow = 20.0
+            elif action == "straggler" and (arg or 0) == rank:
+                # ISSUE 14: a fixed per-window decode delay on ONE rank
+                # — the fleet monitor's skew detector must NAME it from
+                # the step_ms telemetry alone
+                straggle_s = 0.25
+        w0 = time.perf_counter()
+        if straggle_s:
+            time.sleep(straggle_s)
         for name in sorted(os.listdir(inbox)):
             if not name.endswith(".json") or name in seen:
                 continue
@@ -488,7 +545,12 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
         t0 = time.perf_counter()
         if queue:
             req = queue.pop(0)
+            tid = req.get("trace_id")
             n = int(req.get("max_new_tokens", 16))
+            bus.emit_span("admit", tid, {
+                "rid": req.get("rid"),
+                "queue_wait_ms": round(
+                    (time.time() - req["t_arrive"]) * 1e3, 3)})
             # simulated decode: n tokens at rate tokens/sec (slowed
             # when degraded) — wall clock the telemetry prices
             time.sleep(n / rate * slow)
@@ -500,6 +562,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 "prefill_ms": 0.0,
                 "ttft_ms": round(ttft_ms, 3),
                 "ms_per_token": round(ttft_ms / max(n, 1), 3),
+                "trace_id": tid,
             })
             out = {"rid": req.get("rid"), "tokens": n, "rank": rank,
                    "ttft_ms": round(ttft_ms, 3)}
@@ -514,6 +577,8 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
             "tokens": served_tokens,
             "inflight_slots": 1 if served_tokens else 0,
             "queue_depth": len(queue),
+            # per-window wall time: the fleet monitor's skew signal
+            "step_ms": round((time.perf_counter() - w0) * 1e3, 3),
         }
         if served_tokens and dt > 0:
             payload["tokens_per_sec"] = round(served_tokens / dt, 1)

@@ -15,7 +15,9 @@
   ``rank`` (once per elastic step-boundary check,
   distributed/resharding.py — the reshard matrix's prey), ``serve``
   (once per serving-router scheduling tick / host-worker poll,
-  serving/router.py — the admission-control matrix's prey).
+  serving/router.py — the admission-control matrix's prey), ``mon``
+  (once per telemetry-bus row write, observability/bus.py — the fleet
+  monitor's lossy-stream prey).
 - ``action`` one of ``fail`` (raise InjectedFault, an IOError),
   ``hang`` (sleep ``arg`` seconds, default 3600 — the watchdog's prey),
   ``kill`` (``os._exit(arg)``, default 17 — a hard preemption),
@@ -35,12 +37,18 @@
   logical rank, default the last rank, so
   ``PADDLE_FAULT_SPEC="rank:depart:3:1"`` loses rank 1 at step 3 and
   ``rank:depart:3:1,rank:return:6:1`` brings it back at step 6), or
-  ``burst`` / ``slow_host`` (``serve`` only: arm a serving-tier event
-  the router/worker drains at its next tick — ``serve:burst:2:8``
-  injects an 8-request burst at the router's 2nd tick (admission
-  control's prey), ``serve:slow_host:1:0`` degrades host rank 0 from
-  its 1st poll (the SLO scheduler routes away from it); ``arg``
-  defaults: burst 8 requests, slow_host rank 0).
+  ``burst`` / ``slow_host`` / ``straggler`` (``serve`` only: arm a
+  serving-tier event the router/worker drains at its next tick —
+  ``serve:burst:2:8`` injects an 8-request burst at the router's 2nd
+  tick (admission control's prey), ``serve:slow_host:1:0`` degrades
+  host rank 0 from its 1st poll (the SLO scheduler routes away from
+  it), ``serve:straggler:1:2`` adds a fixed per-window decode delay on
+  host rank 2 from its 1st poll (the fleet monitor's skew detector
+  must NAME that rank); ``arg`` defaults: burst 8 requests,
+  slow_host/straggler rank 0), or ``drop`` / ``dup`` (``mon`` only:
+  the telemetry bus consumes the rule at its nth row write and drops /
+  duplicates that one line — the monitor's incremental cursor and
+  count-based aggregation must survive a lossy, re-appending stream).
 - ``nth``    1-based per-process call count at which the rule fires
   (each call to a site increments that site's counter), so a relaunched
   attempt that resumes later in training naturally skips the fault.
@@ -63,11 +71,13 @@ from typing import Dict, List, Optional
 
 __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
            "has_site", "consume_grad_action", "consume_rank_events",
-           "consume_serve_events", "GRAD_POISONS", "reset"]
+           "consume_serve_events", "consume_mon_action", "GRAD_POISONS",
+           "reset"]
 
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
 _ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
-            "spike", "depart", "return", "burst", "slow_host")
+            "spike", "depart", "return", "burst", "slow_host",
+            "straggler", "drop", "dup")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
 # grad poison only makes sense where a compiled step consumes the flag
@@ -79,8 +89,12 @@ _RANK_ACTIONS = ("depart", "return")
 _RANK_SITES = ("rank",)
 # serving-tier events only make sense where the router/worker polls
 # for them (serving/router.py scheduling tick / host-worker loop)
-_SERVE_ACTIONS = ("burst", "slow_host")
+_SERVE_ACTIONS = ("burst", "slow_host", "straggler")
 _SERVE_SITES = ("serve",)
+# bus-line faults only make sense where a bus row is being written
+# (observability/bus.py emit — the fleet monitor's cursor prey)
+_MON_ACTIONS = ("drop", "dup")
+_MON_SITES = ("mon",)
 # sites that pass a file path to fault_point (the only places a corrupt
 # rule can bite) — a corrupt rule elsewhere would be a silent no-op, so
 # the parser rejects it loudly instead
@@ -112,6 +126,7 @@ class FaultInjector:
         self.flags: set = set()  # armed markers (e.g. "desync")
         self.rank_events: List = []  # armed (action, rank|None), ordered
         self.serve_events: List = []  # armed (action, arg|None), ordered
+        self.mon_events: List = []  # armed drop/dup bus-line actions
         for item in filter(None, (s.strip() for s in spec.split(","))):
             parts = item.split(":")
             if len(parts) < 3:
@@ -150,6 +165,11 @@ class FaultInjector:
                 raise ValueError(
                     f"{action} rule targets un-instrumented site {site!r} "
                     f"(serving-event sites: {_SERVE_SITES})"
+                )
+            if action in _MON_ACTIONS and site not in _MON_SITES:
+                raise ValueError(
+                    f"{action} rule targets un-instrumented site {site!r} "
+                    f"(bus-line sites: {_MON_SITES})"
                 )
             arg = parts[3] if len(parts) > 3 else None
             self._rules.append(_Rule(site, action, nth, arg))
@@ -202,6 +222,13 @@ class FaultInjector:
                   f"{'' if arg is None else f':{arg}'} at {tag}",
                   file=sys.stderr, flush=True)
             self.serve_events.append((r.action, arg))
+            return
+        if r.action in _MON_ACTIONS:
+            # consumed synchronously by the bus write that fired this
+            # hit — the armed action applies to THAT row
+            print(f"fault_injection: arming mon:{r.action} at {tag}",
+                  file=sys.stderr, flush=True)
+            self.mon_events.append(r.action)
             return
         if r.action == "desync":
             target = int(r.arg) if r.arg else 0
@@ -283,6 +310,18 @@ def consume_serve_events() -> List:
         return []
     out, inj.serve_events = inj.serve_events, []
     return out
+
+
+def consume_mon_action() -> Optional[str]:
+    """Fire the ``mon`` site for this bus-row write and consume any
+    armed ``drop`` / ``dup`` action; returns the action name for the
+    CURRENT row (the rule fires and is consumed within one write), or
+    None for a clean row."""
+    fault_point("mon")
+    inj = _active
+    if inj is None or not inj.mon_events:
+        return None
+    return inj.mon_events.pop(0)
 
 
 def consume_grad_action() -> int:

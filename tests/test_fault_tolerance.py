@@ -29,6 +29,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HELPERS = os.path.join(REPO, "tests", "helpers")
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _restore_hybrid_mesh():
+    """ISSUE 14 satellite: TestExtrasRoundTrip's `fleet.init` installs
+    a dp hybrid mesh that used to OUTLIVE this module — an adjacent
+    `test_decoder_hot_path` run then saw a multi-device mesh in the
+    flash-routing policy and `flash_routable` declined shapes it
+    routes on the expected trivial mesh (order-dependent outside the
+    tier-1 ordering, present since PR-11 HEAD). Restore the prior mesh
+    when the module finishes — the same module-autouse pattern PR 7
+    added to test_decoder_hot_path/test_pallas_flash."""
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    yield
+    comm._state.hybrid_mesh = prev
+
+
 def _clean_env():
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("PADDLE_", "JAX_", "XLA_"))}

@@ -658,6 +658,94 @@ class TestBenchContinuity:
         rc, lines = bc.check(str(tmp_path))
         assert rc == 0, "\n".join(lines)
 
+    # -- MULTICHIP compile-time drift: report-only -> GATED (ISSUE 14
+    # satellite, the ROADMAP item-2 carry-over) -------------------------
+    def _write_multichip_pair(self, tmp_path, prev_phases, cur_phases,
+                              **cur_top):
+        import json
+
+        def tail(phases):
+            return "\n".join(
+                f"dryrun_multichip(8): {name} loss=2.5000 "
+                f"compile_s={v} OK" for name, v in phases.items())
+
+        for n, phases, top in (("04", prev_phases, {}),
+                               ("05", cur_phases, cur_top)):
+            rec = {"n_devices": 8, "rc": 0, "ok": True,
+                   "tail": tail(phases)}
+            rec.update(top)
+            (tmp_path / f"MULTICHIP_r{n}.json").write_text(
+                json.dumps(rec))
+
+    def test_compile_drift_within_budget_passes(self, tmp_path):
+        bc = self._tool()
+        self._write_multichip_pair(
+            tmp_path, {"dp8xmp2 TrainStep": 10.0},
+            {"dp8xmp2 TrainStep": 12.0})
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 0, "\n".join(lines)
+        assert any("ok      compile_s[dp8xmp2 TrainStep]" in l
+                   for l in lines)
+
+    def test_unannotated_compile_regression_fails(self, tmp_path):
+        bc = self._tool()
+        self._write_multichip_pair(
+            tmp_path, {"dp8xmp2 TrainStep": 10.0, "dp GPT": 5.0},
+            {"dp8xmp2 TrainStep": 14.0, "dp GPT": 5.1})
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 1, "\n".join(lines)
+        assert any("REGRESS compile_s[dp8xmp2 TrainStep]" in l
+                   for l in lines)
+        assert any("FAIL" in l for l in lines)
+
+    def test_compile_regression_waived_by_note_or_declaration(
+            self, tmp_path):
+        bc = self._tool()
+        # phase named in the MULTICHIP note — same mechanism as the
+        # perf gate's extra.note
+        self._write_multichip_pair(
+            tmp_path, {"dp8xmp2 TrainStep": 10.0},
+            {"dp8xmp2 TrainStep": 14.0},
+            note="dp8xmp2 TrainStep compile grew: zero1 padding "
+                 "constraint added this round")
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 0, "\n".join(lines)
+        assert any("waived  compile_s[dp8xmp2 TrainStep]" in l
+                   for l in lines)
+        # whole-record incomparable declaration
+        self._write_multichip_pair(
+            tmp_path, {"dp8xmp2 TrainStep": 10.0},
+            {"dp8xmp2 TrainStep": 20.0},
+            incomparable_to_prev="xla version bumped")
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 0, "\n".join(lines)
+
+    def test_compile_prefix_sibling_annotation_does_not_waive(
+            self, tmp_path):
+        """Whole-name matching, like the perf gate: a note naming
+        'dp GPT flash' must NOT waive its prefix sibling 'dp GPT'."""
+        bc = self._tool()
+        self._write_multichip_pair(
+            tmp_path,
+            {"dp GPT": 10.0, "dp GPT flash": 10.0},
+            {"dp GPT": 14.0, "dp GPT flash": 14.0},
+            note="dp GPT flash: new flash kernel this round")
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 1, "\n".join(lines)
+        assert any("REGRESS compile_s[dp GPT]" in l for l in lines)
+        assert any("waived  compile_s[dp GPT flash]" in l
+                   for l in lines)
+
+    def test_new_phase_stays_report_only(self, tmp_path):
+        bc = self._tool()
+        self._write_multichip_pair(
+            tmp_path, {"dp GPT": 5.0},
+            {"dp GPT": 5.0, "dp16xmp2 flash": 30.0})
+        rc, lines = bc.check(str(tmp_path))
+        assert rc == 0, "\n".join(lines)
+        assert any("report  compile_s[dp16xmp2 flash]" in l and
+                   "(new)" in l for l in lines)
+
     def test_improvements_and_small_deltas_pass(self, tmp_path):
         bc = self._tool()
         self._write_pair(

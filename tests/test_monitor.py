@@ -1,0 +1,738 @@
+"""Live fleet monitor (ISSUE 14): incremental cursors, online
+percentile digests, straggler ranking, incident correlation,
+request-scoped tracing, and the launcher-embedded / standalone modes.
+
+Layers:
+- pure in-process tests over synthetic multi-rank streams (no jax):
+  cursor resume after torn lines / truncation / rotation, histogram
+  percentiles vs a numpy reference + merge associativity, leave-one-out
+  skew ranking with the persistent-straggler window, incident windowing
+  and causal-chain ordering, `mon:drop/dup` bus-line faults;
+- a launcher-driven jax-free 2-process dryrun where a
+  `serve:straggler` fault is NAMED in the embedded monitor's snapshot
+  and `incident` row before the manager returns, and the standalone
+  CLI reproduces the same verdict from the obs dir alone;
+- a router E2E over a real engine: ONE trace_id threads
+  router_submit -> engine admit/prefill/decode-window/retire ->
+  decode_request with monotone span timestamps, and tracing adds ZERO
+  device reads (counted-np.asarray, metrics on vs off).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_monitor():
+    """The monitor module STANDALONE (stdlib-pure contract: loadable
+    without the package, exactly how a login node would)."""
+    import importlib.util
+
+    path = os.path.join(REPO, "paddle_tpu", "observability",
+                        "monitor.py")
+    spec = importlib.util.spec_from_file_location("_t_mon", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+mon = _load_monitor()
+
+
+def _row(rank, kind, step=None, t=None, **payload):
+    return {"v": 1, "kind": kind, "step": step,
+            "time": time.time() if t is None else t, "rank": rank,
+            "payload": payload}
+
+
+def _append(path, rows, newline=True):
+    with open(path, "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + ("\n" if newline else ""))
+
+
+def _stream(tmp_path, rank):
+    return str(tmp_path / f"telemetry.rank{rank}.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# cursor
+# ---------------------------------------------------------------------------
+
+
+class TestStreamCursor:
+    def test_incremental_and_torn_line(self, tmp_path):
+        p = _stream(tmp_path, 0)
+        c = mon.StreamCursor(p)
+        assert c.poll() == []  # missing file: quiet
+        _append(p, [_row(0, "a", step=1)])
+        assert [r["kind"] for r in c.poll()] == ["a"]
+        assert c.poll() == []  # nothing new
+        # a torn trailing line stays unread until its newline lands
+        with open(p, "a") as f:
+            f.write('{"v": 1, "kind": "torn_b", "time": 1.0, "ran')
+        assert c.poll() == []
+        with open(p, "a") as f:
+            f.write('k": 0, "step": 2, "payload": {}}\n')
+        assert [r["kind"] for r in c.poll()] == ["torn_b"]
+
+    def test_corrupt_line_mid_stream_skipped(self, tmp_path):
+        p = _stream(tmp_path, 0)
+        with open(p, "w") as f:
+            f.write(json.dumps(_row(0, "ok1")) + "\n")
+            f.write("%% not json %%\n")
+            f.write(json.dumps(_row(0, "ok2")) + "\n")
+        c = mon.StreamCursor(p)
+        assert [r["kind"] for r in c.poll()] == ["ok1", "ok2"]
+
+    def test_resume_after_truncation(self, tmp_path):
+        p = _stream(tmp_path, 0)
+        c = mon.StreamCursor(p)
+        _append(p, [_row(0, "a"), _row(0, "b")])
+        assert len(c.poll()) == 2
+        # rotation-in-place: the file restarts SHORTER than the cursor
+        with open(p, "w") as f:
+            f.write(json.dumps(_row(0, "fresh")) + "\n")
+        assert [r["kind"] for r in c.poll()] == ["fresh"]
+        _append(p, [_row(0, "after")])
+        assert [r["kind"] for r in c.poll()] == ["after"]
+
+
+# ---------------------------------------------------------------------------
+# log-histogram digests
+# ---------------------------------------------------------------------------
+
+
+class TestLogHistogram:
+    def test_percentiles_vs_numpy(self):
+        rng = np.random.RandomState(7)
+        vals = rng.lognormal(mean=2.5, sigma=1.2, size=8000)
+        h = mon.LogHistogram()
+        for v in vals:
+            h.add(float(v))
+        for q in (10, 50, 90, 99):
+            ref = float(np.percentile(vals, q))
+            got = h.percentile(q)
+            # bin width at 32 bins/decade bounds the relative error
+            assert abs(got - ref) / ref < 0.05, (q, got, ref)
+        s = h.summary()
+        assert s["count"] == len(vals)
+        assert abs(s["mean"] - vals.mean()) / vals.mean() < 1e-6
+        assert s["max"] == round(vals.max(), 4)
+
+    def test_merge_equals_concat(self):
+        rng = np.random.RandomState(8)
+        a = rng.lognormal(1.0, 0.7, 3000)
+        b = rng.lognormal(3.0, 0.4, 2000)
+        ha, hb, hall = (mon.LogHistogram(), mon.LogHistogram(),
+                        mon.LogHistogram())
+        for v in a:
+            ha.add(float(v))
+            hall.add(float(v))
+        for v in b:
+            hb.add(float(v))
+            hall.add(float(v))
+        ha.merge(hb)
+        assert ha.n == hall.n
+        for q in (50, 99):
+            assert ha.percentile(q) == hall.percentile(q)
+
+    def test_merge_rejects_different_geometry(self):
+        with pytest.raises(ValueError, match="geometry"):
+            mon.LogHistogram().merge(
+                mon.LogHistogram(bins_per_decade=8))
+
+    def test_tails_clamped_to_observed_extremes(self):
+        h = mon.LogHistogram()
+        for v in (5.0, 5.0, 5.0):
+            h.add(v)
+        assert h.percentile(0) >= 5.0 - 1e-9
+        assert h.percentile(100) <= 5.0 + 1e-9
+        assert mon.LogHistogram().percentile(50) is None
+
+    def test_garbage_values_ignored(self):
+        h = mon.LogHistogram()
+        h.add(float("nan"))
+        h.add(-3.0)
+        h.add("x")
+        assert h.n == 0
+
+
+# ---------------------------------------------------------------------------
+# skew / straggler ranking
+# ---------------------------------------------------------------------------
+
+
+def _feed_steps(m, tmp_path, per_rank_ms, windows, t0=None):
+    """Interleave `windows` step_metrics rows per rank and poll after
+    each window (the live-arrival shape)."""
+    t0 = time.time() if t0 is None else t0
+    for w in range(windows):
+        for rank, ms in per_rank_ms.items():
+            _append(_stream(tmp_path, rank),
+                    [_row(rank, "step_metrics", step=w,
+                          t=t0 + w * 0.01, step_ms=ms)])
+        m.poll()
+
+
+class TestStragglerRanking:
+    def test_persistent_laggard_named_after_n_windows(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path), straggler_n=3, z_thresh=3.0,
+                             window_s=0.5)
+        _feed_steps(m, tmp_path, {0: 10.0, 1: 10.2, 2: 10.1, 3: 240.0},
+                    windows=2)
+        snap = m.snapshot_dict()
+        assert snap["stragglers"] == []  # 2 windows < N=3: not yet
+        _feed_steps(m, tmp_path, {0: 10.0, 1: 10.2, 2: 10.1, 3: 240.0},
+                    windows=2)
+        snap = m.snapshot_dict()
+        assert snap["stragglers"] == [3]
+        rv = snap["ranks"]["3"]
+        assert rv["straggler"] and rv["z"] > 3.0
+        # the slowest-ranks ranking leads with the straggler
+        assert snap["slowest"][0][0] == 3
+        # ...and the snapshot text NAMES it
+        assert "straggler: rank 3" in m.snapshot_text(snap)
+
+    def test_healthy_fleet_stays_unflagged(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path), straggler_n=2, z_thresh=3.0)
+        _feed_steps(m, tmp_path, {0: 10.0, 1: 10.4, 2: 9.8, 3: 10.2},
+                    windows=6)
+        assert m.snapshot_dict()["stragglers"] == []
+
+    def test_recovered_rank_unflagged(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path), straggler_n=2, z_thresh=3.0,
+                             window_s=0.2)
+        _feed_steps(m, tmp_path, {0: 10.0, 1: 300.0}, windows=4)
+        assert m.snapshot_dict()["stragglers"] == [1]
+        # EWMA back to fleet speed -> the flag clears
+        _feed_steps(m, tmp_path, {0: 10.0, 1: 10.0}, windows=24)
+        assert m.snapshot_dict()["stragglers"] == []
+
+    def test_catchup_poll_names_first_stream_straggler(self, tmp_path):
+        """Post-hoc analysis (`--once` over a finished dir) reads every
+        stream in ONE poll; rows must be merged by emit time before
+        ingestion, or the first-ingested rank's z-scores would all be
+        computed against an empty fleet and rank 0 could never be
+        named — the CLI must reproduce the embedded verdict."""
+        t0 = time.time()
+        for w in range(6):  # whole finished streams, rank 0 straggling
+            _append(_stream(tmp_path, 0),
+                    [_row(0, "step_metrics", step=w, t=t0 + w * 0.01,
+                          step_ms=300.0)])
+        for w in range(6):
+            _append(_stream(tmp_path, 1),
+                    [_row(1, "step_metrics", step=w,
+                          t=t0 + w * 0.01 + 0.001, step_ms=10.0)])
+        m = mon.FleetMonitor(str(tmp_path), straggler_n=3, z_thresh=3.0)
+        m.poll()
+        snap = m.snapshot_dict()
+        assert snap["stragglers"] == [0]
+
+    def test_step_front_skew(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path))
+        _append(_stream(tmp_path, 0),
+                [_row(0, "step_metrics", step=17, step_ms=10.0)])
+        _append(_stream(tmp_path, 1),
+                [_row(1, "step_metrics", step=3, step_ms=10.0)])
+        m.poll()
+        sf = m.snapshot_dict()["step_front"]
+        assert (sf["min"], sf["max"], sf["skew"]) == (3, 17, 14)
+
+
+# ---------------------------------------------------------------------------
+# incident correlation
+# ---------------------------------------------------------------------------
+
+
+class TestIncidentCorrelation:
+    def test_cooccurring_events_fold_into_one_incident(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path), window_s=0.3)
+        t0 = time.time()
+        _append(_stream(tmp_path, 3),
+                [_row(3, "recompile_storm", step=40, t=t0,
+                      detail="args[2].shape changing")])
+        _append(_stream(tmp_path, 0),
+                [_row(0, "coll_timeout", step=40, t=t0 + 0.05,
+                      op="all_reduce", seq=5)])
+        _append(_stream(tmp_path, 1),
+                [_row(1, "guard_skip", step=41, t=t0 + 0.1,
+                      detail="grads nonfinite")])
+        m.poll()
+        assert m.correlator.open is not None
+        time.sleep(0.35)
+        m.poll()  # quiet window elapsed: the incident closes
+        assert len(m.correlator.closed) == 1
+        inc = m.correlator.closed[0]
+        assert inc["ranks"] == [0, 1, 3]
+        # causal chain ordered by event time, not arrival
+        assert inc["chain"].index("rank 3 recompile_storm") \
+            < inc["chain"].index("rank 0 coll_timeout") \
+            < inc["chain"].index("rank 1 guard_skip")
+
+    def test_catchup_poll_keeps_distant_events_separate(self, tmp_path):
+        """One catch-up poll over a finished run must NOT merge notable
+        events hours apart (on their own emit clocks) into one causal
+        chain — correlation is on event time, ingest time only bounds
+        staleness."""
+        t0 = time.time() - 7200
+        _append(_stream(tmp_path, 0),
+                [_row(0, "guard_skip", t=t0, detail="nan grads"),
+                 _row(0, "coll_timeout", t=t0 + 7200, op="all_reduce",
+                      seq=9)])
+        m = mon.FleetMonitor(str(tmp_path), window_s=5.0)
+        m.poll()
+        m.finalize()
+        assert len(m.correlator.closed) == 2
+        chains = [c["chain"] for c in m.correlator.closed]
+        assert not any("guard_skip" in c and "coll_timeout" in c
+                       for c in chains)
+
+    def test_separated_events_make_separate_incidents(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path), window_s=0.15)
+        _append(_stream(tmp_path, 0),
+                [_row(0, "guard_skip", detail="first")])
+        m.poll()
+        time.sleep(0.3)
+        m.poll()  # closes #1
+        _append(_stream(tmp_path, 0),
+                [_row(0, "coll_desync", op="all_gather")])
+        m.poll()
+        m.finalize()
+        assert len(m.correlator.closed) == 2
+
+    def test_routine_rows_are_not_notable(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path), window_s=0.1)
+        _append(_stream(tmp_path, 0), [
+            _row(0, "step_metrics", step=1, step_ms=9.0),
+            _row(0, "recompile", compile_wall_s=1.0),
+            _row(0, "router_admit", outcome="admitted", host=0),
+            _row(0, "decode_metrics", queue_depth=1),
+        ])
+        m.poll()
+        m.finalize()
+        assert m.correlator.closed == []
+        assert m.snapshot_dict()["ranks"]["0"]["recompiles"] == 1
+
+    def test_emitted_incident_row_and_no_self_feedback(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path), window_s=0.1, emit=True)
+        _append(_stream(tmp_path, 2),
+                [_row(2, "guard_abort", detail="divergence")])
+        m.poll()
+        m.finalize()
+        launcher = str(tmp_path / "telemetry.launcher.jsonl")
+        rows = [json.loads(l) for l in open(launcher)]
+        incs = [r for r in rows if r["kind"] == "incident"]
+        assert len(incs) == 1 and incs[0]["rank"] == -1
+        assert "rank 2 guard_abort" in incs[0]["payload"]["chain"]
+        # a second monitor over the SAME dir must not re-ingest the
+        # incident row as a fresh notable event
+        m2 = mon.FleetMonitor(str(tmp_path), window_s=0.1)
+        m2.poll()
+        m2.finalize()
+        assert len(m2.correlator.closed) == 1  # the guard event only
+
+    def test_incident_context_for_attribution(self, tmp_path):
+        m = mon.FleetMonitor(str(tmp_path), window_s=5.0)
+        _append(_stream(tmp_path, 1),
+                [_row(1, "coll_timeout", op="all_reduce", seq=9)])
+        m.poll()
+        assert "rank 1 coll_timeout" in m.incident_context(1)
+        # a FRESH incident on another rank is still offered (cross-rank
+        # causality is the point)...
+        assert m.incident_context(0) is not None
+        # ...but a stale one never is: an hour-old chain would be a
+        # false causal attribution for a fresh kill
+        assert m.incident_context(0, within_s=0.0) is None
+        assert m.incident_context(1, within_s=0.0) is None
+
+    def test_displaced_stale_incident_still_published(self, tmp_path):
+        """An open incident whose quiet window elapses BETWEEN ticks is
+        closed by the next notable event's add() — it must still get
+        its bus row, not just a correlator.closed entry."""
+        m = mon.FleetMonitor(str(tmp_path), window_s=0.2, emit=True)
+        _append(_stream(tmp_path, 0),
+                [_row(0, "guard_skip", detail="first")])
+        m.poll()
+        time.sleep(0.3)  # window elapses with NO tick in between
+        _append(_stream(tmp_path, 0),
+                [_row(0, "coll_desync", op="all_gather")])
+        m.poll()  # ingestion displaces the stale open incident
+        m.finalize()
+        launcher = str(tmp_path / "telemetry.launcher.jsonl")
+        rows = [json.loads(l) for l in open(launcher)]
+        chains = [r["payload"]["chain"] for r in rows
+                  if r["kind"] == "incident"]
+        assert len(chains) == 2, chains
+        assert any("guard_skip" in c for c in chains)
+        assert any("coll_desync" in c for c in chains)
+
+
+# ---------------------------------------------------------------------------
+# mon-site bus-line faults (drop/dup) + serve:straggler grammar
+# ---------------------------------------------------------------------------
+
+
+class TestMonFaultSite:
+    @pytest.fixture(autouse=True)
+    def _clean(self, monkeypatch):
+        from paddle_tpu.utils import fault_injection as fi
+
+        monkeypatch.delenv("PADDLE_FAULT_SPEC", raising=False)
+        fi.reset()
+        yield
+        fi.reset()
+
+    def test_grammar(self):
+        from paddle_tpu.utils.fault_injection import FaultInjector
+
+        FaultInjector("mon:drop:2")
+        FaultInjector("mon:dup:1")
+        FaultInjector("serve:straggler:1:2")
+        with pytest.raises(ValueError, match="bus-line sites"):
+            FaultInjector("io.save:drop:1")
+        with pytest.raises(ValueError, match="serving-event sites"):
+            FaultInjector("coll:straggler:1")
+
+    def test_drop_and_dup_on_the_bus(self, tmp_path, monkeypatch):
+        from paddle_tpu.observability import bus
+        from paddle_tpu.utils import fault_injection as fi
+
+        f = str(tmp_path / "bus.jsonl")
+        monkeypatch.setenv("PADDLE_OBS_BUS_FILE", f)
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "mon:drop:2,mon:dup:3")
+        fi.reset()
+        bus.reset()
+        for i in range(4):
+            bus.emit("tick", {"i": i})
+        kinds = [(r["payload"]["i"]) for r in bus.read_stream(f)]
+        # row 1 dropped, row 2 duplicated
+        assert kinds == [0, 2, 2, 3]
+
+    def test_monitor_survives_lossy_stream(self, tmp_path, monkeypatch):
+        """Drop + duplicate bus lines under the monitor's cursor: counts
+        shift but nothing corrupts and percentiles stay sane."""
+        from paddle_tpu.observability import bus
+        from paddle_tpu.utils import fault_injection as fi
+
+        f = str(tmp_path / "telemetry.rank0.jsonl")
+        monkeypatch.setenv("PADDLE_OBS_BUS_FILE", f)
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "mon:drop:3,mon:dup:5")
+        fi.reset()
+        bus.reset()
+        m = mon.FleetMonitor(str(tmp_path))
+        for i in range(8):
+            bus.emit("step_metrics", {"step_ms": 10.0}, step=i)
+            m.poll()
+        s = m.snapshot_dict()["ranks"]["0"]["step_ms"]
+        assert s["count"] == 8  # 8 emits - 1 dropped + 1 duplicated
+        assert abs(s["p50"] - 10.0) / 10.0 < 0.05
+
+
+# ---------------------------------------------------------------------------
+# snapshots + standalone CLI
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotAndCli:
+    def _seed_dir(self, tmp_path):
+        t0 = time.time()
+        for w in range(6):
+            _append(_stream(tmp_path, 0),
+                    [_row(0, "step_metrics", step=w, t=t0 + w,
+                          step_ms=10.0)])
+            _append(_stream(tmp_path, 1),
+                    [_row(1, "step_metrics", step=w, t=t0 + w,
+                          step_ms=300.0)])
+        _append(_stream(tmp_path, 0),
+                [_row(0, "decode_request", t=t0 + 6, rid="r1",
+                      tokens=8, latency_ms=80.0, prefill_ms=10.0,
+                      ms_per_token=10.0, ttft_ms=12.0)])
+
+    def test_snapshot_files_written_on_cadence(self, tmp_path):
+        self._seed_dir(tmp_path)
+        m = mon.FleetMonitor(str(tmp_path), emit=True,
+                             snapshot_every=0.01, straggler_n=2)
+        m.poll()
+        time.sleep(0.02)
+        assert m.maybe_snapshot() is not None
+        txt = (tmp_path / "monitor.status.txt").read_text()
+        assert "straggler: rank 1" in txt
+        snap = json.loads(
+            (tmp_path / "monitor.snapshot.json").read_text())
+        assert snap["stragglers"] == [1]
+        assert snap["digests"]["ttft_ms"]["count"] == 1
+        # read-only monitors never write
+        m2 = mon.FleetMonitor(str(tmp_path), emit=False,
+                              snapshot_every=0.01)
+        m2.poll()
+        before = set(os.listdir(tmp_path))
+        m2.write_snapshot()
+        assert set(os.listdir(tmp_path)) == before
+
+    def test_cli_once_json(self, tmp_path, capsys):
+        self._seed_dir(tmp_path)
+        rc = mon.main(["--obs_dir", str(tmp_path), "--once", "--json"])
+        assert rc == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert set(snap["ranks"]) == {"0", "1"}
+        assert snap["digests"]["step_ms"]["count"] == 12
+
+    def test_cli_bad_dir_rc(self, tmp_path):
+        assert mon.main(["--obs_dir", str(tmp_path / "nope"),
+                         "--once"]) == 2
+
+    def test_package_entrypoint(self, tmp_path):
+        """`python -m paddle_tpu.observability.monitor` — the
+        documented standalone spelling."""
+        self._seed_dir(tmp_path)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability.monitor",
+             "--obs_dir", str(tmp_path), "--once"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "fleet monitor @" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# launcher-embedded dryrun: injected straggler NAMED before exit
+# ---------------------------------------------------------------------------
+
+
+class TestEmbeddedDryrun:
+    @pytest.fixture(autouse=True)
+    def _clean(self, monkeypatch):
+        from paddle_tpu.utils import fault_injection as fi
+
+        for k in ("PADDLE_FAULT_SPEC", "PADDLE_OBS_DIR",
+                  "PADDLE_MON", "PADDLE_MON_SNAPSHOT_EVERY",
+                  "PADDLE_MON_POLL", "PADDLE_MON_STRAGGLER_N",
+                  "PADDLE_MON_WINDOW"):
+            monkeypatch.delenv(k, raising=False)
+        fi.reset()
+        yield
+        fi.reset()
+
+    def test_straggler_named_in_incident_and_snapshot(
+            self, tmp_path, monkeypatch):
+        """Two jax-free router workers under the elastic launcher;
+        `serve:straggler:1:1` delays rank 1's windows. The EMBEDDED
+        monitor (rank -1) must flag rank 1 from telemetry alone and
+        emit an `incident` row BEFORE launch() returns; the standalone
+        CLI must reproduce the verdict from the obs dir."""
+        from paddle_tpu.distributed.launch import launch
+
+        logs = str(tmp_path / "logs")
+        base = str(tmp_path / "mail")
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "serve:straggler:1:1")
+        monkeypatch.setenv("PADDLE_MON_SNAPSHOT_EVERY", "0.5")
+        monkeypatch.setenv("PADDLE_MON_POLL", "0.1")
+        monkeypatch.setenv("PADDLE_MON_STRAGGLER_N", "3")
+        monkeypatch.setenv("PADDLE_MON_WINDOW", "1.0")
+        from paddle_tpu.utils import fault_injection as fi
+
+        fi.reset()
+        rc_box = {}
+
+        def run():
+            rc_box["rc"] = launch(
+                os.path.join(REPO, "paddle_tpu", "serving", "router.py"),
+                [REPO, base, "600", "0.02"],
+                nproc_per_node=2, backend="cpu", log_dir=logs)
+
+        t = threading.Thread(target=run)
+        t.start()
+        # let the straggler accumulate windows, then stop the workers
+        time.sleep(6.0)
+        os.makedirs(base, exist_ok=True)
+        open(os.path.join(base, "stop"), "w").close()
+        t.join(timeout=60)
+        assert rc_box.get("rc") == 0
+        launcher = os.path.join(logs, "telemetry.launcher.jsonl")
+        rows = [json.loads(l) for l in open(launcher)]
+        incs = [r for r in rows if r["kind"] == "incident"]
+        assert incs, "no incident row before manager exit"
+        chains = " | ".join(r["payload"]["chain"] for r in incs)
+        assert "rank 1 straggler" in chains       # the offender, named
+        assert "rank 0 straggler" not in chains   # the healthy rank not
+        # the periodic snapshot named the rank too
+        status = open(os.path.join(logs, "monitor.status.txt")).read()
+        assert "straggler: rank 1" in status
+        # standalone CLI over the finished dir: same verdict
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        env.pop("PADDLE_FAULT_SPEC", None)
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "paddle_tpu", "observability",
+                          "monitor.py"),
+             "--obs_dir", logs, "--once", "--json"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert out.returncode == 0, out.stderr
+        snap = json.loads(out.stdout)
+        assert snap["stragglers"] == [1]
+        assert snap["ranks"]["1"]["step_ms_ewma"] > \
+            10 * snap["ranks"]["0"]["step_ms_ewma"]
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing through a REAL engine (router E2E)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def trivial_mesh():
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    comm._state.hybrid_mesh = None
+    comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+def _tiny_lm(vocab=48, cap=64, layers=2, heads=4, d=32, seed=7):
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import TransformerLM
+
+    paddle.seed(seed)
+    m = TransformerLM(vocab, d_model=d, num_heads=heads,
+                      num_layers=layers, max_position=cap)
+    m.eval()
+    return m
+
+
+class TestRequestTracing:
+    def test_one_trace_id_end_to_end_monotone(self, tmp_path,
+                                              trivial_mesh,
+                                              monkeypatch):
+        """ONE trace_id appears in router, engine-span, and
+        decode_request rows, with monotone span timestamps — one
+        request's life, renderable by tools/timeline.py."""
+        from paddle_tpu.observability import bus
+        from paddle_tpu.serving import (
+            InferenceEngine, LocalHost, Request, Router,
+        )
+
+        f = str(tmp_path / "bus.jsonl")
+        monkeypatch.setenv("PADDLE_OBS_BUS_FILE", f)
+        bus.reset()
+        engine = InferenceEngine(_tiny_lm(), slots=2, max_length=64,
+                                 sync_every=4)
+        host = LocalHost(engine)
+        router = Router([host])
+        reqs = [Request(np.asarray([3, 4, 5], np.int32),
+                        max_new_tokens=6, rid=f"r{i}")
+                for i in range(3)]
+        for r in reqs:
+            assert router.submit(r) == 0
+        host.drain()
+        bus.reset()
+        tid = reqs[0].trace_id
+        assert tid and all(r.trace_id for r in reqs)
+        assert len({r.trace_id for r in reqs}) == 3  # unique per req
+        rows = bus.read_stream(f)
+        mine = [r for r in rows if
+                (r["payload"].get("trace_id") == tid
+                 or tid in (r["payload"].get("trace_ids") or []))]
+        names = [r["payload"].get("name", r["kind"]) for r in mine]
+        # the full life: root span -> engine phases -> terminal row
+        assert names[0] == "router_submit"
+        for phase in ("admit", "prefill", "decode_window", "retire"):
+            assert phase in names, names
+        assert mine[-1]["kind"] == "decode_request"
+        times = [r["time"] for r in mine]
+        assert times == sorted(times), "span timestamps not monotone"
+        # timeline renders the trace with per-phase attribution
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_t_timeline", os.path.join(REPO, "tools", "timeline.py"))
+        tl = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tl)
+        spans = tl.trace_spans({0: rows}, tid)
+        assert [s["name"] for s in spans] == names
+        text = "\n".join(tl.format_trace(spans, tid))
+        assert "router_submit" in text and "retire" in text
+
+    def test_untraced_engine_requests_emit_no_spans(self, tmp_path,
+                                                    trivial_mesh,
+                                                    monkeypatch):
+        from paddle_tpu.observability import bus
+        from paddle_tpu.serving import InferenceEngine, Request
+
+        f = str(tmp_path / "bus.jsonl")
+        monkeypatch.setenv("PADDLE_OBS_BUS_FILE", f)
+        bus.reset()
+        engine = InferenceEngine(_tiny_lm(), slots=2, max_length=64,
+                                 sync_every=4)
+        engine.submit(Request(np.asarray([3, 4], np.int32),
+                              max_new_tokens=4))
+        engine.run()
+        bus.reset()
+        rows = bus.read_stream(f)
+        assert all(r["kind"] != "span" for r in rows)
+        dr = [r for r in rows if r["kind"] == "decode_request"]
+        assert dr and all("trace_id" not in r["payload"] for r in dr)
+
+    def test_tracing_adds_zero_device_reads(self, tmp_path,
+                                            trivial_mesh, monkeypatch):
+        """Counted-np.asarray contract: span rows are built from host
+        values the engine already holds — traced-and-metered vs
+        metrics-off makes a BITWISE-equal number of device reads."""
+        import jax
+
+        from paddle_tpu.observability import bus
+        from paddle_tpu.serving import InferenceEngine, Request
+
+        m = _tiny_lm()
+
+        def reads(traced):
+            if traced:
+                monkeypatch.setenv("PADDLE_OBS_BUS_FILE",
+                                   str(tmp_path / "on.jsonl"))
+                monkeypatch.setenv("PADDLE_OBS_DECODE_METRICS", "1")
+            else:
+                monkeypatch.delenv("PADDLE_OBS_BUS_FILE",
+                                   raising=False)
+                monkeypatch.setenv("PADDLE_OBS_DECODE_METRICS", "0")
+            bus.reset()
+            e = InferenceEngine(m, slots=2, max_length=64, sync_every=4)
+            for i in range(3):
+                e.submit(Request(np.asarray([4, 5, 6], np.int32),
+                                 max_new_tokens=6, rid=i,
+                                 trace_id=f"t-{i}" if traced else None))
+            counted = {"n": 0}
+            real = np.asarray
+
+            def counting(a, *args, **kw):
+                if isinstance(a, jax.Array):
+                    counted["n"] += 1
+                return real(a, *args, **kw)
+
+            monkeypatch.setattr(np, "asarray", counting)
+            try:
+                e.run()
+            finally:
+                monkeypatch.setattr(np, "asarray", real)
+            bus.reset()
+            return counted["n"]
+
+        reads(False)  # warm the compile caches
+        n_traced, n_off = reads(True), reads(False)
+        assert n_traced == n_off
+        # and the traced run actually produced span rows
+        rows = [json.loads(l)
+                for l in open(tmp_path / "on.jsonl")]
+        assert any(r["kind"] == "span" for r in rows)

@@ -512,11 +512,18 @@ if rank == 1:
         timeline = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(timeline)
         streams, _, trace, lines = timeline.merge(log_dir)
-        assert set(streams) == {0, 1}
+        # round 14: the launcher's EMBEDDED fleet monitor adds its own
+        # rank −1 stream next to the per-rank ones
+        assert set(streams) == {0, 1, -1}
         assert {e.get("pid") for e in trace["traceEvents"]} >= {0, 1}
         text = "\n".join(lines)
         assert "slowest ranks: rank 1" in text
         assert "guard events: 1" in text
+        # ...and the guard trip was folded into an incident row before
+        # the manager returned (the live-detection acceptance pin)
+        incs = [r for r in streams[-1] if r["kind"] == "incident"]
+        assert incs and "rank 1 guard_skip" in \
+            incs[-1]["payload"]["chain"]
 
 
 # ---------------------------------------------------------------------------

@@ -220,14 +220,19 @@ class TestHostSyncInStep:
 
     def test_real_observability_emitters_quiet(self):
         """The shipped emitters (guard monitor, comm monitor, metrics
-        sampler) emit from host-side code only — the full-module sweep
-        of the new surface stays clean."""
+        sampler, fleet monitor, span-emitting engine/router) emit from
+        host-side code only — the full-module sweep of the new surface
+        stays clean."""
         findings, errors = lint_core.run(
             [os.path.join(REPO, "paddle_tpu", "observability", "bus.py"),
              os.path.join(REPO, "paddle_tpu", "observability",
                           "metrics.py"),
              os.path.join(REPO, "paddle_tpu", "observability",
                           "ledger.py"),
+             os.path.join(REPO, "paddle_tpu", "observability",
+                          "monitor.py"),
+             os.path.join(REPO, "paddle_tpu", "serving", "engine.py"),
+             os.path.join(REPO, "paddle_tpu", "serving", "router.py"),
              os.path.join(REPO, "paddle_tpu", "utils", "train_guard.py"),
              os.path.join(REPO, "paddle_tpu", "distributed",
                           "comm_monitor.py")],
@@ -235,6 +240,73 @@ class TestHostSyncInStep:
         )
         assert not errors
         assert not names(findings, "host-sync-in-step")
+
+    # ISSUE 14 satellite: the span/trace emit helpers join the emit
+    # list — a trace emit inside a compiled DecodeStep body fires per
+    # COMPILE with tracer reprs; the engine publishes spans on its
+    # readback cadence from host values.
+    TRACE_PRE_FIX = """
+        import jax
+        from paddle_tpu.observability import bus
+
+        class DecodeStep:
+            def _step_fn(self, state):
+                tok = state[0] + 1
+                bus.emit_span("decode_token", "t1", {"tok": tok})
+                self._metrics.span("decode", trace_id="t1", tok=tok)
+                return tok
+
+            def __call__(self, state):
+                return jax.jit(self._step_fn)(state)
+    """
+    TRACE_FIXED = """
+        import jax
+        import numpy as np
+
+        class DecodeStep:
+            def _step_fn(self, state):
+                return state[0] + 1
+
+        class Engine:
+            def run(self):
+                for _ in range(16):
+                    self.state = self._decode(self.state)
+                block = np.asarray(self.state)  # THE readback
+                self._metrics.window_span(["t1"], steps=16)
+                self._metrics.span("retire", trace_id="t1",
+                                   tokens=int(block[0]))
+    """
+
+    def test_span_emit_in_decode_step_flagged(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.TRACE_PRE_FIX},
+                      rule="host-sync-in-step")
+        msgs = [f.message for f in names(fs, "host-sync-in-step")]
+        assert any("bus.emit_span" in m for m in msgs), msgs
+        assert any("_metrics.span" in m for m in msgs), msgs
+
+    def test_span_emit_on_readback_cadence_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.TRACE_FIXED},
+                      rule="host-sync-in-step")
+        assert not names(fs, "host-sync-in-step")
+
+    def test_unqualified_span_method_not_flagged(self, tmp_path):
+        """`.span(...)` is a generic name: without a metrics/sampler/
+        bus qualifier it must NOT count as a telemetry emit even
+        inside a compiled body (a tensor `.span()` helper is not the
+        bus API)."""
+        src = """
+            import jax
+
+            class TrainStep:
+                def _step_fn(self, x):
+                    return self.interval.span(x)
+
+                def __call__(self, x):
+                    return jax.jit(self._step_fn)(x)
+        """
+        fs = run_lint(tmp_path, {"mod.py": src},
+                      rule="host-sync-in-step")
+        assert not names(fs, "host-sync-in-step")
 
 
 class TestDecodeStepContract:

@@ -26,11 +26,18 @@ Output:
   through the eager monitor), and the slowest-ranks ranking that
   pod-scale debugging starts from (MLPerf-on-pods, PAPERS.md).
 
+Round 14: `incident` rows from the live fleet monitor render as
+duration slices + INCIDENT summary lines; request-scoped `span` rows
+(router_submit -> engine admission/prefill/decode-window/retire ->
+decode_request) group per trace_id, and ``--trace <id>`` prints one
+request's life with per-phase attribution.
+
 Stdlib-pure: loads the bus parser standalone, no jax import, safe on a
 login node against a dir rsync'd off the pod.
 
 Usage:
     python tools/timeline.py <obs_dir> [--out trace.json] [--json]
+        [--trace TRACE_ID]
 """
 from __future__ import annotations
 
@@ -158,6 +165,55 @@ def chrome_trace(streams: Dict[int, List[dict]],
                     "ts": max(us(t) - dur, 0.0), "dur": dur,
                     "args": {"ordinal": payload.get("ordinal"),
                              "changed": payload.get("changed")},
+                })
+                continue
+            if kind == "incident":
+                # fleet-monitor correlation (ISSUE 14): one slice per
+                # incident spanning its first..last correlated event
+                ts0, ts1 = payload.get("t_start"), payload.get("t_end")
+                if isinstance(ts0, (int, float)) and \
+                        isinstance(ts1, (int, float)):
+                    events.append({
+                        "ph": "X", "name": f"incident#{payload.get('id')}",
+                        "pid": rank, "tid": "incidents",
+                        "ts": us(ts0),
+                        "dur": max((ts1 - ts0) * 1e6, 1.0),
+                        "args": {"chain": payload.get("chain"),
+                                 "ranks": str(payload.get("ranks")),
+                                 "count": payload.get("count")},
+                    })
+                    continue
+            if kind == "span":
+                # request-scoped tracing (ISSUE 14): group each traced
+                # request's phases on its own track so one request's
+                # life reads as a lane in the trace viewer; a
+                # decode_window row names EVERY traced inflight
+                # request, so it marks every named lane
+                lanes = ([payload["trace_id"]]
+                         if payload.get("trace_id") is not None
+                         else list(payload.get("trace_ids") or [None]))
+                for tid_lane in lanes:
+                    events.append({
+                        "ph": "i",
+                        "name": str(payload.get("name", "span")),
+                        "pid": rank, "tid": f"trace {tid_lane}",
+                        "ts": us(t), "s": "t",
+                        "args": {k: v for k, v in payload.items()
+                                 if isinstance(v, (str, int, float,
+                                                   bool))},
+                    })
+                continue
+            if kind == "decode_request" and payload.get("trace_id"):
+                # the terminal span: a slice covering the request's
+                # whole latency, ending at the retire row
+                dur = float(payload.get("latency_ms", 0.0)) * 1e3
+                events.append({
+                    "ph": "X", "name": f"request {payload.get('rid')}",
+                    "pid": rank, "tid": f"trace {payload['trace_id']}",
+                    "ts": max(us(t) - dur, 0.0), "dur": dur,
+                    "args": {k: payload.get(k) for k in
+                             ("rid", "tokens", "latency_ms",
+                              "prefill_ms", "ttft_ms", "ms_per_token")},
                 })
                 continue
             if kind == "reshard":
@@ -339,6 +395,28 @@ def summarize(streams: Dict[int, List[dict]],
         lines.append(f"guard events: {trips} across "
                      f"{sum(1 for s in stats.values() if s['guard_trips'])}"
                      f" rank(s) — see guard_* rows / replay bundles")
+    # fleet-monitor incidents + traced requests (ISSUE 14)
+    incidents = []
+    traces = set()
+    for rows in streams.values():
+        for r in rows:
+            p = r.get("payload")
+            if not isinstance(p, dict):
+                continue
+            k = r.get("kind")
+            if k == "incident":
+                incidents.append(p)
+            elif k in ("span", "decode_request", "router_admit"):
+                if p.get("trace_id"):
+                    traces.add(p["trace_id"])
+                for t in (p.get("trace_ids") or []):
+                    traces.add(t)
+    if traces:
+        lines.append(f"traced requests: {len(traces)} "
+                     f"(--trace <id> renders one request's spans)")
+    for p in incidents:
+        lines.append(f"INCIDENT #{p.get('id')} ranks {p.get('ranks')}: "
+                     f"{p.get('chain')}")
     launcher = streams.get(-1, [])
     if launcher:
         kinds = {}
@@ -346,6 +424,55 @@ def summarize(streams: Dict[int, List[dict]],
             kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
         lines.append("launcher: " + ", ".join(
             f"{k} x{n}" for k, n in sorted(kinds.items())))
+    return lines
+
+
+def trace_spans(streams: Dict[int, List[dict]],
+                trace_id: str) -> List[dict]:
+    """Every row carrying ``trace_id`` — router_submit span, engine
+    admission/prefill/decode-window/retire spans, the decode_request
+    terminal — merged across rank streams and time-ordered: one
+    request's life (ISSUE 14)."""
+    out: List[dict] = []
+    for rank, rows in streams.items():
+        for r in rows:
+            p = r.get("payload")
+            if not isinstance(p, dict):
+                continue
+            k = r.get("kind")
+            if p.get("trace_id") == trace_id or \
+                    trace_id in (p.get("trace_ids") or []):
+                out.append({
+                    "time": r.get("time", 0.0),
+                    "rank": rank,
+                    "name": (p.get("name", "span") if k == "span"
+                             else k),
+                    "detail": {kk: vv for kk, vv in p.items()
+                               if kk not in ("trace_id", "trace_ids",
+                                             "name")
+                               and isinstance(vv, (str, int, float,
+                                                   bool))},
+                })
+    out.sort(key=lambda e: e["time"])
+    return out
+
+
+def format_trace(spans: List[dict], trace_id: str) -> List[str]:
+    """Per-phase attribution for one request: +offset from the root
+    span and the delta each phase added."""
+    if not spans:
+        return [f"trace {trace_id}: no spans found"]
+    t0 = spans[0]["time"]
+    lines = [f"trace {trace_id}: {len(spans)} span(s)"]
+    prev = t0
+    for s in spans:
+        detail = " ".join(f"{k}={v}" for k, v in sorted(
+            s["detail"].items()))
+        lines.append(
+            f"  +{(s['time'] - t0) * 1e3:9.3f}ms "
+            f"(+{(s['time'] - prev) * 1e3:8.3f}ms)  "
+            f"rank {s['rank']:>2}  {s['name']:<14} {detail}")
+        prev = s["time"]
     return lines
 
 
@@ -365,6 +492,9 @@ def main(argv=None) -> int:
                     help="write chrome-trace JSON here")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as JSON instead of a table")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="print one traced request's spans with "
+                         "per-phase attribution instead of the summary")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.obs_dir):
         print(f"timeline: {args.obs_dir} is not a directory",
@@ -381,7 +511,10 @@ def main(argv=None) -> int:
         n = len(trace["traceEvents"])
         print(f"chrome trace: {args.out} ({n} events; load in "
               f"chrome://tracing or https://ui.perfetto.dev)")
-    if args.json:
+    if args.trace:
+        print("\n".join(format_trace(
+            trace_spans(streams, args.trace), args.trace)))
+    elif args.json:
         ranks = sorted(r for r in set(streams) | set(dumps) if r >= 0)
         print(json.dumps({
             str(r): _rank_stats(streams.get(r, []), dumps.get(r, []))

@@ -24,6 +24,13 @@ Statically: inside compiled-region functions (anything reachable from a
   value in the payload dies a tracer repr. Emit from the host loop on
   the step's RETURNED state instead — that is exactly what the guard's
   interval-synced monitor does.
+* the request-scoped span/trace helpers (ISSUE 14):
+  ``bus.emit_span(...)`` and the metrics-sampler methods
+  ``.span(...)`` / ``.window_span(...)`` / ``.request_done(...)``
+  behind a metrics/sampler qualifier — same contract as emits: the
+  engine publishes spans on its READBACK cadence from host values, a
+  span inside a compiled DecodeStep body would fire per compile with
+  tracer reprs.
 """
 from __future__ import annotations
 
@@ -38,19 +45,33 @@ _CAST_SYNCS = {"float", "int", "bool"}
 #: bus API (the bare `emit_event` name is the guard's and always counts)
 _EMIT_QUALIFIERS = {"bus", "_bus", "_obs_bus", "telemetry", "_telemetry",
                     "obs", "_obs", "observability"}
+#: the request-scoped span/trace helpers (ISSUE 14): `emit_span` is the
+#: bus-level API (unambiguous, always counts like emit_event); the
+#: sampler methods are generic names, so they only count behind a
+#: metrics/sampler/bus-ish qualifier (`self._metrics.span(...)`)
+_SPAN_METHODS = {"span", "window_span", "request_done"}
+_SPAN_QUALIFIERS = _EMIT_QUALIFIERS | {"metrics", "_metrics", "sampler",
+                                       "_sampler"}
+#: every terminal name the emit branch of the rule dispatches on
+EMIT_TERMINALS = frozenset(
+    {"emit", "emit_event", "emit_span"} | _SPAN_METHODS)
 
 
 def _telemetry_emit(d: str) -> bool:
     parts = d.split(".")
     t = parts[-1]
-    if t == "emit_event":
+    if t in ("emit_event", "emit_span"):
         return True
-    if t != "emit":
-        return False
     quals = parts[:-1]
-    return not quals or any(
-        q in _EMIT_QUALIFIERS or q.endswith("bus") for q in quals
-    )
+    if t == "emit":
+        return not quals or any(
+            q in _EMIT_QUALIFIERS or q.endswith("bus") for q in quals
+        )
+    if t in _SPAN_METHODS:
+        return any(
+            q in _SPAN_QUALIFIERS or q.endswith("bus") for q in quals
+        )
+    return False
 
 
 @register
@@ -111,7 +132,7 @@ class HostSyncInStepRule(Rule):
                         "sync under concrete execution and a trace "
                         "error under jit; keep it an array",
                     )
-                elif t in ("emit", "emit_event") and _telemetry_emit(d):
+                elif t in EMIT_TERMINALS and _telemetry_emit(d):
                     yield self.finding(
                         mod, node,
                         f"telemetry emit `{d}(...)` {where} — bus emits "
