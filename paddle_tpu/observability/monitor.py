@@ -433,7 +433,31 @@ def _notable_detail(kind: str, payload: dict) -> Optional[str]:
     if kind in ("elastic_attribution",):
         return f"{payload.get('cause')}: {payload.get('detail')}"
     if kind == "router_admit" and payload.get("outcome") == "rejected":
-        return f"admission rejected (depths {payload.get('depths')})"
+        why = payload.get("reason")
+        return (f"admission rejected ({why})" if why else
+                f"admission rejected (depths {payload.get('depths')})")
+    # serving-plane fault tolerance (ISSUE 15): host death, the
+    # failover that recovered its requests, and planned drains are the
+    # cross-rank causal links the incident chain must NAME — "host 0
+    # dead → 3 requests failed over → admission rejected" reads as one
+    # event, not three disconnected rows
+    if kind == "router_host_dead":
+        hr = payload.get("host_rank")
+        return (f"host {payload.get('host')}"
+                + (f" (worker rank {hr})" if hr is not None else "")
+                + f" dead: {payload.get('reason')}, "
+                  f"{payload.get('inflight')} in flight")
+    if kind == "router_failover":
+        return (f"host {payload.get('host')}: "
+                f"{payload.get('requests')} request(s) failed over"
+                + (f", {payload.get('orphaned')} orphaned"
+                   if payload.get("orphaned") else ""))
+    if kind == "router_drain":
+        hr = payload.get("host_rank")
+        return (f"host {payload.get('host')}"
+                + (f" (worker rank {hr})" if hr is not None else "")
+                + f" draining: {payload.get('migrated')} migrated, "
+                  f"{payload.get('in_place')} in place")
     return None
 
 

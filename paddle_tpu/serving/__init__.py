@@ -31,6 +31,16 @@ Round 13 (ISSUE 13) grows it into the production tier:
 - `router` — the multi-host front end: admission control, SLO-aware
   host choice driven by the `decode_metrics` bus rows, a jax-free
   worker for the launcher-driven multi-process dryrun.
+
+Round 15 (ISSUE 15) makes the plane fault-tolerant: the router grows a
+per-host health state machine (healthy → suspect → dead / draining →
+retired; `PADDLE_SERVE_HOST_TIMEOUT_MS` + exp-backoff probation),
+token-exact failover (in-flight requests re-submit to survivors as
+`Request(resume_tokens=...)` resume requests under idempotent ids),
+live drain (`Router.drain_host` + the `drain`/`cancel` mailbox verbs),
+and reasoned load shedding against the surviving fleet; the engine
+grows the host-side seam it rides (`InferenceEngine.turn` /
+`progress` / `cancel`).
 """
 from . import paged_kv  # noqa: F401
 from . import sampling  # noqa: F401

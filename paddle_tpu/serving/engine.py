@@ -321,12 +321,23 @@ _rid_counter = itertools.count()
 
 
 class Request:
-    """One generation request for the engine."""
+    """One generation request for the engine.
+
+    ``resume_tokens`` (ISSUE 15) carries tokens a PREVIOUS host already
+    emitted for this request: the engine prefills ``prompt_ids +
+    resume_tokens`` as one prefix (the caller — Router failover — has
+    already decremented ``max_new_tokens`` by the resumed count), so a
+    greedy request continues TOKEN-EXACTLY where the dead host stopped.
+    The engine's result holds only the NEW tokens; the router owns the
+    prefix reassembly."""
 
     def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                  top_k=0, top_p=1.0, eos_id=None, rid=None,
-                 trace_id=None):
+                 trace_id=None, resume_tokens=None):
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        self.resume_tokens = (
+            np.asarray([], np.int32) if resume_tokens is None
+            else np.asarray(resume_tokens, np.int32).reshape(-1))
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -339,6 +350,14 @@ class Request:
         #: (direct engine use) keeps the span stream empty
         self.trace_id = trace_id
         self.t_submit: Optional[float] = None  # set by engine.submit
+
+    @property
+    def prefill_ids(self) -> np.ndarray:
+        """The tokens the engine actually prefills: prompt plus any
+        resumed prefix from a failed-over host."""
+        if self.resume_tokens.size == 0:
+            return self.prompt_ids
+        return np.concatenate([self.prompt_ids, self.resume_tokens])
 
 
 class GeneratedResult:
@@ -490,7 +509,7 @@ class InferenceEngine:
         if self._pool is None:
             return 0
         return pk.blocks_for(
-            req.prompt_ids.size + req.max_new_tokens, self.block_size)
+            req.prefill_ids.size + req.max_new_tokens, self.block_size)
 
     def free_blocks(self) -> Optional[int]:
         return None if self._pool is None else self._pool.free
@@ -501,11 +520,56 @@ class InferenceEngine:
     def inflight(self) -> int:
         return len(self._active) + len(self._pending)
 
+    def progress(self) -> Dict[object, List[int]]:
+        """rid -> tokens emitted so far, for every request the engine
+        holds (ISSUE 15). HOST-side state only: active slots report the
+        tokens already read back at window boundaries, pending prefills
+        and queued requests report ``[]`` — the failover/drain resume
+        path feeds on exactly this map, so it costs zero device reads
+        by construction."""
+        out: Dict[object, List[int]] = {}
+        for st in self._active.values():
+            out[st.req.rid] = list(st.tokens)
+        for job in self._pending.values():
+            out[job.req.rid] = []
+        for req in self._queue:
+            out[req.rid] = []
+        return out
+
+    def cancel(self, rid) -> bool:
+        """Withdraw one request without a result row (ISSUE 15 drain:
+        the router migrates it elsewhere and must stop THIS engine from
+        also serving it — idempotent rids make a race survivable, a
+        cancel makes it cheap). Queued: dropped. Pending prefill /
+        active slot: the slot is marked done in-graph (its keep-alive
+        writes stay masked like any retired slot) and its blocks come
+        back. Returns whether anything was withdrawn."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                return True
+        for slot, job in list(self._pending.items()):
+            if job.req.rid == rid:
+                del self._pending[slot]
+                self._release(slot, job.blocks)
+                return True
+        for slot, st in list(self._active.items()):
+            if st.req.rid == rid:
+                self._active.pop(slot)
+                self._state.done = self._state.done.at[slot].set(True)
+                self._metrics.span(
+                    "cancel", trace_id=st.req.trace_id, rid=rid,
+                    slot=slot, tokens=len(st.tokens))
+                self._release(slot, self._slot_blocks.pop(slot, None))
+                return True
+        return False
+
     def submit(self, req: Request) -> None:
-        if req.prompt_ids.size + req.max_new_tokens > self.max_length:
+        if req.prefill_ids.size + req.max_new_tokens > self.max_length:
             raise ValueError(
-                f"request {req.rid}: prompt ({req.prompt_ids.size}) + "
-                f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                f"request {req.rid}: prompt+resume "
+                f"({req.prefill_ids.size}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds "
                 f"max_length={self.max_length}")
         if self._pool is not None and \
                 self.needed_blocks(req) > self._pool.total:
@@ -519,54 +583,67 @@ class InferenceEngine:
     def run(self) -> Dict[object, GeneratedResult]:
         """Drain the queue; returns rid -> GeneratedResult."""
         results: Dict[object, GeneratedResult] = {}
-        while self._queue or self._active or self._pending:
-            self._advance_prefills(results)
-            progress = self._fill_free_slots(results)
-            if not self._active:
-                if not self._pending and not progress and self._queue:
-                    # nothing inflight and the head request can't start:
-                    # with a paged pool this would spin forever (blocks
-                    # can only come back from retiring work, and there
-                    # is none) — fail loudly instead
-                    req = self._queue[0]
-                    raise RuntimeError(
-                        f"request {req.rid} cannot be admitted: needs "
-                        f"{self.needed_blocks(req)} blocks, "
-                        f"{self.free_blocks()} free, nothing inflight "
-                        f"to free more")
-                continue
-            window = self._window()
-            t0 = time.perf_counter()
-            emits = []
-            for _ in range(window):
-                emit, _, self._state = self._decode(self._state)
-                emits.append(emit)
-            # THE readback: one stacked token transfer + the done mask
-            # per window — the only recurring device->host reads in the
-            # serving loop (decode_metrics rides exactly this cadence)
-            tok_block = np.asarray(jnp.stack(emits, axis=0))
-            done = np.asarray(self._state.done)
-            dt = time.perf_counter() - t0
-            # decode-window span for traced requests: emitted on the
-            # SAME readback cadence (host values only, zero new reads)
-            self._metrics.window_span(
-                [s.req.trace_id for s in self._active.values()],
-                steps=window)
-            self._collect(tok_block, done, results)
-            ttfts, self._ttft_window = self._ttft_window, []
-            self._metrics.window(
-                steps=window, tokens=int((tok_block >= 0).sum()),
-                wall_s=dt, inflight=len(self._active),
-                queue_depth=len(self._queue),
-                ttft_ms=ttfts,
-                blocks_in_use=(None if self._pool is None
-                               else self._pool.in_use),
-                blocks_total=(None if self._pool is None
-                              else self._pool.total),
-                blocks_freed=(None if self._pool is None
-                              else self._pool.freed_total),
-                admit_deferred=self._admit_deferred)
+        while self.turn(results):
+            pass
         return results
+
+    def turn(self, results: Dict[object, GeneratedResult]) -> bool:
+        """ONE scheduling turn: advance pending prefills by a chunk,
+        fill free slots, run one decode window, collect its readback.
+        Returns True while work remains (``run`` is just a turn loop).
+        The incremental form is what a failover-capable host endpoint
+        pumps (ISSUE 15): between turns every inflight request's
+        emitted tokens sit in HOST state (:meth:`progress`), so a
+        router can migrate them without touching the device."""
+        if not (self._queue or self._active or self._pending):
+            return False
+        self._advance_prefills(results)
+        progress = self._fill_free_slots(results)
+        if not self._active:
+            if not self._pending and not progress and self._queue:
+                # nothing inflight and the head request can't start:
+                # with a paged pool this would spin forever (blocks
+                # can only come back from retiring work, and there
+                # is none) — fail loudly instead
+                req = self._queue[0]
+                raise RuntimeError(
+                    f"request {req.rid} cannot be admitted: needs "
+                    f"{self.needed_blocks(req)} blocks, "
+                    f"{self.free_blocks()} free, nothing inflight "
+                    f"to free more")
+            return bool(self._queue or self._active or self._pending)
+        window = self._window()
+        t0 = time.perf_counter()
+        emits = []
+        for _ in range(window):
+            emit, _, self._state = self._decode(self._state)
+            emits.append(emit)
+        # THE readback: one stacked token transfer + the done mask
+        # per window — the only recurring device->host reads in the
+        # serving loop (decode_metrics rides exactly this cadence)
+        tok_block = np.asarray(jnp.stack(emits, axis=0))
+        done = np.asarray(self._state.done)
+        dt = time.perf_counter() - t0
+        # decode-window span for traced requests: emitted on the
+        # SAME readback cadence (host values only, zero new reads)
+        self._metrics.window_span(
+            [s.req.trace_id for s in self._active.values()],
+            steps=window)
+        self._collect(tok_block, done, results)
+        ttfts, self._ttft_window = self._ttft_window, []
+        self._metrics.window(
+            steps=window, tokens=int((tok_block >= 0).sum()),
+            wall_s=dt, inflight=len(self._active),
+            queue_depth=len(self._queue),
+            ttft_ms=ttfts,
+            blocks_in_use=(None if self._pool is None
+                           else self._pool.in_use),
+            blocks_total=(None if self._pool is None
+                          else self._pool.total),
+            blocks_freed=(None if self._pool is None
+                          else self._pool.freed_total),
+            admit_deferred=self._admit_deferred)
+        return bool(self._queue or self._active or self._pending)
 
     # -- internals ---------------------------------------------------------
     def _window(self) -> int:
@@ -595,11 +672,11 @@ class InferenceEngine:
         for slot in list(self._pending):
             job = self._pending[slot]
             C = self.prefill_chunk
-            L = job.req.prompt_ids.size
+            L = job.req.prefill_ids.size
             t0 = time.perf_counter()
             take = min(C, L - job.consumed)
             chunk = np.zeros((1, C), np.int32)
-            chunk[0, :take] = job.req.prompt_ids[
+            chunk[0, :take] = job.req.prefill_ids[
                 job.consumed: job.consumed + take]
             last, job.raws, _ = self._prefill(
                 job.raws, chunk, np.asarray([take], np.int32),
@@ -645,7 +722,7 @@ class InferenceEngine:
                 queue_wait_ms=(
                     round((time.perf_counter() - req.t_submit) * 1e3, 3)
                     if req.t_submit is not None else None))
-            L = req.prompt_ids.size
+            L = req.prefill_ids.size
             if self.prefill_chunk > 0 and L > self.prefill_chunk:
                 self._pending[slot] = _Pending(
                     req, slot, blocks, self._slot_cache(),
@@ -653,7 +730,7 @@ class InferenceEngine:
                 continue
             t0 = time.perf_counter()
             bucket = bucket_for(L, self.max_length)
-            ids, lens = _pad_prompts([req.prompt_ids], bucket)
+            ids, lens = _pad_prompts([req.prefill_ids], bucket)
             last, slot_raws, _ = self._prefill(self._slot_cache(), ids,
                                                lens)
             self._activate(slot, req, slot_raws, last, blocks=blocks,
@@ -724,7 +801,7 @@ class InferenceEngine:
                 jax.jit(fn, donate_argnums=donate, static_argnums=()),
                 label="CacheInsert", donate=donate)
         st = self._state
-        L = req.prompt_ids.size
+        L = req.prefill_ids.size
         extra = ()
         if self._pool is not None:
             row = np.zeros((self._nmax,), np.int32)

@@ -1,4 +1,5 @@
-"""Multi-host SLO-aware request router (ISSUE 13 tentpole d).
+"""Multi-host SLO-aware request router (ISSUE 13 tentpole d; ISSUE 15
+fault-tolerant serving plane).
 
 The layer above :class:`serving.InferenceEngine`: one engine serves one
 host's chips; "millions of users" need a front end that spreads
@@ -11,28 +12,64 @@ queue depth — and, round 13, TTFT and block-pool occupancy) ARE the
 router's scheduling signal. Nothing new is measured; the router reads
 what serving already publishes.
 
+Round 15 makes the plane survive host DEATH, not just host slowness:
+
+- **failure detection** — per-host health state (``healthy`` →
+  ``suspect`` → ``dead``, plus ``draining`` → ``retired``) driven by
+  two signals that already exist: heartbeat staleness on the host's
+  `decode_metrics` cadence and a service-progress deadline
+  (``PADDLE_SERVE_HOST_TIMEOUT_MS`` — a host with outstanding requests
+  must show an ack / progress / completion inside the window). A
+  troubled host sits in exp-backoff PROBATION
+  (``PADDLE_SERVE_RETRY_BACKOFF_MS`` base, ``PADDLE_SERVE_RETRY_MAX``
+  probes) before the ``dead`` verdict, so a long GC pause is not an
+  execution;
+- **token-exact recovery** — the router tracks every admitted
+  request's prompt, sampling params, and the tokens its host has
+  emitted so far (`worker_progress` rows / the engine's host-side
+  window readbacks — data that exists anyway). On a dead verdict each
+  in-flight request is RE-SUBMITTED to a healthy host as a *resume*
+  request: ``resume_tokens`` carries the emitted prefix, the budget is
+  decremented, the host re-prefills prompt+prefix through the ordinary
+  bucketed/chunked prefill. For greedy decoding the continuation is
+  token-exact by construction (asserted against an uninterrupted run
+  in tests/test_serving_fault.py); retried submits keep their original
+  request id, so a slow-then-recovering host that ALSO serves its copy
+  is deduplicated, never double-counted;
+- **live drain** — :meth:`Router.drain_host` stops admissions, lets
+  short requests finish in place, migrates long ones over the same
+  resume path (with a ``cancel`` mailbox verb so the drainer stops
+  wasting work), then sends the ``drain`` verb: the worker finishes
+  its queue and exits rc 0 — planned maintenance as
+  recovery-with-a-warning;
+- **graceful degradation** — admission control re-evaluates the
+  existing ``PADDLE_SERVE_ADMIT_*`` bounds against the SURVIVING
+  fleet; `router_admit` rows carry a ``reason`` (``no_live_host`` /
+  ``queue_full`` / ``ttft_slo``) so shed load is attributable, and
+  failover re-submissions that find no healthy host are ORPHANED and
+  retried every tick — shrunk capacity sheds new work deterministically
+  but never drops admitted work.
+
 Pieces:
 
 - :class:`LocalHost` — an in-process engine endpoint (single-host
-  deployments and the fast test matrix);
+  deployments and the fast test matrix); pumps the engine one
+  scheduling turn at a time so progress is observable between turns;
 - :class:`FileHost` — a mailbox endpoint to a host WORKER process
-  (``inbox/*.json`` requests in, ``outbox/*.json`` results back,
-  stats read from the worker's per-rank telemetry stream) — the
-  multi-process dryrun transport; production would swap a real RPC in
-  behind the same three methods;
-- :class:`Router` — per-host queues + admission control
-  (``PADDLE_SERVE_ADMIT_QUEUE`` / ``PADDLE_SERVE_ADMIT_TTFT_MS``) +
-  SLO-aware host choice (predicted wait from the freshest
-  ``decode_metrics`` row), `router_metrics` telemetry (queue depth per
-  host — tools/timeline.py renders it as a counter track), and the
-  ``serve`` fault-injection site (``serve:burst:nth[:n]``,
-  ``serve:slow_host:nth[:rank]``) so the admission and degradation
-  paths are testable from the fault matrix;
+  (``inbox/*.json`` requests + verbs in, ``outbox/*.json`` results
+  back, stats/progress read from the worker's per-rank telemetry
+  stream) — the multi-process dryrun transport; production would swap
+  a real RPC in behind the same methods;
+- :class:`Router` — per-host queues + admission control + SLO-aware
+  host choice + the round-15 health/failover/drain machinery above;
 - :func:`worker_main` — the jax-free simulated host worker the
-  launcher-driven dryrun spawns (loads the bus standalone, same
-  pattern as the observability dryrun children): polls its inbox,
-  "decodes" at a configured rate, emits REAL `decode_metrics` /
-  `decode_request` rows, honors ``serve:slow_host`` degradation.
+  launcher-driven dryrun spawns: polls its inbox, "decodes"
+  deterministically window by window (so resumed greedy requests are
+  token-exact by construction), emits REAL `decode_metrics` /
+  `worker_ack` / `worker_progress` / `decode_request` rows, honors
+  the ``drain``/``cancel`` verbs and the ``serve`` fault site
+  (``slow_host``, ``straggler``, ``host_crash`` — SIGKILL mid-decode —
+  and ``hang`` — alive but not serving, the detector's harder prey).
 
 Run as a script (what `distributed.launch` spawns)::
 
@@ -45,12 +82,15 @@ import importlib.util
 import itertools
 import json
 import os
+import signal as _signal
 import sys
 import time
 from typing import Dict, List, Optional
 
 __all__ = ["HostStats", "LocalHost", "FileHost", "Router",
-           "admit_queue_default", "admit_ttft_ms_default", "worker_main"]
+           "admit_queue_default", "admit_ttft_ms_default",
+           "host_timeout_ms_default", "retry_max_default",
+           "retry_backoff_ms_default", "sim_next_token", "worker_main"]
 
 #: process-wide trace-id counter: ids are pid-qualified, so the counter
 #: must be shared by every Router in the process or two routers over
@@ -59,6 +99,9 @@ _trace_counter = itertools.count(1)
 
 _ADMIT_QUEUE_ENV = "PADDLE_SERVE_ADMIT_QUEUE"
 _ADMIT_TTFT_ENV = "PADDLE_SERVE_ADMIT_TTFT_MS"
+_HOST_TIMEOUT_ENV = "PADDLE_SERVE_HOST_TIMEOUT_MS"
+_RETRY_MAX_ENV = "PADDLE_SERVE_RETRY_MAX"
+_RETRY_BACKOFF_ENV = "PADDLE_SERVE_RETRY_BACKOFF_MS"
 
 
 def admit_queue_default() -> int:
@@ -78,6 +121,36 @@ def admit_ttft_ms_default() -> float:
         return max(float(os.environ.get(_ADMIT_TTFT_ENV, "0")), 0.0)
     except ValueError:
         return 0.0
+
+
+def host_timeout_ms_default() -> float:
+    """``PADDLE_SERVE_HOST_TIMEOUT_MS`` — a host with outstanding
+    requests that shows no ack/progress/completion for this long is
+    SUSPECT (default 2000). The dead verdict additionally needs the
+    probation probes below, so total detection latency is roughly
+    ``timeout + backoff * (2^1 + .. + 2^retries)``."""
+    try:
+        return max(float(os.environ.get(_HOST_TIMEOUT_ENV, "2000")), 1.0)
+    except ValueError:
+        return 2000.0
+
+
+def retry_max_default() -> int:
+    """``PADDLE_SERVE_RETRY_MAX`` — probation probes without a sign of
+    service before a suspect host is declared dead (default 3)."""
+    try:
+        return max(int(os.environ.get(_RETRY_MAX_ENV, "3")), 1)
+    except ValueError:
+        return 3
+
+
+def retry_backoff_ms_default() -> float:
+    """``PADDLE_SERVE_RETRY_BACKOFF_MS`` — base of the exponential
+    probation backoff between probes (default 250)."""
+    try:
+        return max(float(os.environ.get(_RETRY_BACKOFF_ENV, "250")), 1.0)
+    except ValueError:
+        return 250.0
 
 
 # ---------------------------------------------------------------------------
@@ -127,6 +200,30 @@ def _monitor():
 
 
 # ---------------------------------------------------------------------------
+# deterministic "greedy" simulation (the jax-free worker's model)
+# ---------------------------------------------------------------------------
+
+_SIM_VOCAB = 64
+
+
+def sim_next_token(ids: List[int]) -> int:
+    """The dryrun worker's deterministic next-token rule: a mix over the
+    WHOLE prefix (prompt + everything emitted), so it behaves like
+    greedy decoding — the continuation is a pure function of the
+    prefix, and a resumed request (prefix re-fed as prompt+resume)
+    continues token-exactly where the dead host stopped. Stdlib-pure on
+    purpose; tests and bench recompute the chain as the uninterrupted
+    oracle."""
+    h = 2166136261
+    for j, v in enumerate(ids):
+        # position folds in so a run of equal tokens still walks the
+        # state — without it a chain that reaches 0 sticks at 0 forever
+        h = ((h ^ ((int(v) + 31 * (j + 1)) & 0xFFFF))
+             * 16777619) & 0xFFFFFFFF
+    return h % _SIM_VOCAB
+
+
+# ---------------------------------------------------------------------------
 # host endpoints
 # ---------------------------------------------------------------------------
 
@@ -154,11 +251,15 @@ def _req_fields(req) -> dict:
     """Engine Request / plain dict -> the wire fields a host needs.
     ``trace_id`` rides the mailbox row so a worker's span and
     decode_request rows stitch to the router's — the trace follows the
-    request across the process boundary."""
+    request across the process boundary. ``resume_tokens`` (round 15)
+    is the failed-over prefix a resumed request re-prefills."""
     if isinstance(req, dict):
         d = dict(req)
         d.setdefault("max_new_tokens", 16)
         return d
+    resume = getattr(req, "resume_tokens", None)
+    if resume is None:
+        resume = []
     return {
         "rid": req.rid,
         "prompt_ids": [int(t) for t in req.prompt_ids],
@@ -168,15 +269,26 @@ def _req_fields(req) -> dict:
         "top_p": req.top_p,
         "eos_id": req.eos_id,
         "trace_id": getattr(req, "trace_id", None),
+        "resume_tokens": [int(t) for t in resume],
     }
 
 
 class LocalHost:
-    """In-process endpoint over one :class:`InferenceEngine`."""
+    """In-process endpoint over one :class:`InferenceEngine`.
+
+    ``can_fail = False``: an in-process engine cannot die independently
+    of the router, so the health machinery never puts it on probation
+    (an idle tick-loop would otherwise look like a stall). Drain still
+    applies — the router just stops admitting and pumps it dry."""
+
+    can_fail = False
 
     def __init__(self, engine):
         self.engine = engine
         self._submitted = 0
+        self._run_results: Dict = {}
+        self._done: List[dict] = []
+        self._reqs: Dict[object, object] = {}
 
     def submit(self, req) -> None:
         from .engine import Request
@@ -190,7 +302,9 @@ class LocalHost:
                 top_k=d.get("top_k", 0), top_p=d.get("top_p", 1.0),
                 eos_id=(None if d.get("eos_id", -1) in (-1, None)
                         else d["eos_id"]),
-                rid=d.get("rid"), trace_id=d.get("trace_id"))
+                rid=d.get("rid"), trace_id=d.get("trace_id"),
+                resume_tokens=d.get("resume_tokens"))
+        self._reqs[req.rid] = req
         self.engine.submit(req)
         self._submitted += 1
 
@@ -201,16 +315,70 @@ class LocalHost:
             inflight=self.engine.inflight(),
             age_s=0.0, submitted=self._submitted)
 
+    def pump(self) -> bool:
+        """One engine scheduling turn; finished requests move to the
+        :meth:`results` queue. Returns True while work remains."""
+        more = self.engine.turn(self._run_results)
+        self._harvest()
+        return more
+
+    def _harvest(self) -> None:
+        for rid, res in list(self._run_results.items()):
+            del self._run_results[rid]
+            req = self._reqs.pop(rid, None)
+            resume = ([int(t) for t in req.resume_tokens]
+                      if req is not None else [])
+            self._done.append({
+                "rid": rid,
+                # FULL continuation (resume prefix + new tokens): the
+                # host-results contract the dedup/reassembly rides on
+                "token_ids": resume + [int(t) for t in res.tokens],
+                "resumed": len(resume),
+                "ttft_ms": res.ttft_ms,
+                "latency_ms": res.total_ms,
+                "trace_id": getattr(req, "trace_id", None),
+            })
+
     def drain(self) -> Dict:
-        return self.engine.run()
+        out = self.engine.run()
+        # back-compat: callers get the GeneratedResult dict, the router
+        # still sees the completions through results()
+        self._run_results.update(out)
+        self._harvest()
+        return out
+
+    def results(self) -> List[dict]:
+        out, self._done = self._done, []
+        return out
+
+    def progress(self) -> Dict[object, List[int]]:
+        return self.engine.progress()
+
+    def cancel(self, rid) -> bool:
+        self._reqs.pop(rid, None)
+        return self.engine.cancel(rid)
+
+    def send_verb(self, verb: str, rid=None) -> None:
+        if verb == "cancel":
+            self.cancel(rid)
+        # "drain" is router-side for an in-process engine: admissions
+        # stop and the remaining work is pumped dry
+
+    def signals(self) -> dict:
+        now = time.time()
+        return {"live_t": now, "service_t": now,
+                "progress": self.progress(), "results": self.results()}
 
 
 class FileHost:
-    """Mailbox endpoint to a worker process: requests as one JSON file
-    each under ``<dir>/inbox``, results back under ``<dir>/outbox``,
-    stats from the worker's ``telemetry.rank{N}.jsonl`` stream (the
-    SAME rows the engine emits — the router schedules on telemetry, not
-    on a private side channel)."""
+    """Mailbox endpoint to a worker process: requests (and round-15
+    ``drain``/``cancel`` verbs) as one JSON file each under
+    ``<dir>/inbox``, results back under ``<dir>/outbox``, stats AND
+    health signals from the worker's ``telemetry.rank{N}.jsonl`` stream
+    (the SAME rows the engine emits — the router schedules and judges
+    liveness on telemetry, not on a private side channel)."""
+
+    can_fail = True
 
     def __init__(self, host_dir: str, rank: int,
                  obs_dir: Optional[str] = None):
@@ -222,6 +390,7 @@ class FileHost:
         os.makedirs(self.inbox, exist_ok=True)
         os.makedirs(self.outbox, exist_ok=True)
         self._submitted = 0
+        self._verb_n = 0
         # incremental stream tail: the router polls stats per submit
         # AND per tick, and the stream grows one row per worker poll —
         # re-parsing from byte 0 every time would be quadratic over a
@@ -231,6 +400,9 @@ class FileHost:
         # torn-line and truncation semantics, one implementation.
         self._cursor = _monitor().StreamCursor(self._stream_path())
         self._last_metrics: Optional[dict] = None
+        self._last_row_t: Optional[float] = None
+        self._service_t: Optional[float] = None
+        self._progress: Dict[object, List[int]] = {}
 
     def submit(self, req) -> None:
         d = _req_fields(req)
@@ -242,14 +414,55 @@ class FileHost:
             json.dump(d, f)
         os.replace(tmp, path)  # atomic: the worker never sees a torn file
 
+    def send_verb(self, verb: str, rid=None) -> None:
+        """Drop one control file in the inbox (``drain`` — finish the
+        queue, then exit rc 0; ``cancel`` — stop serving ``rid``)."""
+        self._verb_n += 1
+        d = {"verb": verb}
+        if rid is not None:
+            d["rid"] = rid
+        path = os.path.join(
+            self.inbox, f"req_{self._submitted:06d}v{self._verb_n:03d}"
+                        f"_{verb}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+        os.replace(tmp, path)
+
+    def cancel(self, rid) -> None:
+        self.send_verb("cancel", rid)
+        # a cancelled request never writes a result row, so results()
+        # would never prune its progress entry — drop it now or every
+        # signals() snapshot copies it for the host's lifetime
+        self._progress.pop(rid, None)
+
     def _stream_path(self) -> str:
         return os.path.join(self.obs_dir,
                             f"telemetry.rank{self.rank}.jsonl")
 
-    def stats(self) -> HostStats:
+    def _drain_stream(self) -> None:
+        """Fold freshly appended telemetry into the health caches: the
+        decode_metrics row is the heartbeat, `worker_ack` /
+        `worker_progress` / `decode_request` rows are SERVICE signals —
+        a hung worker keeps the first and stops the rest, which is
+        exactly the distinction the failure detector needs."""
+        now = time.time()
         for rec in self._cursor.poll():
-            if rec.get("kind") == "decode_metrics":
+            self._last_row_t = now
+            kind = rec.get("kind")
+            p = rec.get("payload") or {}
+            if kind == "decode_metrics":
                 self._last_metrics = rec
+            elif kind == "worker_ack":
+                self._service_t = now
+            elif kind == "worker_progress":
+                self._progress[p.get("rid")] = list(p.get("tokens") or [])
+                self._service_t = now
+            elif kind == "decode_request":
+                self._service_t = now
+
+    def stats(self) -> HostStats:
+        self._drain_stream()
         last = self._last_metrics
         if last is None:
             return HostStats(age_s=None, submitted=self._submitted)
@@ -276,40 +489,103 @@ class FileHost:
             except (OSError, ValueError):
                 continue
             os.remove(path)
+        for res in out:
+            self._progress.pop(res.get("rid"), None)
         return out
+
+    def progress(self) -> Dict[object, List[int]]:
+        self._drain_stream()
+        return dict(self._progress)
+
+    def signals(self) -> dict:
+        self._drain_stream()
+        return {"live_t": self._last_row_t,
+                "service_t": self._service_t,
+                "progress": dict(self._progress),
+                "results": self.results()}
 
 
 # ---------------------------------------------------------------------------
 # the router
 # ---------------------------------------------------------------------------
 
+#: host health states (round 15): healthy -> suspect -> dead on
+#: failure; healthy -> draining -> retired on planned maintenance.
+HOST_STATES = ("healthy", "suspect", "dead", "draining", "retired")
+
+
+class _HostHealth:
+    __slots__ = ("state", "prior", "live_t", "service_t", "suspect_t",
+                 "probes", "next_probe_t", "drain_t", "reason")
+
+    def __init__(self):
+        self.state = "healthy"
+        self.prior = "healthy"   # state to restore when probation clears
+        self.live_t: Optional[float] = None
+        self.service_t: Optional[float] = None
+        self.suspect_t = 0.0
+        self.probes = 0
+        self.next_probe_t = 0.0
+        self.drain_t = 0.0
+        self.reason = ""
+
+
+class _Tracked:
+    """One admitted request as the router remembers it: enough to
+    re-submit it token-exactly to another host."""
+
+    __slots__ = ("fields", "rid", "trace_id", "host", "t_submit",
+                 "progress", "attempts")
+
+    def __init__(self, fields: dict, trace_id, host: int, now: float):
+        self.fields = fields
+        self.rid = fields.get("rid")
+        self.trace_id = trace_id
+        self.host = host
+        self.t_submit = now
+        self.progress: List[int] = []  # tokens past THIS submission's resume
+        self.attempts = 1
+
 
 class Router:
-    """Admission-controlled, SLO-aware request spreading over hosts.
+    """Admission-controlled, SLO-aware, failure-surviving request
+    spreading over hosts.
 
-    Scheduling: pick the host minimizing PREDICTED WAIT — pending work
-    (queued + inflight requests, times the router's average new-token
-    estimate) over the host's published tokens/sec; hosts that have
-    never published fall back to queue-depth ordering. A host whose
-    queue is at ``admit_queue``, and (when ``admit_ttft_ms`` > 0) a
-    host whose predicted wait exceeds the TTFT SLO, is NOT eligible;
-    when no host is eligible the request is REJECTED (returned None,
-    counted) — under a burst the router sheds load instead of building
-    an unbounded queue whose every entry misses the SLO. In-router
-    bookkeeping (`_pending_guess`) bridges the telemetry lag between
-    submits inside one tick: a submit counts against its host until a
-    fresher bus row arrives.
+    Scheduling: pick the LIVE (``healthy``) host minimizing PREDICTED
+    WAIT — pending work (queued + inflight requests, times the router's
+    average new-token estimate) over the host's published tokens/sec;
+    hosts that have never published fall back to queue-depth ordering.
+    A host whose queue is at ``admit_queue``, and (when
+    ``admit_ttft_ms`` > 0) a host whose predicted wait exceeds the TTFT
+    SLO, is NOT eligible; when no host is eligible the request is
+    REJECTED (returned None, counted, `router_admit` row carries the
+    reason) — under a burst or a shrunken fleet the router sheds load
+    instead of building an unbounded queue whose every entry misses the
+    SLO. In-router bookkeeping (`_pending_guess`) bridges the telemetry
+    lag between submits inside one tick.
+
+    Fault tolerance (round 15): every admitted request is TRACKED
+    (prompt, params, emitted tokens); :meth:`tick` folds host telemetry
+    into per-host health state and, on a ``dead`` verdict, re-submits
+    the host's in-flight requests to survivors as token-exact resume
+    requests under their ORIGINAL ids (late duplicates from a
+    recovering host are deduplicated in :attr:`completed`).
+    :meth:`drain_host` is the same path as planned maintenance.
 
     ``serve`` fault-injection events are drained on every
     :meth:`tick`: a ``burst`` submits ``n`` synthetic probe requests
     through the normal admission path (the admission matrix's prey);
-    ``slow_host`` is consumed by the WORKER side (degradation shows up
-    here through the telemetry it causes, not through a flag).
+    ``slow_host`` / ``straggler`` / ``host_crash`` / ``hang`` are
+    consumed by the WORKER side (degradation and death show up here
+    through the telemetry they cause — or stop causing — not through a
+    flag).
     """
 
     def __init__(self, hosts, *, admit_queue=None, admit_ttft_ms=None,
                  avg_new_tokens=16, burst_prompt_len=4,
-                 burst_new_tokens=None):
+                 burst_new_tokens=None, host_timeout_ms=None,
+                 retry_max=None, retry_backoff_ms=None,
+                 drain_inplace_tokens=None):
         self.hosts = list(hosts)
         if not self.hosts:
             raise ValueError("Router needs at least one host")
@@ -323,14 +599,55 @@ class Router:
         self.burst_new_tokens = (burst_new_tokens
                                  if burst_new_tokens is not None
                                  else self.avg_new_tokens)
+        self.host_timeout_ms = (host_timeout_ms_default()
+                                if host_timeout_ms is None
+                                else float(host_timeout_ms))
+        self.retry_max = (retry_max_default() if retry_max is None
+                          else max(int(retry_max), 1))
+        self.retry_backoff_ms = (retry_backoff_ms_default()
+                                 if retry_backoff_ms is None
+                                 else float(retry_backoff_ms))
+        #: drain policy: requests with at most this many tokens left
+        #: finish on the draining host; longer ones migrate
+        self.drain_inplace_tokens = (self.avg_new_tokens
+                                     if drain_inplace_tokens is None
+                                     else int(drain_inplace_tokens))
         self.admitted = 0
         self.rejected = 0
+        self.failovers = 0
+        self.duplicates = 0
         self._ticks = 0
         self._burst_rid = 0
         # submits this router made that the host telemetry cannot have
         # absorbed yet; decays when a fresher stats row shows up
         self._pending_guess = [0] * len(self.hosts)
         self._last_submit_t = [0.0] * len(self.hosts)
+        self._health = [_HostHealth() for _ in self.hosts]
+        self._tracked: Dict[object, _Tracked] = {}
+        self._orphans: List[_Tracked] = []
+        #: rid -> result dict (token_ids reassembled across hosts);
+        #: the dedup point for idempotent re-submits. Bounded: past
+        #: ``completed_max`` the oldest results are evicted to a
+        #: rid-only tombstone set, so a long-running router's memory
+        #: tracks the working set, not total request history, while
+        #: dedup of arbitrarily late duplicates keeps working
+        self.completed: Dict[object, dict] = {}
+        self.completed_max = 4096
+        self._completed_rids: set = set()
+
+    # -- introspection ------------------------------------------------------
+    def host_state(self, idx: int) -> str:
+        return self._health[idx].state
+
+    def inflight(self) -> int:
+        return len(self._tracked) + len(self._orphans)
+
+    def outstanding(self, idx: Optional[int] = None) -> List[object]:
+        """rids tracked on one host (or orphaned, for ``idx=None``)."""
+        if idx is None:
+            return [e.rid for e in self._orphans]
+        return [rid for rid, e in self._tracked.items()
+                if e.host == idx]
 
     # -- request-scoped tracing (ISSUE 14) ---------------------------------
     def _stamp_trace(self, req):
@@ -363,14 +680,19 @@ class Router:
         # pending request keeps the units comparable)
         return float(pending)
 
-    def _eligible(self, idx: int, st: HostStats) -> bool:
+    def _live(self, idx: int) -> bool:
+        return self._health[idx].state == "healthy"
+
+    def _ineligible_why(self, idx: int, st: HostStats) -> Optional[str]:
+        if not self._live(idx):
+            return "not_live"
         depth = st.queue_depth + self._pending_guess[idx]
         if depth >= self.admit_queue:
-            return False
+            return "queue_full"
         if self.admit_ttft_ms > 0 and self._predicted_wait_ms(
                 st, self._pending_guess[idx]) > self.admit_ttft_ms:
-            return False
-        return True
+            return "ttft_slo"
+        return None
 
     def _refresh_guess(self, idx: int, st: HostStats) -> None:
         # a stats row OBSERVED after our last submit already counts
@@ -381,19 +703,48 @@ class Router:
 
     def submit(self, req) -> Optional[int]:
         """Route one request; returns the host index, or None when
-        admission control rejected it (all hosts over limit). Stamps a
-        ``trace_id`` on the request (the root of its span chain)."""
+        admission control rejected it (no live host under its limits).
+        Stamps a ``trace_id`` (the root of its span chain) and TRACKS
+        the admitted request for failover."""
         tid, rid = self._stamp_trace(req)
+        fields = _req_fields(req)
+        if fields.get("rid") is None:
+            # tracking (and idempotent failover) needs a stable id even
+            # for anonymous dict requests
+            fields["rid"] = rid = f"r{os.getpid():x}-{next(_trace_counter)}"
+        now = time.time()
+        entry = _Tracked(fields, tid, -1, now)
+        placed = self._route(entry, now)
+        if placed is None:
+            self.rejected += 1
+            return None
+        # counted HERE, not in _route: failover/orphan re-submissions
+        # re-place work that was already admitted once — admitted vs
+        # completed must reconcile per request, not per placement
+        self.admitted += 1
+        return placed
+
+    def _route(self, entry: _Tracked, now: float,
+               emit_reject: bool = True) -> Optional[int]:
+        """The shared scheduling core for fresh submits AND failover
+        re-submits: choose among live, in-bounds hosts; on success the
+        entry is tracked on its host. Rejections emit the `router_admit`
+        row with the reason the surviving fleet gave."""
         stats = []
+        reasons = []
         for i, h in enumerate(self.hosts):
             st = h.stats()
             self._refresh_guess(i, st)
             stats.append(st)
-        candidates = [i for i, st in enumerate(stats)
-                      if self._eligible(i, st)]
+            reasons.append(self._ineligible_why(i, st))
+        candidates = [i for i, why in enumerate(reasons) if why is None]
         if not candidates:
-            self.rejected += 1
-            self._emit_admit(None, stats, tid, rid)
+            if emit_reject:
+                live = [w for w in reasons if w != "not_live"]
+                reason = ("no_live_host" if not live
+                          else "+".join(sorted(set(live))))
+                self._emit_admit(None, stats, entry.trace_id, entry.rid,
+                                 reason)
             return None
         best = min(candidates, key=lambda i: self._predicted_wait_ms(
             stats[i], self._pending_guess[i]))
@@ -401,25 +752,31 @@ class Router:
         # BEFORE this submit bumps the pending guess
         predicted = self._predicted_wait_ms(stats[best],
                                             self._pending_guess[best])
-        self.hosts[best].submit(req)
+        self.hosts[best].submit(dict(entry.fields))
+        entry.host = best
+        entry.t_submit = now
+        entry.progress = []
+        self._tracked[entry.rid] = entry
         self._pending_guess[best] += 1
         self._last_submit_t[best] = time.time()
-        self.admitted += 1
-        self._emit_span(tid, rid, best, predicted)
+        self._emit_span(entry.trace_id, entry.rid, best, predicted)
         return best
 
     # -- control loop ------------------------------------------------------
     def tick(self) -> List[Optional[int]]:
         """One scheduling tick: drain armed ``serve`` fault events
         (each ``burst`` submits its synthetic requests through normal
-        admission) and publish `router_metrics`. Returns the burst
-        routing outcomes (host index or None per synthetic request)."""
+        admission), fold host telemetry into health state, fail over
+        the in-flight requests of hosts that crossed the dead line,
+        finish drains, retry orphans, and publish `router_metrics`.
+        Returns the burst routing outcomes (host index or None per
+        synthetic request)."""
         fi = _fault()
         self._ticks += 1
         outcomes: List[Optional[int]] = []
         for action, arg in fi.consume_serve_events():
             if action != "burst":
-                continue  # slow_host is the worker's event
+                continue  # the other serve events are the worker's
             n = int(arg) if arg else 8
             for _ in range(n):
                 self._burst_rid += 1
@@ -428,8 +785,293 @@ class Router:
                     "prompt_ids": list(range(self.burst_prompt_len)),
                     "max_new_tokens": self.burst_new_tokens,
                 }))
+        now = time.time()
+        self._poll_hosts(now)
+        self._evaluate_health(now)
+        self._finish_drains(now)
+        self._resubmit_orphans(now)
         self._emit_metrics()
         return outcomes
+
+    # -- health: signal folding --------------------------------------------
+    def _poll_hosts(self, now: float) -> None:
+        for i, h in enumerate(self.hosts):
+            sig_fn = getattr(h, "signals", None)
+            if sig_fn is None:
+                continue
+            sig = sig_fn() or {}
+            hh = self._health[i]
+            lt = sig.get("live_t")
+            if isinstance(lt, (int, float)):
+                hh.live_t = lt if hh.live_t is None else max(hh.live_t, lt)
+            st = sig.get("service_t")
+            if isinstance(st, (int, float)):
+                hh.service_t = (st if hh.service_t is None
+                                else max(hh.service_t, st))
+            for rid, toks in (sig.get("progress") or {}).items():
+                e = self._tracked.get(rid)
+                if e is None or e.host != i:
+                    continue  # a late copy on an abandoned host: ignore
+                if len(toks) > len(e.progress):
+                    e.progress = [int(t) for t in toks]
+                    hh.service_t = now
+            for res in sig.get("results") or ():
+                self._complete(i, res)
+                hh.service_t = now
+
+    def _complete(self, host_idx: int, res: dict) -> None:
+        """Fold one host result in. ``token_ids`` is the FULL
+        continuation (resume prefix + new tokens), so results from the
+        original and the failed-over submission are directly
+        comparable — first one wins, the rest count as duplicates (the
+        idempotent-rid contract)."""
+        rid = res.get("rid")
+        if rid in self.completed or rid in self._completed_rids:
+            self.duplicates += 1
+            e = self._tracked.pop(rid, None)
+            if e is not None and e.host != host_idx:
+                # a third copy is still running somewhere: withdraw it
+                self._cancel_on_host(e.host, rid)
+            return
+        e = self._tracked.pop(rid, None)
+        out = {
+            "rid": rid,
+            "tokens": [int(t) for t in res.get("token_ids") or []],
+            "host": host_idx,
+            "resumed": int(res.get("resumed", 0)),
+            "trace_id": (e.trace_id if e is not None
+                         else res.get("trace_id")),
+        }
+        for k in ("ttft_ms", "latency_ms", "rank"):
+            if k in res:
+                out[k] = res[k]
+        self.completed[rid] = out
+        while len(self.completed) > self.completed_max:
+            old = next(iter(self.completed))  # oldest: insertion order
+            del self.completed[old]
+            self._completed_rids.add(old)
+        if e is not None and e.host != host_idx:
+            # the ORIGINAL host recovered and finished first: withdraw
+            # the failed-over copy so the survivor stops wasting work
+            self._cancel_on_host(e.host, rid)
+
+    def _cancel_on_host(self, idx: int, rid) -> None:
+        if idx is None or not (0 <= idx < len(self.hosts)):
+            return
+        h = self.hosts[idx]
+        try:
+            if hasattr(h, "cancel"):
+                h.cancel(rid)
+            elif hasattr(h, "send_verb"):
+                h.send_verb("cancel", rid)
+        except OSError:
+            pass  # best-effort: dedup already guarantees correctness
+
+    # -- health: evaluation ------------------------------------------------
+    def _evaluate_health(self, now: float) -> None:
+        for i, h in enumerate(self.hosts):
+            if not getattr(h, "can_fail", True):
+                continue
+            hh = self._health[i]
+            if hh.state in ("dead", "retired"):
+                continue
+            outstanding = [e for e in self._tracked.values()
+                           if e.host == i]
+            if hh.state in ("healthy", "draining"):
+                if not outstanding:
+                    continue
+                # the host owes a sign of service within the timeout of
+                # either its last service signal or the moment the
+                # oldest outstanding request reached it
+                ref = max([hh.service_t or 0.0] +
+                          [min(e.t_submit for e in outstanding)])
+                stall_ms = (now - ref) * 1e3
+                if stall_ms <= self.host_timeout_ms:
+                    continue
+                hh.prior = hh.state
+                hh.state = "suspect"
+                hh.suspect_t = now
+                hh.probes = 0
+                hh.next_probe_t = now + self.retry_backoff_ms / 1e3
+                live_stale = (hh.live_t is None or
+                              (now - hh.live_t) * 1e3 >
+                              self.host_timeout_ms)
+                hh.reason = ("silent" if live_stale else "unresponsive")
+                self._emit_host_event("router_host_suspect", i, hh,
+                                      stall_ms=round(stall_ms, 1),
+                                      inflight=len(outstanding))
+            elif hh.state == "suspect":
+                if hh.service_t is not None and \
+                        hh.service_t > hh.suspect_t:
+                    # a sign of service during probation: stand down
+                    hh.state = hh.prior
+                    hh.probes = 0
+                    self._emit_host_event("router_host_recovered", i, hh)
+                    continue
+                if now < hh.next_probe_t:
+                    continue
+                hh.probes += 1
+                if hh.probes >= self.retry_max:
+                    self._declare_dead(i, now)
+                else:
+                    hh.next_probe_t = now + (
+                        self.retry_backoff_ms / 1e3) * (2 ** hh.probes)
+
+    def _declare_dead(self, idx: int, now: float) -> None:
+        h = self.hosts[idx]
+        hh = self._health[idx]
+        hh.state = "dead"
+        # re-judge liveness at VERDICT time: at suspicion the heartbeat
+        # of a just-crashed host is only borderline-stale, but by now a
+        # crash has been silent for the whole probation — only a hang
+        # (alive, not serving) still shows a fresh heartbeat
+        live_stale = (hh.live_t is None or
+                      (now - hh.live_t) * 1e3 > self.host_timeout_ms)
+        hh.reason = "silent" if live_stale else "unresponsive"
+        victims = [e for e in self._tracked.values() if e.host == idx]
+        bus = _bus()
+        if bus.enabled():
+            bus.emit("router_host_dead", {
+                "host": idx,
+                "host_rank": getattr(h, "rank", None),
+                "reason": hh.reason,
+                "silent_ms": round((now - hh.suspect_t) * 1e3
+                                   + self.host_timeout_ms, 1),
+                "probes": hh.probes,
+                "inflight": len(victims),
+            }, step=self._ticks)
+        for e in victims:
+            self._failover(e, idx, now, kind="failover")
+        if victims and bus.enabled():
+            bus.emit("router_failover", {
+                "host": idx, "requests": len(victims),
+                "orphaned": len(self._orphans),
+            }, step=self._ticks)
+
+    # -- failover / resume --------------------------------------------------
+    def _failover(self, e: _Tracked, from_host: int, now: float, *,
+                  kind: str) -> Optional[int]:
+        """Move one in-flight request off ``from_host`` via the resume
+        path: prefix = old resume + everything the host emitted, budget
+        decremented, SAME rid (idempotent — a recovering host's late
+        copy deduplicates instead of double-serving)."""
+        self._tracked.pop(e.rid, None)
+        prefix = list(e.fields.get("resume_tokens") or []) + \
+            [int(t) for t in e.progress]
+        budget_left = int(e.fields.get("max_new_tokens", 0)) - \
+            len(e.progress)
+        span_payload = {
+            "rid": e.rid,
+            "from_host": from_host,
+            "resumed": len(prefix),
+            # the slice: how long the request lived on the abandoned
+            # host (timeline renders it on the request's trace lane)
+            "dur_ms": round((now - e.t_submit) * 1e3, 3),
+        }
+        eos = e.fields.get("eos_id")
+        hit_eos = (eos is not None and eos != -1 and eos in e.progress)
+        if budget_left <= 0 or hit_eos:
+            # the host died (or drained) with the request effectively
+            # finished: the recovered prefix IS the answer
+            self.completed.setdefault(e.rid, {
+                "rid": e.rid, "tokens": prefix, "host": from_host,
+                "resumed": len(prefix) - len(e.progress),
+                "trace_id": e.trace_id,
+            })
+            span_payload["to_host"] = None
+            span_payload["completed_from_progress"] = True
+            self._emit_fail_span(kind, e.trace_id, span_payload)
+            return None
+        fields = dict(e.fields)
+        fields["resume_tokens"] = prefix
+        fields["max_new_tokens"] = budget_left
+        e.fields = fields
+        e.progress = []
+        e.host = -1
+        e.attempts += 1
+        self.failovers += 1
+        placed = self._route(e, now)
+        span_payload["to_host"] = placed
+        self._emit_fail_span(kind, e.trace_id, span_payload)
+        if placed is None:
+            # no live host right now: ORPHANED, retried every tick —
+            # shrunk capacity sheds NEW work, never admitted work
+            self._orphans.append(e)
+        return placed
+
+    def _resubmit_orphans(self, now: float) -> None:
+        if not self._orphans:
+            return
+        pending, self._orphans = self._orphans, []
+        for e in pending:
+            if e.rid in self.completed or e.rid in self._completed_rids:
+                continue  # a recovering host delivered meanwhile
+            # emit_reject=False: the shed-load row fired when the
+            # request was orphaned; re-emitting a NOTABLE rejected row
+            # per orphan per tick would flood the bus and the incident
+            # correlator during an outage
+            if self._route(e, now, emit_reject=False) is None:
+                self._orphans.append(e)
+
+    # -- drain --------------------------------------------------------------
+    def drain_host(self, idx: int) -> dict:
+        """Live drain (round 15): stop admissions to host ``idx``, let
+        short requests (≤ ``drain_inplace_tokens`` left) finish in
+        place, migrate long ones via the resume path (cancelling them
+        on the drainer), and send the ``drain`` verb so the worker
+        retires rc 0 once its queue is empty. Returns a summary dict;
+        the host reaches ``retired`` state on the tick that sees its
+        last outstanding request finish."""
+        if not (0 <= idx < len(self.hosts)):
+            raise ValueError(f"no host {idx}")
+        hh = self._health[idx]
+        if hh.state in ("dead", "retired"):
+            raise ValueError(
+                f"host {idx} is {hh.state}; nothing to drain")
+        now = time.time()
+        # fold the freshest progress in first: migration resumes from
+        # what the host actually emitted, not a stale view
+        self._poll_hosts(now)
+        hh.state = "draining"
+        hh.prior = "draining"
+        hh.drain_t = now
+        migrated, in_place = 0, 0
+        for e in [t for t in self._tracked.values() if t.host == idx]:
+            left = int(e.fields.get("max_new_tokens", 0)) - \
+                len(e.progress)
+            if left > self.drain_inplace_tokens:
+                self._cancel_on_host(idx, e.rid)
+                self._failover(e, idx, now, kind="drain")
+                migrated += 1
+            else:
+                in_place += 1
+        h = self.hosts[idx]
+        if hasattr(h, "send_verb"):
+            h.send_verb("drain")
+        bus = _bus()
+        if bus.enabled():
+            bus.emit("router_drain", {
+                "host": idx,
+                "host_rank": getattr(h, "rank", None),
+                "migrated": migrated,
+                "in_place": in_place,
+            }, step=self._ticks)
+        return {"host": idx, "migrated": migrated, "in_place": in_place}
+
+    def _finish_drains(self, now: float) -> None:
+        for i, hh in enumerate(self._health):
+            if hh.state != "draining":
+                continue
+            if any(e.host == i for e in self._tracked.values()):
+                continue
+            hh.state = "retired"
+            bus = _bus()
+            if bus.enabled():
+                bus.emit("router_host_retired", {
+                    "host": i,
+                    "host_rank": getattr(self.hosts[i], "rank", None),
+                    "drain_ms": round((now - hh.drain_t) * 1e3, 1),
+                }, step=self._ticks)
 
     # -- telemetry ---------------------------------------------------------
     def _emit_metrics(self) -> None:
@@ -440,22 +1082,39 @@ class Router:
             "hosts": len(self.hosts),
             "admitted": self.admitted,
             "rejected": self.rejected,
+            "failovers": self.failovers,
+            "duplicates": self.duplicates,
+            "orphans": len(self._orphans),
         }
         total = 0
         for i, h in enumerate(self.hosts):
             st = h.stats()
             depth = st.queue_depth + self._pending_guess[i]
             payload[f"host{i}_queue_depth"] = depth
+            payload[f"host{i}_state"] = self._health[i].state
             total += depth
         payload["queue_depth_total"] = total
         bus.emit("router_metrics", payload, step=self._ticks)
 
-    def _emit_admit(self, host: Optional[int], stats, trace_id=None,
-                    rid=None) -> None:
+    def _emit_host_event(self, kind: str, idx: int, hh: _HostHealth,
+                         **extra) -> None:
         bus = _bus()
         if not bus.enabled():
             return
-        bus.emit("router_admit", {
+        payload = {"host": idx,
+                   "host_rank": getattr(self.hosts[idx], "rank", None),
+                   "state": hh.state, "reason": hh.reason}
+        payload.update(extra)
+        bus.emit(kind, payload, step=self._ticks)
+
+    def _emit_admit(self, host: Optional[int], stats, trace_id=None,
+                    rid=None, reason: Optional[str] = None) -> None:
+        if host is not None:
+            return  # admitted rows ride the router_submit span instead
+        bus = _bus()
+        if not bus.enabled():
+            return
+        payload = {
             "host": host,
             "outcome": "rejected" if host is None else "admitted",
             "depths": [s.queue_depth for s in stats],
@@ -463,7 +1122,13 @@ class Router:
             "admit_ttft_ms": self.admit_ttft_ms,
             "trace_id": trace_id,
             "rid": rid,
-        }, step=self._ticks)
+        }
+        if reason is not None:
+            # why the SURVIVING fleet shed this request (round 15)
+            payload["reason"] = reason
+            payload["live_hosts"] = sum(
+                1 for hh in self._health if hh.state == "healthy")
+        bus.emit("router_admit", payload, step=self._ticks)
 
     def _emit_span(self, trace_id, rid, host: int,
                    predicted_wait_ms: float) -> None:
@@ -478,24 +1143,56 @@ class Router:
             "predicted_wait_ms": round(predicted_wait_ms, 3),
         }, step=self._ticks)
 
+    def _emit_fail_span(self, kind: str, trace_id, payload: dict) -> None:
+        """The failover/drain slice on the request's trace lane
+        (``dur_ms`` = its life on the abandoned host; timeline renders
+        a duration slice ending at this row's time)."""
+        bus = _bus()
+        if not bus.enabled():
+            return
+        bus.emit_span(kind, trace_id, payload, step=self._ticks)
+
 
 # ---------------------------------------------------------------------------
 # the dryrun host worker (jax-free: the serving CONTROL plane must not
 # pay an interpreter-plus-jax startup per host in the launcher matrix)
 # ---------------------------------------------------------------------------
 
+#: simulated tokens per decode window — the worker's SYNC_EVERY analog:
+#: progress/metrics rows ride window boundaries, so a crash loses at
+#: most one window of host-visible progress (exactly like the engine)
+_WORKER_WINDOW = 4
+
 
 def worker_main(argv: Optional[List[str]] = None) -> int:
     """Simulated host worker for the launcher-driven multi-process
     dryrun: polls ``<base>/host{rank}/inbox``, queues requests, decodes
-    them at ``rate`` tokens/sec of simulated work, and emits the SAME
-    telemetry rows a real engine does — ``decode_metrics`` per poll
-    (tokens/sec, queue depth, inflight, TTFT) and ``decode_request``
-    per completion — into its launcher-provisioned per-rank bus stream.
-    A ``serve:slow_host:nth[:rank]`` fault rule matching this rank
-    multiplies its simulated work 20x: the degradation the router must
-    route around, visible ONLY through telemetry. Exits when
-    ``<base>/stop`` appears and the inbox is drained."""
+    them WINDOW BY WINDOW at ``rate`` tokens/sec of simulated work with
+    the deterministic :func:`sim_next_token` chain (a pure function of
+    the prefix — greedy in spirit, so resumed requests continue
+    token-exactly), and emits the SAME telemetry rows a real engine
+    does: ``decode_metrics`` per poll (tokens/sec, queue depth,
+    inflight, step_ms — the heartbeat), ``worker_ack`` per ingested
+    request, ``worker_progress`` per decode window (rid + cumulative
+    new tokens — what the router's failover resumes from), and
+    ``decode_request`` per completion, into its launcher-provisioned
+    per-rank bus stream. Results land as ``outbox/done_<rid>.json``
+    with ``token_ids`` = the FULL continuation (resume prefix + new).
+
+    Verbs (round 15): a ``{"verb": "drain"}`` inbox file finishes the
+    queue then exits rc 0 (planned retirement); ``{"verb": "cancel",
+    "rid": r}`` withdraws one request (dropped from the queue, or
+    abandoned mid-decode without a result).
+
+    Faults (``serve`` site, rank-targeted): ``slow_host`` multiplies
+    simulated work 20x; ``straggler`` adds a fixed per-window delay;
+    ``host_crash`` SIGKILLs the process at the next MID-DECODE window
+    boundary (progress emitted, result not — the failover path's
+    prey); ``hang`` stops draining the mailbox and serving but keeps
+    the process and its ``decode_metrics`` heartbeat ALIVE — the
+    detector's harder prey (liveness looks fine; only the service
+    deadline sees it). Exits when ``<base>/stop`` appears and the
+    inbox is drained (a hung worker exits on ``stop`` alone)."""
     argv = list(sys.argv[1:] if argv is None else argv)
     if len(argv) < 2:
         print("usage: router.py <repo_root> <mailbox_base> "
@@ -515,67 +1212,145 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     stop_path = os.path.join(base, "stop")
     queue: List[dict] = []
     seen = set()
+    cancelled = set()
     slow = 1.0
     straggle_s = 0.0
+    hung = False
+    crash_armed = False
+    draining = False
+    current: Optional[dict] = None
     windows = 0
+
+    def _mine(arg) -> bool:
+        return (arg or 0) == rank
+
     while True:
         for action, arg in fi.consume_serve_events():
-            if action == "slow_host" and (arg or 0) == rank:
+            if action == "slow_host" and _mine(arg):
                 slow = 20.0
-            elif action == "straggler" and (arg or 0) == rank:
+            elif action == "straggler" and _mine(arg):
                 # ISSUE 14: a fixed per-window decode delay on ONE rank
                 # — the fleet monitor's skew detector must NAME it from
                 # the step_ms telemetry alone
                 straggle_s = 0.25
+            elif action == "host_crash" and _mine(arg):
+                crash_armed = True
+            elif action == "hang" and _mine(arg):
+                hung = True
         w0 = time.perf_counter()
         if straggle_s:
             time.sleep(straggle_s)
-        for name in sorted(os.listdir(inbox)):
-            if not name.endswith(".json") or name in seen:
-                continue
-            seen.add(name)
-            try:
-                with open(os.path.join(inbox, name)) as f:
-                    req = json.load(f)
-            except (OSError, ValueError):
-                continue
-            req["t_arrive"] = time.time()
-            queue.append(req)
+        if not hung:
+            acked = []
+            for name in sorted(os.listdir(inbox)):
+                if not name.endswith(".json") or name in seen:
+                    continue
+                seen.add(name)
+                try:
+                    with open(os.path.join(inbox, name)) as f:
+                        row = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                verb = row.get("verb")
+                if verb == "drain":
+                    draining = True
+                    continue
+                if verb == "cancel":
+                    cancelled.add(row.get("rid"))
+                    if current is not None and \
+                            current["req"].get("rid") == row.get("rid"):
+                        current = None  # abandon mid-decode, no result
+                    continue
+                row["t_arrive"] = time.time()
+                queue.append(row)
+                acked.append(row.get("rid"))
+            if acked:
+                # the ack row: receipt, distinct from service — a
+                # request deep in the queue is WAITING, not lost
+                bus.emit("worker_ack", {"rids": acked}, step=windows)
         served_tokens = 0
         t0 = time.perf_counter()
-        if queue:
-            req = queue.pop(0)
-            tid = req.get("trace_id")
-            n = int(req.get("max_new_tokens", 16))
-            bus.emit_span("admit", tid, {
-                "rid": req.get("rid"),
-                "queue_wait_ms": round(
-                    (time.time() - req["t_arrive"]) * 1e3, 3)})
-            # simulated decode: n tokens at rate tokens/sec (slowed
-            # when degraded) — wall clock the telemetry prices
-            time.sleep(n / rate * slow)
-            served_tokens = n
-            ttft_ms = (time.time() - req["t_arrive"]) * 1e3
-            bus.emit("decode_request", {
-                "rid": req.get("rid"), "tokens": n,
-                "latency_ms": round(ttft_ms, 3),
-                "prefill_ms": 0.0,
-                "ttft_ms": round(ttft_ms, 3),
-                "ms_per_token": round(ttft_ms / max(n, 1), 3),
-                "trace_id": tid,
-            })
-            out = {"rid": req.get("rid"), "tokens": n, "rank": rank,
-                   "ttft_ms": round(ttft_ms, 3)}
-            path = os.path.join(outbox, f"done_{req.get('rid')}.json")
-            with open(path + ".tmp", "w") as f:
-                json.dump(out, f)
-            os.replace(path + ".tmp", path)
+        if not hung:
+            while current is None and queue:
+                req = queue.pop(0)
+                if req.get("rid") in cancelled:
+                    continue
+                resume = [int(t) for t in req.get("resume_tokens") or []]
+                current = {
+                    "req": req,
+                    # the greedy chain: prompt + resumed prefix, new
+                    # tokens appended as they are "decoded"
+                    "chain": [int(t) for t in req.get("prompt_ids")
+                              or []] + resume,
+                    "resume": resume,
+                    "emitted": [],
+                    "t_first": None,
+                }
+                bus.emit_span("admit", req.get("trace_id"), {
+                    "rid": req.get("rid"),
+                    "queue_wait_ms": round(
+                        (time.time() - req["t_arrive"]) * 1e3, 3)},
+                    step=windows)
+            if current is not None:
+                req = current["req"]
+                budget = int(req.get("max_new_tokens", 16))
+                take = min(_WORKER_WINDOW, budget - len(current["emitted"]))
+                # simulated decode: `take` tokens at rate tokens/sec
+                # (slowed when degraded) — wall clock the telemetry
+                # prices
+                time.sleep(take / rate * slow)
+                for _ in range(take):
+                    tok = sim_next_token(current["chain"])
+                    current["chain"].append(tok)
+                    current["emitted"].append(tok)
+                if current["t_first"] is None:
+                    current["t_first"] = time.time()
+                served_tokens = take
+                bus.emit("worker_progress", {
+                    "rid": req.get("rid"),
+                    "trace_id": req.get("trace_id"),
+                    "tokens": list(current["emitted"]),
+                }, step=windows)
+                if crash_armed:
+                    # mid-decode by construction: >= 1 window of this
+                    # request's progress is on the bus, its result is
+                    # not — the router must recover it token-exactly
+                    print(f"fault_injection: serve:host_crash — SIGKILL "
+                          f"rank {rank} mid-decode", file=sys.stderr,
+                          flush=True)
+                    os.kill(os.getpid(), _signal.SIGKILL)
+                if len(current["emitted"]) >= budget:
+                    ttft_ms = (current["t_first"] - req["t_arrive"]) * 1e3
+                    latency_ms = (time.time() - req["t_arrive"]) * 1e3
+                    n = len(current["emitted"])
+                    bus.emit("decode_request", {
+                        "rid": req.get("rid"), "tokens": n,
+                        "latency_ms": round(latency_ms, 3),
+                        "prefill_ms": 0.0,
+                        "ttft_ms": round(ttft_ms, 3),
+                        "ms_per_token": round(latency_ms / max(n, 1), 3),
+                        "trace_id": req.get("trace_id"),
+                    }, step=windows)
+                    out = {"rid": req.get("rid"),
+                           "token_ids": current["resume"]
+                           + current["emitted"],
+                           "resumed": len(current["resume"]),
+                           "tokens": n, "rank": rank,
+                           "trace_id": req.get("trace_id"),
+                           "ttft_ms": round(ttft_ms, 3),
+                           "latency_ms": round(latency_ms, 3)}
+                    path = os.path.join(outbox,
+                                        f"done_{req.get('rid')}.json")
+                    with open(path + ".tmp", "w") as f:
+                        json.dump(out, f)
+                    os.replace(path + ".tmp", path)
+                    current = None
         windows += 1
         dt = time.perf_counter() - t0
         payload = {
             "steps": 1,
             "tokens": served_tokens,
-            "inflight_slots": 1 if served_tokens else 0,
+            "inflight_slots": 1 if current is not None else 0,
             "queue_depth": len(queue),
             # per-window wall time: the fleet monitor's skew signal
             "step_ms": round((time.perf_counter() - w0) * 1e3, 3),
@@ -583,10 +1358,19 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
         if served_tokens and dt > 0:
             payload["tokens_per_sec"] = round(served_tokens / dt, 1)
         bus.emit("decode_metrics", payload, step=windows)
-        if not queue and os.path.exists(stop_path):
+        if hung:
+            # the mailbox rots, the heartbeat doesn't; the operator's
+            # stop file still ends the process cleanly
+            if os.path.exists(stop_path):
+                return 0
+            time.sleep(poll_s)
+            continue
+        idle = current is None and not queue
+        if idle:
             leftover = [n for n in os.listdir(inbox)
                         if n.endswith(".json") and n not in seen]
-            if not leftover:
+            if not leftover and (draining or os.path.exists(stop_path)):
+                # drain verb (round 15) or the global stop: retire rc 0
                 return 0
         if not served_tokens:
             time.sleep(poll_s)

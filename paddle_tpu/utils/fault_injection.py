@@ -37,15 +37,23 @@
   logical rank, default the last rank, so
   ``PADDLE_FAULT_SPEC="rank:depart:3:1"`` loses rank 1 at step 3 and
   ``rank:depart:3:1,rank:return:6:1`` brings it back at step 6), or
-  ``burst`` / ``slow_host`` / ``straggler`` (``serve`` only: arm a
-  serving-tier event the router/worker drains at its next tick —
-  ``serve:burst:2:8`` injects an 8-request burst at the router's 2nd
-  tick (admission control's prey), ``serve:slow_host:1:0`` degrades
-  host rank 0 from its 1st poll (the SLO scheduler routes away from
-  it), ``serve:straggler:1:2`` adds a fixed per-window decode delay on
-  host rank 2 from its 1st poll (the fleet monitor's skew detector
-  must NAME that rank); ``arg`` defaults: burst 8 requests,
-  slow_host/straggler rank 0), or ``drop`` / ``dup`` (``mon`` only:
+  ``burst`` / ``slow_host`` / ``straggler`` / ``host_crash`` (``serve``
+  only: arm a serving-tier event the router/worker drains at its next
+  tick — ``serve:burst:2:8`` injects an 8-request burst at the
+  router's 2nd tick (admission control's prey), ``serve:slow_host:1:0``
+  degrades host rank 0 from its 1st poll (the SLO scheduler routes
+  away from it), ``serve:straggler:1:2`` adds a fixed per-window decode
+  delay on host rank 2 from its 1st poll (the fleet monitor's skew
+  detector must NAME that rank), ``serve:host_crash:2:0`` SIGKILLs the
+  host-rank-0 worker at its next MID-DECODE window after its 2nd poll
+  (the failover path's prey: the process dies with a request half
+  served, ISSUE 15); ``arg`` defaults: burst 8 requests,
+  slow_host/straggler/host_crash rank 0. At the ``serve`` site the
+  generic ``hang`` action is ALSO rank-targeted and event-armed
+  (``serve:hang:1:1`` = host rank 1 stops draining its mailbox but
+  keeps the process — and its telemetry heartbeat — alive, the
+  failure detector's harder prey); everywhere else ``hang`` keeps its
+  sleep-``arg``-seconds semantics), or ``drop`` / ``dup`` (``mon`` only:
   the telemetry bus consumes the rule at its nth row write and drops /
   duplicates that one line — the monitor's incremental cursor and
   count-based aggregation must survive a lossy, re-appending stream).
@@ -77,7 +85,7 @@ __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
 _ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
             "spike", "depart", "return", "burst", "slow_host",
-            "straggler", "drop", "dup")
+            "straggler", "host_crash", "drop", "dup")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
 # grad poison only makes sense where a compiled step consumes the flag
@@ -88,8 +96,10 @@ _GRAD_SITES = ("grad",)
 _RANK_ACTIONS = ("depart", "return")
 _RANK_SITES = ("rank",)
 # serving-tier events only make sense where the router/worker polls
-# for them (serving/router.py scheduling tick / host-worker loop)
-_SERVE_ACTIONS = ("burst", "slow_host", "straggler")
+# for them (serving/router.py scheduling tick / host-worker loop);
+# `hang` doubles as a serve event when a rule targets that site (the
+# worker consumes it as "stop draining the mailbox, stay alive")
+_SERVE_ACTIONS = ("burst", "slow_host", "straggler", "host_crash")
 _SERVE_SITES = ("serve",)
 # bus-line faults only make sense where a bus row is being written
 # (observability/bus.py emit — the fleet monitor's cursor prey)
@@ -201,6 +211,18 @@ class FaultInjector:
             print(f"fault_injection: killing process at {tag} "
                   f"exit={code}", file=sys.stderr, flush=True)
             os._exit(code)
+        if r.action == "hang" and site in _SERVE_SITES:
+            # serve-site hang is an EVENT, not a sleep: the targeted
+            # worker (arg = host rank, default 0) stops draining its
+            # mailbox while its process — and telemetry heartbeat —
+            # stays alive; sleeping here would stall the router's own
+            # scheduling tick instead of the host under test
+            arg = int(r.arg) if r.arg else None
+            print(f"fault_injection: arming serve:hang"
+                  f"{'' if arg is None else f':{arg}'} at {tag}",
+                  file=sys.stderr, flush=True)
+            self.serve_events.append(("hang", arg))
+            return
         if r.action == "hang":
             secs = float(r.arg) if r.arg else 3600.0
             print(f"fault_injection: hanging {secs}s at {tag}",

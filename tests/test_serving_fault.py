@@ -1,0 +1,924 @@
+"""Fault-tolerant serving plane (ISSUE 15): host failover with
+token-exact request recovery, live drain + slot migration,
+retry/timeout/backoff in the router.
+
+Acceptance contracts tested here:
+- the host state machine (healthy → suspect → dead; healthy →
+  draining → retired) is driven by telemetry alone: a CRASHED host
+  goes silent (heartbeat + service stop), a HUNG host keeps its
+  heartbeat but stops serving — both cross the dead line after
+  ``PADDLE_SERVE_HOST_TIMEOUT_MS`` + exp-backoff probation, and a host
+  that shows service during probation stands down with no failover;
+- recovery is TOKEN-EXACT for greedy requests at every interruption
+  phase (queued / mid-prefill / mid-decode), asserted against an
+  uninterrupted oracle — the deterministic worker chain for the
+  control-plane matrix, a REAL engine pair for the model path;
+- re-submits are IDEMPOTENT: a host that recovers after the dead
+  verdict and serves its stale copy is deduplicated, never
+  double-counted;
+- ``Router.drain_host`` stops admissions, finishes short requests in
+  place, migrates long ones (resume + cancel on the drainer), and the
+  drained worker process exits rc 0;
+- the launcher-driven jax-free multi-process dryrun survives an
+  injected ``serve:host_crash`` mid-decode with ZERO dropped requests,
+  launcher rc 0, and an `incident` row naming the dead host before
+  launch() returns (the ISSUE 15 acceptance pin).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.observability import bus
+from paddle_tpu.serving.router import (
+    FileHost, HostStats, LocalHost, Router, sim_next_token,
+)
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _restore_mesh():
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+@pytest.fixture()
+def trivial_mesh():
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    comm._state.hybrid_mesh = None
+    comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+@pytest.fixture()
+def obs_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "obs")
+    os.makedirs(d, exist_ok=True)
+    monkeypatch.setenv("PADDLE_OBS_DIR", d)
+    bus.reset()
+    yield d
+    bus.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PADDLE_FAULT_SPEC", raising=False)
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _tiny_lm(vocab=48, cap=64, layers=2, heads=4, d=32, seed=7):
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import TransformerLM
+
+    paddle.seed(seed)
+    m = TransformerLM(vocab, d_model=d, num_heads=heads,
+                      num_layers=layers, max_position=cap)
+    m.eval()
+    return m
+
+
+def _sim_chain(prompt, n):
+    """The uninterrupted oracle for the deterministic worker/stub."""
+    chain = list(prompt)
+    out = []
+    for _ in range(n):
+        t = sim_next_token(chain)
+        chain.append(t)
+        out.append(t)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# a scriptable control-plane host (no jax): serves the deterministic
+# chain window by window, can crash (all rows stop), hang (heartbeat
+# continues, service stops), or recover — the router-side failure
+# semantics without a subprocess per matrix cell
+# ---------------------------------------------------------------------------
+
+
+class _ScriptHost:
+    can_fail = True
+
+    def __init__(self, window=2):
+        self.window = window
+        self.mode = "serve"  # serve | crash | hang
+        self.subs = []       # pending wire dicts
+        self.prog = {}       # rid -> new tokens so far
+        self.done = []
+        self.cancelled = []
+        self.held = set()    # rids acked but not yet decoding (prefill)
+        self._t_dead = None
+
+    # endpoint protocol -----------------------------------------------------
+    def submit(self, d):
+        if self.mode == "crash":
+            return  # black hole: the process is gone
+        self.subs.append(dict(d))
+
+    def stats(self):
+        return HostStats(queue_depth=len(self.subs), age_s=0.0)
+
+    def cancel(self, rid):
+        self.cancelled.append(rid)
+        self.subs = [d for d in self.subs if d.get("rid") != rid]
+        self.prog.pop(rid, None)
+
+    def send_verb(self, verb, rid=None):
+        if verb == "cancel":
+            self.cancel(rid)
+
+    def signals(self):
+        now = time.time()
+        if self.mode == "crash":
+            t = self._t_dead
+            return {"live_t": t, "service_t": t, "progress": {},
+                    "results": []}
+        if self.mode == "hang":
+            # alive but not serving: fresh heartbeat, frozen service
+            return {"live_t": now, "service_t": self._t_dead,
+                    "progress": {}, "results": []}
+        res, self.done = self.done, []
+        return {"live_t": now, "service_t": now,
+                "progress": {rid: list(t) for rid, t in
+                             self.prog.items()},
+                "results": res}
+
+    # the script ------------------------------------------------------------
+    def die(self, mode):
+        self.mode = mode
+        self._t_dead = time.time()
+
+    def revive(self):
+        self.mode = "serve"
+
+    def step(self):
+        """One decode window for every admitted request (held rids
+        stay 'in prefill': acked, zero tokens)."""
+        if self.mode != "serve":
+            return
+        for d in list(self.subs):
+            rid = d.get("rid")
+            if rid in self.cancelled or rid in self.held:
+                continue
+            cur = self.prog.setdefault(rid, [])
+            chain = (list(d.get("prompt_ids") or [])
+                     + list(d.get("resume_tokens") or []) + cur)
+            for _ in range(self.window):
+                if len(cur) >= d["max_new_tokens"]:
+                    break
+                tok = sim_next_token(chain)
+                chain.append(tok)
+                cur.append(tok)
+            if len(cur) >= d["max_new_tokens"]:
+                self.done.append({
+                    "rid": rid,
+                    "token_ids": list(d.get("resume_tokens") or []) + cur,
+                    "resumed": len(d.get("resume_tokens") or []),
+                })
+                self.subs.remove(d)
+                self.prog.pop(rid, None)
+
+
+def _fast_router(hosts, **kw):
+    kw.setdefault("host_timeout_ms", 120)
+    kw.setdefault("retry_backoff_ms", 25)
+    kw.setdefault("retry_max", 2)
+    kw.setdefault("avg_new_tokens", 8)
+    return Router(hosts, **kw)
+
+
+def _pump_until(router, hosts, pred, timeout=8.0, step_survivors=True):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        router.tick()
+        if step_survivors:
+            for h in hosts:
+                h.step()
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the fault matrix: crash/hang/drain x queued/mid-prefill/mid-decode
+# ---------------------------------------------------------------------------
+
+
+PHASES = ("queued", "mid_prefill", "mid_decode")
+
+
+def _submit_phase(router, victim, phase, rid="m", prompt=(3, 1, 4),
+                  budget=10):
+    """Put one request on the victim host in the named phase; returns
+    the tokens the victim emitted before the fault."""
+    if phase != "mid_decode":
+        victim.held.add(rid)  # acked, never decoding (prefill/queue)
+    placed = router.submit({"rid": rid, "prompt_ids": list(prompt),
+                            "max_new_tokens": budget})
+    assert placed == 0
+    if phase == "mid_decode":
+        victim.step()  # one window of real progress
+    router.tick()      # fold the progress in before the fault
+    return list(router._tracked[rid].progress)
+
+
+class TestFaultMatrix:
+    @pytest.mark.parametrize("phase", PHASES)
+    @pytest.mark.parametrize("fault", ("crash", "hang"))
+    def test_failover_token_exact(self, fault, phase):
+        victim, survivor = _ScriptHost(), _ScriptHost()
+        router = _fast_router([victim, survivor])
+        pre = _submit_phase(router, victim, phase)
+        if phase == "mid_decode":
+            assert pre, "mid-decode cell needs emitted tokens"
+        else:
+            assert pre == []
+        victim.die(fault)
+        assert _pump_until(router, [survivor],
+                           lambda: "m" in router.completed)
+        assert router.host_state(0) == "dead"
+        assert router.host_state(1) == "healthy"
+        # token-exact vs the uninterrupted chain, regardless of where
+        # the fault struck
+        assert router.completed["m"]["tokens"] == _sim_chain([3, 1, 4],
+                                                             10)
+        assert router.completed["m"]["resumed"] == len(pre)
+        assert router.failovers == 1 and router.duplicates == 0
+        assert router.inflight() == 0
+
+    @pytest.mark.parametrize("phase", PHASES)
+    def test_drain_matrix(self, phase):
+        victim, survivor = _ScriptHost(), _ScriptHost()
+        router = _fast_router([victim, survivor],
+                              drain_inplace_tokens=3)
+        pre = _submit_phase(router, victim, phase)
+        summary = router.drain_host(0)
+        # 10-token budget minus any progress always exceeds the
+        # 3-token in-place bound: every phase migrates
+        assert summary == {"host": 0, "migrated": 1, "in_place": 0}
+        assert router.host_state(0) == "draining"
+        # the drainer was told to stop working on it
+        assert victim.cancelled == ["m"]
+        # no admissions to a draining host
+        assert router.submit({"rid": "n", "prompt_ids": [9],
+                              "max_new_tokens": 4}) == 1
+        assert _pump_until(router, [survivor, victim],
+                           lambda: {"m", "n"} <= set(router.completed))
+        assert router.completed["m"]["tokens"] == _sim_chain([3, 1, 4],
+                                                             10)
+        assert router.completed["m"]["resumed"] == len(pre)
+        assert router.duplicates == 0
+        assert router.host_state(0) == "retired"
+
+    def test_drain_short_request_finishes_in_place(self):
+        victim, survivor = _ScriptHost(), _ScriptHost()
+        router = _fast_router([victim, survivor],
+                              drain_inplace_tokens=8)
+        router.submit({"rid": "s", "prompt_ids": [7, 7],
+                       "max_new_tokens": 4})
+        summary = router.drain_host(0)
+        assert summary == {"host": 0, "migrated": 0, "in_place": 1}
+        assert victim.cancelled == []
+        assert _pump_until(router, [victim, survivor],
+                           lambda: "s" in router.completed)
+        assert router.completed["s"]["host"] == 0
+        assert router.completed["s"]["tokens"] == _sim_chain([7, 7], 4)
+        assert router.host_state(0) == "retired"
+
+
+class TestHealthStateMachine:
+    def test_probation_recovery_no_failover(self):
+        victim, survivor = _ScriptHost(), _ScriptHost()
+        router = _fast_router([victim, survivor], retry_max=50,
+                              retry_backoff_ms=40)
+        router.submit({"rid": "p", "prompt_ids": [2, 2],
+                       "max_new_tokens": 6})
+        victim.die("hang")
+        assert _pump_until(router, [],
+                           lambda: router.host_state(0) == "suspect",
+                           step_survivors=False)
+        victim.revive()  # service resumes during probation
+        assert _pump_until(router, [victim],
+                           lambda: "p" in router.completed)
+        assert router.host_state(0) == "healthy"
+        assert router.failovers == 0
+        assert router.completed["p"]["tokens"] == _sim_chain([2, 2], 6)
+
+    def test_idempotent_resubmit_under_recovering_host(self):
+        """The issue's double-serve trap: the host recovers AFTER the
+        dead verdict and serves its stale copy anyway — the original
+        rid makes the late result a counted duplicate, not a second
+        answer."""
+        victim, survivor = _ScriptHost(), _ScriptHost()
+        router = _fast_router([victim, survivor])
+        router.submit({"rid": "d", "prompt_ids": [5, 6],
+                       "max_new_tokens": 6})
+        victim.step()  # one window before the hang
+        router.tick()
+        victim.die("hang")
+        assert _pump_until(router, [survivor],
+                           lambda: "d" in router.completed)
+        first = dict(router.completed["d"])
+        assert first["host"] == 1 and first["resumed"] == 2
+        # the hung worker wakes up and finishes ITS copy
+        victim.revive()
+        assert _pump_until(router, [victim],
+                           lambda: router.duplicates >= 1)
+        assert router.completed["d"] == first  # first answer stands
+        assert router.completed["d"]["tokens"] == _sim_chain([5, 6], 6)
+
+    def test_admitted_counts_requests_not_placements(self):
+        """Failover re-submissions re-place already-admitted work:
+        `admitted` must reconcile against unique requests, so
+        completed == admitted holds even across a failover."""
+        victim, survivor = _ScriptHost(), _ScriptHost()
+        router = _fast_router([victim, survivor])
+        router.submit({"rid": "a1", "prompt_ids": [1],
+                       "max_new_tokens": 4})
+        victim.die("crash")
+        assert _pump_until(router, [survivor],
+                           lambda: "a1" in router.completed)
+        assert router.failovers == 1
+        assert router.admitted == 1 == len(router.completed)
+
+    def test_completed_eviction_keeps_dedup(self):
+        """The completed store is bounded; evicted rids leave a
+        tombstone so an arbitrarily late duplicate still dedupes."""
+        host = _ScriptHost()
+        router = _fast_router([host])
+        router.completed_max = 2
+        for i in range(4):
+            router.submit({"rid": f"e{i}", "prompt_ids": [i],
+                           "max_new_tokens": 2})
+        assert _pump_until(router, [host],
+                           lambda: router.admitted == 4
+                           and len(router.completed)
+                           + len(router._completed_rids) == 4)
+        assert len(router.completed) == 2  # oldest two evicted
+        # a very late duplicate of an EVICTED rid is still a duplicate
+        router._complete(0, {"rid": "e0", "token_ids": [1, 2]})
+        assert router.duplicates == 1
+        assert "e0" not in router.completed
+
+    def test_no_live_host_orphans_then_recovers(self):
+        """Graceful degradation: when every host is dead, admitted work
+        is ORPHANED (never dropped) and new work is shed with a reason
+        the router_admit row carries."""
+        victim = _ScriptHost()
+        router = _fast_router([victim])
+        router.submit({"rid": "o", "prompt_ids": [8],
+                       "max_new_tokens": 4})
+        victim.die("crash")
+        assert _pump_until(router, [],
+                           lambda: router.host_state(0) == "dead",
+                           step_survivors=False)
+        assert router.outstanding(None) == ["o"]  # orphaned, not lost
+        assert router.submit({"rid": "new", "prompt_ids": [1],
+                              "max_new_tokens": 2}) is None
+        assert router.rejected == 1
+        # capacity returns (a fresh host joins the fleet)
+        fresh = _ScriptHost()
+        router.hosts.append(fresh)
+        from paddle_tpu.serving.router import _HostHealth
+
+        router._health.append(_HostHealth())
+        router._pending_guess.append(0)
+        router._last_submit_t.append(0.0)
+        assert _pump_until(router, [fresh],
+                           lambda: "o" in router.completed)
+        assert router.completed["o"]["tokens"] == _sim_chain([8], 4)
+
+    def test_admit_reason_rows(self, obs_dir):
+        victim = _ScriptHost()
+        router = _fast_router([victim])
+        router.submit({"rid": "x", "prompt_ids": [1],
+                       "max_new_tokens": 4})
+        victim.die("crash")
+        _pump_until(router, [], lambda: router.host_state(0) == "dead",
+                    step_survivors=False)
+        router.submit({"rid": "y", "prompt_ids": [1],
+                       "max_new_tokens": 4})
+        rows = bus.read_stream(
+            os.path.join(obs_dir, "telemetry.rank0.jsonl"))
+        admits = [r["payload"] for r in rows
+                  if r["kind"] == "router_admit"]
+        assert admits and admits[-1]["reason"] == "no_live_host"
+        assert admits[-1]["live_hosts"] == 0
+        dead = [r["payload"] for r in rows
+                if r["kind"] == "router_host_dead"]
+        assert dead and dead[0]["host"] == 0
+        kinds = {r["kind"] for r in rows}
+        assert "router_host_suspect" in kinds
+        assert "router_failover" in kinds
+        rm = [r["payload"] for r in rows
+              if r["kind"] == "router_metrics"][-1]
+        assert rm["host0_state"] == "dead"
+        assert rm["orphans"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the REAL engine path: greedy recovery is token-exact through the
+# compiled prefill/decode pair, not just the simulated worker
+# ---------------------------------------------------------------------------
+
+
+class _CrashableLocal(LocalHost):
+    """A LocalHost the health machinery MAY judge: `die()` freezes its
+    signals the way a dead host's telemetry freezes."""
+
+    can_fail = True
+
+    def __init__(self, engine):
+        super().__init__(engine)
+        self.dead = False
+        self._t_dead = None
+
+    def die(self):
+        self.dead = True
+        self._t_dead = time.time()
+
+    def pump(self):
+        if self.dead:
+            return False
+        return super().pump()
+
+    def submit(self, req):
+        if self.dead:
+            return
+        super().submit(req)
+
+    def signals(self):
+        if not self.dead:
+            return super().signals()
+        return {"live_t": self._t_dead, "service_t": self._t_dead,
+                "progress": {}, "results": []}
+
+
+class TestEngineRecovery:
+    def test_mid_decode_failover_token_exact(self, trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine, Request
+
+        m = _tiny_lm()
+        prompt = [4, 5, 6, 7]
+        # uninterrupted oracle on a fresh engine
+        oracle_eng = InferenceEngine(m, slots=2, max_length=64,
+                                     sync_every=4)
+        oracle_eng.submit(Request(prompt, max_new_tokens=12, rid="u"))
+        oracle = oracle_eng.run()["u"].tokens
+
+        hosts = [
+            _CrashableLocal(InferenceEngine(m, slots=2, max_length=64,
+                                            sync_every=4))
+            for _ in range(2)
+        ]
+        router = _fast_router(hosts)
+        placed = router.submit({"rid": "r", "prompt_ids": prompt,
+                                "max_new_tokens": 12})
+        assert placed == 0
+        hosts[0].pump()  # prefill + one readback window
+        router.tick()
+        pre = list(router._tracked["r"].progress)
+        assert 0 < len(pre) < 12
+        hosts[0].die()
+        deadline = time.time() + 30
+        while "r" not in router.completed and time.time() < deadline:
+            router.tick()
+            hosts[1].pump()
+            time.sleep(0.01)
+        got = router.completed["r"]
+        assert got["host"] == 1 and got["resumed"] == len(pre)
+        # token-exact: re-prefilling prompt+prefix reproduces the
+        # uninterrupted greedy continuation
+        assert got["tokens"] == oracle
+        assert router.failovers == 1
+
+    def test_engine_drain_migrates_and_retires(self, trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine, Request
+
+        m = _tiny_lm()
+        prompt = [9, 8, 7]
+        oracle_eng = InferenceEngine(m, slots=2, max_length=64,
+                                     sync_every=4)
+        oracle_eng.submit(Request(prompt, max_new_tokens=16, rid="u"))
+        oracle = oracle_eng.run()["u"].tokens
+
+        hosts = [LocalHost(InferenceEngine(m, slots=2, max_length=64,
+                                           sync_every=4))
+                 for _ in range(2)]
+        router = _fast_router(hosts, drain_inplace_tokens=2)
+        assert router.submit({"rid": "long", "prompt_ids": prompt,
+                              "max_new_tokens": 16}) == 0
+        hosts[0].pump()
+        router.tick()
+        pre = list(router._tracked["long"].progress)
+        assert pre
+        summary = router.drain_host(0)
+        assert summary["migrated"] == 1
+        # the drainer's engine no longer holds the request
+        assert "long" not in hosts[0].engine.progress()
+        # new work only lands on the live host
+        assert router.submit({"rid": "after", "prompt_ids": [1, 2],
+                              "max_new_tokens": 4}) == 1
+        deadline = time.time() + 30
+        while not ({"long", "after"} <= set(router.completed)) and \
+                time.time() < deadline:
+            router.tick()
+            hosts[0].pump()
+            hosts[1].pump()
+            time.sleep(0.01)
+        assert router.completed["long"]["tokens"] == oracle
+        assert router.completed["long"]["resumed"] == len(pre)
+        assert router.duplicates == 0
+        assert router.host_state(0) == "retired"
+
+    def test_engine_cancel_and_progress(self, trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine, Request
+
+        m = _tiny_lm()
+        e = InferenceEngine(m, slots=1, max_length=64, sync_every=4)
+        e.submit(Request([1, 2], max_new_tokens=8, rid="a"))
+        e.submit(Request([3, 4], max_new_tokens=8, rid="b"))
+        results = {}
+        e.turn(results)
+        prog = e.progress()
+        assert len(prog["a"]) > 0     # active: window tokens on host
+        assert prog["b"] == []        # queued: nothing yet
+        assert e.cancel("b") is True  # queued cancel
+        assert e.cancel("a") is True  # active cancel
+        assert e.cancel("zz") is False
+        out = e.run()
+        assert out == {} and results == {}
+
+    def test_resume_request_validation(self, trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine, Request
+
+        m = _tiny_lm()
+        e = InferenceEngine(m, slots=1, max_length=16)
+        with pytest.raises(ValueError, match="prompt\\+resume"):
+            e.submit(Request([1] * 8, max_new_tokens=4, rid="v",
+                             resume_tokens=[2] * 8))
+
+
+# ---------------------------------------------------------------------------
+# fault grammar + worker chain determinism
+# ---------------------------------------------------------------------------
+
+
+class TestServeFaultGrammar:
+    def test_host_crash_wrong_site_rejected(self):
+        with pytest.raises(ValueError, match="un-instrumented"):
+            fi.FaultInjector("grad:host_crash:1")
+        with pytest.raises(ValueError, match="un-instrumented"):
+            fi.FaultInjector("mon:host_crash:1")
+
+    def test_serve_hang_is_an_event_not_a_sleep(self):
+        inj = fi.FaultInjector("serve:hang:1:1,serve:host_crash:2:0")
+        t0 = time.time()
+        inj.fire("serve")
+        assert time.time() - t0 < 1.0  # no 1-second sleep happened
+        assert ("hang", 1) in inj.serve_events
+        inj.fire("serve")
+        assert ("host_crash", 0) in inj.serve_events
+
+    def test_generic_hang_sites_unchanged(self):
+        inj = fi.FaultInjector("epoch:hang:1:0.01")
+        t0 = time.time()
+        inj.fire("epoch")
+        assert time.time() - t0 >= 0.01  # still a sleep elsewhere
+
+    def test_sim_chain_resume_property(self):
+        prompt = [11, 3, 5]
+        full = _sim_chain(prompt, 20)
+        for k in (0, 1, 7, 19):
+            resumed = _sim_chain(prompt + full[:k], 20 - k)
+            assert full[:k] + resumed == full
+
+
+# ---------------------------------------------------------------------------
+# observability: incidents name the host, timeline renders the slices
+# ---------------------------------------------------------------------------
+
+
+def _load_monitor():
+    import importlib.util
+
+    path = os.path.join(REPO, "paddle_tpu", "observability",
+                        "monitor.py")
+    spec = importlib.util.spec_from_file_location("_t_mon_fault", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_timeline():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_t_timeline_fault", os.path.join(REPO, "tools", "timeline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFaultObservability:
+    def test_host_dead_folds_into_incident_chain(self, tmp_path):
+        mon = _load_monitor()
+        m = mon.FleetMonitor(str(tmp_path), window_s=5.0)
+        t = time.time()
+        rows = [
+            {"v": 1, "kind": "router_host_suspect", "step": 1, "time": t,
+             "rank": 0, "payload": {"host": 1, "host_rank": 1,
+                                    "reason": "silent"}},
+            {"v": 1, "kind": "router_host_dead", "step": 2,
+             "time": t + 0.5, "rank": 0,
+             "payload": {"host": 1, "host_rank": 1, "reason": "silent",
+                         "inflight": 3}},
+            {"v": 1, "kind": "router_failover", "step": 2,
+             "time": t + 0.6, "rank": 0,
+             "payload": {"host": 1, "requests": 3, "orphaned": 0}},
+        ]
+        with open(os.path.join(str(tmp_path),
+                               "telemetry.rank0.jsonl"), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        m.poll()
+        closed = m.correlator.flush()
+        assert closed is not None
+        chain = closed["chain"]
+        # ONE incident: death and the recovery it triggered, in order,
+        # naming the lost host (suspect rows are not notable on purpose
+        # — probation often stands down)
+        assert "router_host_dead" in chain
+        assert "host 1 (worker rank 1) dead" in chain
+        assert chain.index("router_host_dead") < chain.index(
+            "router_failover")
+
+    def test_drain_is_notable(self, tmp_path):
+        mon = _load_monitor()
+        d = mon._notable_detail("router_drain",
+                                {"host": 0, "host_rank": 0,
+                                 "migrated": 2, "in_place": 1})
+        assert "host 0" in d and "2 migrated" in d
+
+    def test_timeline_failover_slice_and_trace(self, obs_dir):
+        timeline = _load_timeline()
+        t = time.time()
+        bus.emit_span("router_submit", "tX", {"rid": "r", "host": 0,
+                                              "predicted_wait_ms": 1.0})
+        bus.emit_span("failover", "tX", {
+            "rid": "r", "from_host": 0, "to_host": 1, "resumed": 5,
+            "dur_ms": 120.0})
+        bus.emit_span("drain", "tX", {
+            "rid": "r2", "from_host": 0, "to_host": 1, "resumed": 2,
+            "dur_ms": 40.0})
+        bus.emit("router_host_dead", {"host": 0, "host_rank": 0,
+                                      "reason": "silent", "inflight": 1})
+        streams = timeline._load_bus().rank_streams(obs_dir)
+        trace = timeline.chrome_trace(streams, {})
+        slices = [e for e in trace["traceEvents"]
+                  if e.get("ph") == "X" and e["name"] in ("failover",
+                                                          "drain")]
+        assert len(slices) == 2
+        fo = [e for e in slices if e["name"] == "failover"][0]
+        assert fo["tid"] == "trace tX"
+        assert abs(fo["dur"] - 120e3) < 1.0  # dur_ms -> microseconds
+        # the recovered request's two-host life via --trace
+        spans = timeline.trace_spans(streams, "tX")
+        names = [s["name"] for s in spans]
+        assert "router_submit" in names and "failover" in names
+        lines = timeline.format_trace(spans, "tX")
+        assert any("failover" in ln for ln in lines)
+        # and the summary names the dead host
+        summary = "\n".join(timeline.summarize(streams, {}))
+        assert "HOST DEAD: host 0" in summary
+
+
+# ---------------------------------------------------------------------------
+# the launcher-driven multi-process dryruns (the acceptance pins)
+# ---------------------------------------------------------------------------
+
+
+class TestLauncherDryruns:
+    def _launch(self, base, logs, monkeypatch, **kw):
+        from paddle_tpu.distributed.launch import launch
+
+        rc_box = {}
+
+        def run():
+            rc_box["rc"] = launch(
+                os.path.join(REPO, "paddle_tpu", "serving", "router.py"),
+                [REPO, base, "800", "0.02"],
+                nproc_per_node=2, backend="cpu", log_dir=logs, **kw)
+
+        t = threading.Thread(target=run)
+        t.start()
+        return t, rc_box
+
+    def test_host_crash_mid_decode_zero_dropped(self, tmp_path,
+                                                monkeypatch):
+        """The ISSUE 15 acceptance pin: SIGKILL a worker mid-decode
+        (injected), every in-flight greedy request completes on the
+        survivor token-identical to an uninterrupted run, zero dropped,
+        launcher rc 0 (reshard quorum retires the dead rank), and the
+        incident row names the dead host before launch() returns."""
+        base = str(tmp_path / "mail")
+        logs = str(tmp_path / "logs")
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "serve:host_crash:2:0")
+        monkeypatch.setenv("PADDLE_MON_POLL", "0.1")
+        monkeypatch.setenv("PADDLE_MON_WINDOW", "2.0")
+        fi.reset()
+        t, rc_box = self._launch(base, logs, monkeypatch,
+                                 reshard="shrink", reshard_quorum=0.5)
+        monkeypatch.setenv("PADDLE_OBS_DIR", logs)
+        bus.reset()
+        hosts = [FileHost(os.path.join(base, f"host{r}"), r,
+                          obs_dir=logs) for r in (0, 1)]
+        router = Router(hosts, admit_queue=32, avg_new_tokens=24,
+                        host_timeout_ms=400, retry_backoff_ms=60,
+                        retry_max=2)
+        prompts = {}
+        for i in range(6):
+            rid = f"c{i}"
+            prompts[rid] = [i + 1, i + 2]
+            router.submit({"rid": rid, "prompt_ids": prompts[rid],
+                           "max_new_tokens": 24})
+        deadline = time.time() + 45
+        while len(router.completed) < 6 and time.time() < deadline:
+            router.tick()
+            time.sleep(0.02)
+        open(os.path.join(base, "stop"), "w").close()
+        t.join(timeout=60)
+        bus.reset()
+        # launcher survived the SIGKILL: quorum held, dead rank retired
+        assert rc_box.get("rc") == 0
+        # zero dropped requests, all token-exact vs the uninterrupted
+        # chain — including the ones recovered off the dead host
+        assert len(router.completed) == 6
+        for rid, prompt in prompts.items():
+            assert router.completed[rid]["tokens"] == _sim_chain(
+                prompt, 24), rid
+        assert router.failovers >= 1
+        assert router.host_state(0) == "dead"
+        resumed = [r for r in router.completed.values()
+                   if r.get("resumed")]
+        assert resumed, "no request actually resumed mid-stream"
+        # the incident row names the dead host, before launch returned
+        launcher_rows = [json.loads(ln) for ln in open(
+            os.path.join(logs, "telemetry.launcher.jsonl"))]
+        incs = [r for r in launcher_rows if r["kind"] == "incident"]
+        assert incs, "no incident row before manager exit"
+        chains = " | ".join(r["payload"]["chain"] for r in incs)
+        assert "router_host_dead" in chains
+        assert "host 0 (worker rank 0) dead" in chains
+
+    def test_drain_retires_worker_rc0(self, tmp_path, monkeypatch):
+        """The drain acceptance pin: after drain_host(0) + the drain
+        verb the worker process exits rc 0 on its own (its telemetry
+        stream freezes while the survivor's keeps growing), no request
+        is dropped or double-served, and no admission reaches the
+        drained host."""
+        base = str(tmp_path / "mail")
+        logs = str(tmp_path / "logs")
+        t, rc_box = self._launch(base, logs, monkeypatch)
+        monkeypatch.setenv("PADDLE_OBS_DIR", logs)
+        bus.reset()
+        hosts = [FileHost(os.path.join(base, f"host{r}"), r,
+                          obs_dir=logs) for r in (0, 1)]
+        router = Router(hosts, admit_queue=32, avg_new_tokens=24,
+                        drain_inplace_tokens=4)
+        prompts = {}
+        for i in range(4):
+            rid = f"d{i}"
+            prompts[rid] = [i + 3, i + 4]
+            router.submit({"rid": rid, "prompt_ids": prompts[rid],
+                           "max_new_tokens": 24})
+        # wait until host 0 is actually working (mid-decode drain)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            router.tick()
+            if any(e.progress for e in router._tracked.values()
+                   if e.host == 0):
+                break
+            time.sleep(0.02)
+        router.drain_host(0)
+        while len(router.completed) < 4 and time.time() < deadline:
+            router.tick()
+            time.sleep(0.02)
+        assert len(router.completed) == 4
+        # host 0 retired; its worker exits WITHOUT the global stop file
+        while router.host_state(0) != "retired" and \
+                time.time() < deadline:
+            router.tick()
+            time.sleep(0.02)
+        assert router.host_state(0) == "retired"
+        stream0 = os.path.join(logs, "telemetry.rank0.jsonl")
+        stream1 = os.path.join(logs, "telemetry.rank1.jsonl")
+
+        def _frozen():
+            s0 = os.path.getsize(stream0)
+            s1 = os.path.getsize(stream1)
+            time.sleep(0.6)
+            return (os.path.getsize(stream0) == s0
+                    and os.path.getsize(stream1) > s1)
+
+        froze = False
+        for _ in range(20):
+            if _frozen():
+                froze = True
+                break
+        assert froze, "drained worker kept emitting (did not exit)"
+        # no admission after the drain verb
+        assert router.submit({"rid": "late", "prompt_ids": [1],
+                              "max_new_tokens": 4}) == 1
+        open(os.path.join(base, "stop"), "w").close()
+        t.join(timeout=60)
+        bus.reset()
+        assert rc_box.get("rc") == 0  # BOTH workers exited clean
+        for rid, prompt in prompts.items():
+            assert router.completed[rid]["tokens"] == _sim_chain(
+                prompt, 24), rid
+        assert router.duplicates == 0
+
+    def test_hang_detected_and_recovered(self, tmp_path, monkeypatch):
+        """The detector's harder prey end to end: the hung worker keeps
+        its decode_metrics heartbeat (the process is alive) but stops
+        serving — only the service deadline can catch it."""
+        base = str(tmp_path / "mail")
+        logs = str(tmp_path / "logs")
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "serve:hang:2:0")
+        fi.reset()
+        t, rc_box = self._launch(base, logs, monkeypatch)
+        monkeypatch.setenv("PADDLE_OBS_DIR", logs)
+        bus.reset()
+        hosts = [FileHost(os.path.join(base, f"host{r}"), r,
+                          obs_dir=logs) for r in (0, 1)]
+        router = Router(hosts, admit_queue=32, avg_new_tokens=24,
+                        host_timeout_ms=400, retry_backoff_ms=60,
+                        retry_max=2)
+        prompts = {}
+        for i in range(4):
+            rid = f"h{i}"
+            prompts[rid] = [i + 5, i + 6]
+            router.submit({"rid": rid, "prompt_ids": prompts[rid],
+                           "max_new_tokens": 24})
+        deadline = time.time() + 45
+        while len(router.completed) < 4 and time.time() < deadline:
+            router.tick()
+            time.sleep(0.02)
+        # the hung host is STILL alive (heartbeat fresh) yet dead to
+        # the router — the reason must say so
+        assert router.host_state(0) == "dead"
+        assert len(router.completed) == 4
+        for rid, prompt in prompts.items():
+            assert router.completed[rid]["tokens"] == _sim_chain(
+                prompt, 24), rid
+        rows = bus.read_stream(
+            os.path.join(logs, "telemetry.rank0.jsonl"))
+        dead = [r["payload"] for r in rows
+                if r["kind"] == "router_host_dead"]
+        assert dead and dead[0]["reason"] == "unresponsive"
+        open(os.path.join(base, "stop"), "w").close()
+        t.join(timeout=60)
+        bus.reset()
+        assert rc_box.get("rc") == 0  # the hung worker exits 0 on stop
+
+
+# ---------------------------------------------------------------------------
+# tpulint: the grown serving modules stay under the compiled-by-contract
+# and host-sync rules
+# ---------------------------------------------------------------------------
+
+
+class TestFaultLintContract:
+    def test_touched_serving_modules_quiet(self):
+        from tools.tpulint import core as lint_core
+
+        paths = [
+            os.path.join(REPO, "paddle_tpu", "serving", "router.py"),
+            os.path.join(REPO, "paddle_tpu", "serving", "engine.py"),
+            os.path.join(REPO, "paddle_tpu", "utils",
+                         "fault_injection.py"),
+            os.path.join(REPO, "paddle_tpu", "observability",
+                         "monitor.py"),
+        ]
+        findings, errors = lint_core.run(paths, enable_project=False)
+        assert not errors, errors
+        live = [f for f in findings if not f.suppressed]
+        assert not live, [str(f) for f in live]

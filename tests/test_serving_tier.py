@@ -823,14 +823,17 @@ class TestRouterDryrun:
             time.sleep(0.1)
         open(os.path.join(base, "stop"), "w").close()
         t.join(timeout=60)
+        router.tick()  # collect the last results into router.completed
         bus.reset()
         assert rc_box.get("rc") == 0
         # the degraded host got less traffic than the healthy one
         assert placed[1] > placed[0]
         # the burst was admission-limited
         assert router.rejected > 0 and placed[None] == router.rejected
-        served = len(hosts[0].results()) + len(hosts[1].results())
-        assert served == router.admitted
+        # round 15: ticked routers fold host results into the tracked
+        # completion set (the failover dedup point) — nothing dropped
+        assert len(router.completed) == router.admitted
+        assert router.inflight() == 0
         # queue-depth + TTFT rows on the bus, per worker
         for rank in (0, 1):
             rows = bus.read_stream(

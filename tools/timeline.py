@@ -192,15 +192,32 @@ def chrome_trace(streams: Dict[int, List[dict]],
                 lanes = ([payload["trace_id"]]
                          if payload.get("trace_id") is not None
                          else list(payload.get("trace_ids") or [None]))
+                # round 15: failover/drain spans carry dur_ms — the
+                # request's life on the abandoned host — and render as
+                # DURATION slices ending at the span's emit time, so a
+                # recovered request's two-host life reads as
+                # slice(host A) → resubmit spans(host B) on ONE lane
+                dur_ms = payload.get("dur_ms")
+                args = {k: v for k, v in payload.items()
+                        if isinstance(v, (str, int, float, bool))}
                 for tid_lane in lanes:
+                    if isinstance(dur_ms, (int, float)):
+                        dur = float(dur_ms) * 1e3
+                        events.append({
+                            "ph": "X",
+                            "name": str(payload.get("name", "span")),
+                            "pid": rank, "tid": f"trace {tid_lane}",
+                            "ts": max(us(t) - dur, 0.0),
+                            "dur": max(dur, 1.0),
+                            "args": args,
+                        })
+                        continue
                     events.append({
                         "ph": "i",
                         "name": str(payload.get("name", "span")),
                         "pid": rank, "tid": f"trace {tid_lane}",
                         "ts": us(t), "s": "t",
-                        "args": {k: v for k, v in payload.items()
-                                 if isinstance(v, (str, int, float,
-                                                   bool))},
+                        "args": args,
                     })
                 continue
             if kind == "decode_request" and payload.get("trace_id"):
@@ -414,6 +431,32 @@ def summarize(streams: Dict[int, List[dict]],
     if traces:
         lines.append(f"traced requests: {len(traces)} "
                      f"(--trace <id> renders one request's spans)")
+    # serving fault tolerance (ISSUE 15): host deaths, failovers, and
+    # drains as one line each — the recovery story at a glance
+    for rows in streams.values():
+        for r in rows:
+            p = r.get("payload")
+            if not isinstance(p, dict):
+                continue
+            k = r.get("kind")
+            if k == "router_host_dead":
+                lines.append(
+                    f"HOST DEAD: host {p.get('host')} "
+                    f"(worker rank {p.get('host_rank')}) — "
+                    f"{p.get('reason')}, {p.get('inflight')} in-flight "
+                    f"request(s) to recover")
+            elif k == "router_failover":
+                lines.append(
+                    f"failover: host {p.get('host')} -> survivors, "
+                    f"{p.get('requests')} request(s) resumed"
+                    + (f", {p.get('orphaned')} orphaned"
+                       if p.get("orphaned") else ""))
+            elif k == "router_drain":
+                lines.append(
+                    f"drain: host {p.get('host')} "
+                    f"(worker rank {p.get('host_rank')}) — "
+                    f"{p.get('migrated')} migrated, "
+                    f"{p.get('in_place')} finished in place")
     for p in incidents:
         lines.append(f"INCIDENT #{p.get('id')} ranks {p.get('ranks')}: "
                      f"{p.get('chain')}")
