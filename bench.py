@@ -776,6 +776,113 @@ def _bench_serve_failover(n_requests=6, budget=48, rate=4000.0):
     return out
 
 
+def _bench_ctl(waves=8, per_wave=6, budget=8, rate=4000.0):
+    """Train-serve co-tenancy (ISSUE 16): what a serving burst sheds
+    with the fleet controller OFF vs ON, plus the cost of one lend
+    transition. Jax-free like the failover bench — one mailbox worker,
+    a small admission bound (admit_queue=2), and bursts of `per_wave`
+    submits per control window, so the OFF run rejects most of every
+    wave while the ON run's controller sees the rejection rate, lends
+    after `sustain_n` hot windows (the bench's lend callback registers
+    4x capacity on the host — the stand-in for expand_slots absorbing
+    the lent devices), and later waves admit in full.
+
+    `serve_burst_shed_tokens_ctl_off/_on` are report-only (no gated
+    suffix); `ctl_lend_ms` (begin->commit journal wall time) lands
+    under the continuity gate's lower-is-better `_ms` rule."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    from paddle_tpu.distributed.fleet_controller import (
+        CtlConfig, FleetController,
+    )
+    from paddle_tpu.observability.monitor import FleetMonitor
+    from paddle_tpu.serving.router import FileHost, Router
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "paddle_tpu", "serving", "router.py")
+    out = {}
+
+    def _run(with_ctl: bool) -> dict:
+        tmp = tempfile.mkdtemp(prefix="pdtpu_ctl_bench_")
+        base = os.path.join(tmp, "mail")
+        obs = os.path.join(tmp, "obs")
+        os.makedirs(obs, exist_ok=True)
+        env_prev = os.environ.get("PADDLE_OBS_DIR")
+        os.environ["PADDLE_OBS_DIR"] = obs  # router_metrics -> monitor
+        proc = None
+        try:
+            wenv = dict(os.environ, PADDLE_TRAINER_ID="0",
+                        PADDLE_OBS_DIR=obs)
+            wenv.pop("PADDLE_FAULT_SPEC", None)
+            wenv.pop("PADDLE_OBS_BUS_FILE", None)
+            proc = subprocess.Popen(
+                [sys.executable, worker, repo, base, str(rate), "0.005"],
+                env=wenv, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            host = FileHost(os.path.join(base, "host0"), 0, obs_dir=obs)
+            router = Router([host], admit_queue=2, avg_new_tokens=budget,
+                            admit_ttft_ms=0)
+            ctl = None
+            if with_ctl:
+                mon = FleetMonitor(obs, emit=False)
+                ctl = FleetController(
+                    obs, monitor=mon, donor_ranks=[7],
+                    config=CtlConfig(pressure=0.25, release=0.01,
+                                     sustain_n=2, cooldown_n=2,
+                                     window_s=0.01),
+                    lend=lambda ranks, s: router.register_capacity(0, 4),
+                    reclaim=lambda ranks, s: router.register_capacity(0, 1),
+                    emit=True)
+            rid = 0
+            for _ in range(waves):
+                for _ in range(per_wave):
+                    rid += 1
+                    router.submit({"rid": f"b{rid}",
+                                   "prompt_ids": [1, 2, 3],
+                                   "max_new_tokens": budget})
+                deadline = time.time() + 10
+                while time.time() < deadline and router.inflight():
+                    router.tick()
+                    time.sleep(0.005)
+                if ctl is not None:
+                    mon.poll()
+                    ctl.window()
+            return {"shed": router.rejected * budget,
+                    "admitted": router.admitted,
+                    "lend_ms": (ctl.transitions[0]["dur_ms"]
+                                if ctl is not None and ctl.transitions
+                                else None)}
+        finally:
+            try:
+                os.makedirs(base, exist_ok=True)
+                open(os.path.join(base, "stop"), "w").close()
+                if proc is not None:
+                    try:
+                        proc.wait(timeout=20)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            finally:
+                if env_prev is None:
+                    os.environ.pop("PADDLE_OBS_DIR", None)
+                else:
+                    os.environ["PADDLE_OBS_DIR"] = env_prev
+                shutil.rmtree(tmp, ignore_errors=True)
+
+    off = _run(False)
+    on = _run(True)
+    assert on["lend_ms"] is not None, "ctl bench: controller never lent"
+    assert on["shed"] < off["shed"], (
+        f"ctl bench: lend did not reduce shed "
+        f"(on {on['shed']} vs off {off['shed']})")
+    out["serve_burst_shed_tokens_ctl_off"] = off["shed"]
+    out["serve_burst_shed_tokens_ctl_on"] = on["shed"]
+    out["ctl_lend_ms"] = round(on["lend_ms"], 1)
+    return out
+
+
 def _bench_flash_attention(steps=500):
     """Long-context attention: the Pallas flash kernel vs XLA dense at
     S=2048 causal. The `steps` iterations run INSIDE one jitted lax.scan
@@ -1040,6 +1147,14 @@ def main():
         )
         extra.update(fo_bd)
         extra["serve_failover_recovery_ms_spread"] = fo_sp
+        # train-serve co-tenancy (ISSUE 16): burst tokens shed with the
+        # fleet controller off vs on (report-only pair) and the
+        # begin->commit cost of the lend transition (gated _ms key)
+        ctl_ms, ctl_bd, ctl_sp = _repeat(
+            lambda: (lambda d: (d["ctl_lend_ms"], d))(_bench_ctl())
+        )
+        extra.update(ctl_bd)
+        extra["ctl_lend_ms_spread"] = ctl_sp
     # r04 measured the same model/optimizer at batch 64 with two-pass
     # f32-blacklisted batch norm: 41.78 ms / 64 imgs = 1531.7 imgs/sec
     extra["vs_r04_resnet50_bf16"] = round(r50_bf16_ips / 1531.7, 2)

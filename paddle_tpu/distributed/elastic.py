@@ -47,6 +47,14 @@ TPU-native additions over the reference watch loop:
   (``observability/monitor.py``); kill attribution folds the active
   incident chain in, and the final incident/snapshot rows are flushed
   before the manager returns. ``PADDLE_MON=0`` disables.
+- **embedded co-tenancy controller** (ISSUE 16): ``PADDLE_CTL=dryrun``
+  (or ``controller="dryrun"``) starts the lend/reclaim state machine
+  (``distributed/fleet_controller.py``) next to the monitor at
+  rank −1. The launcher runs it journal-only — decisions, hysteresis,
+  and the crash-recoverable ctl_lend/ctl_reclaim journal are real;
+  actuation callbacks are not wired (training steps and serving
+  engines live in the children; in-process co-tenants construct
+  ``FleetController`` themselves with lend/reclaim callbacks).
 """
 from __future__ import annotations
 
@@ -74,6 +82,11 @@ try:  # the live fleet monitor (ISSUE 14, stdlib-pure as well)
 except ImportError:  # pragma: no cover - package always carries it
     _obs_monitor = None
 
+try:  # the train-serve co-tenancy controller (ISSUE 16, stdlib-pure)
+    from . import fleet_controller as _fleet_ctl
+except ImportError:  # pragma: no cover - package always carries it
+    _fleet_ctl = None
+
 
 def _emit(kind: str, **payload) -> None:
     """Launcher-side bus event (rank -1). Lands only when the operator
@@ -95,6 +108,7 @@ _RESHARD_MODE_ENV = "PADDLE_RESHARD_MODE"
 _RESHARD_QUORUM_ENV = "PADDLE_RESHARD_QUORUM"
 _RESHARD_NOTICE_ENV = "PADDLE_RESHARD_NOTICE_FILE"
 _MON_ENV = "PADDLE_MON"
+_CTL_ENV = "PADDLE_CTL"
 
 #: exit code the manager reports when the watchdog had to put a rank down
 HUNG_RC = 98
@@ -186,7 +200,8 @@ class ElasticManager:
                  coll_timeout: Optional[float] = None,
                  reshard: Optional[str] = None,
                  reshard_quorum: Optional[float] = None,
-                 monitor: Optional[bool] = None):
+                 monitor: Optional[bool] = None,
+                 controller: Optional[str] = None):
         def _envf(name, default):
             raw = os.environ.get(name, "")
             return float(raw) if raw.strip() else default
@@ -227,6 +242,23 @@ class ElasticManager:
         self.monitor = None
         self._mon_thread: Optional[threading.Thread] = None
         self._mon_stop = threading.Event()
+        if controller is None:
+            controller = os.environ.get(_CTL_ENV, "off")
+        self.controller_mode = (controller or "off").strip().lower() or "off"
+        if self.controller_mode not in ("off", "dryrun"):
+            raise ValueError(
+                f"controller={self.controller_mode!r}: want off|dryrun "
+                f"(live actuation wires callbacks in-process, not here)")
+        #: the embedded co-tenancy controller (ISSUE 16): rides next to
+        #: the monitor at rank -1, consuming its serving aggregates.
+        #: The launcher embeds it DRYRUN-only — decisions and the
+        #: journal are real, actuation callbacks are not wired (the
+        #: training step and the serving engine live in the children;
+        #: in-process co-tenants construct FleetController themselves
+        #: with lend/reclaim callbacks)
+        self.controller = None
+        self._ctl_thread: Optional[threading.Thread] = None
+        self._ctl_stop = threading.Event()
         self._run_dir = None          # heartbeat-file home, made lazily
         self._procs: List[RankProc] = []
         self._retired: List[RankProc] = []  # resharded-away ranks
@@ -313,6 +345,7 @@ class ElasticManager:
                                         notice_path=notice))
         self._spawn_total = len(self._procs)
         self._start_monitor(obs_dir)
+        self._start_controller(obs_dir)
         _emit("elastic_spawn", attempt=attempt,
               ranks=[rp.rank for rp in self._procs],
               pids=[rp.proc.pid for rp in self._procs],
@@ -358,6 +391,46 @@ class ElasticManager:
             self.monitor.finalize()
         except Exception:  # noqa: BLE001 — diagnostics stay best-effort
             pass
+
+    # -- embedded co-tenancy controller (ISSUE 16) ------------------------
+    def _start_controller(self, obs_dir: Optional[str]) -> None:
+        """Run the lend/reclaim state machine at rank -1, next to the
+        monitor it feeds from. Launcher embedding is dryrun-only: every
+        window samples the monitor's serving aggregates, the hysteresis
+        policy decides, decisions journal to the launcher bus stream
+        (crash-recoverable) -- but no actuation callbacks are wired, so
+        ownership changes are declared, not executed. One controller
+        per job; relaunch attempts keep the journal, so recovery
+        re-derives lent state instead of guessing."""
+        if (self.controller is not None or self.controller_mode == "off"
+                or not obs_dir or _fleet_ctl is None
+                or self.monitor is None):
+            return
+        donors = sorted(rp.rank for rp in self._procs)
+        try:
+            self.controller = _fleet_ctl.FleetController(
+                obs_dir, monitor=self.monitor, donor_ranks=donors)
+        except Exception:  # noqa: BLE001 — the controller never blocks spawn
+            self.controller = None
+            return
+
+        def _loop():
+            while not self._ctl_stop.wait(self.controller.cfg.window_s):
+                try:
+                    self.controller.window()
+                except Exception:  # noqa: BLE001 — keep deciding
+                    pass
+
+        self._ctl_thread = threading.Thread(
+            target=_loop, name="pdtpu-fleet-controller", daemon=True)
+        self._ctl_thread.start()
+
+    def _stop_controller(self) -> None:
+        if self.controller is None:
+            return
+        self._ctl_stop.set()
+        if self._ctl_thread is not None:
+            self._ctl_thread.join(timeout=5.0)
 
     # -- teardown ---------------------------------------------------------
     def _kill_rank(self, rp: RankProc, why: str) -> None:
@@ -628,6 +701,7 @@ class ElasticManager:
                     return PREEMPT_RC
                 attempt += 1
         finally:
+            self._stop_controller()  # last decision journals first
             self._stop_monitor()  # incident rows land BEFORE exit
             self._teardown("manager exit")
             for sig, h in old_handlers.items():

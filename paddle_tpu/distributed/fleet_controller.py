@@ -1,0 +1,608 @@
+"""Train–serve co-tenancy: the fleet controller (ISSUE 16 tentpole).
+
+Rounds 11–15 built every ingredient of one pod running both planes:
+ElasticStep reshards training at a step boundary (PR 11), the router
+drains a serving host live with zero token loss (PR 14), and the fleet
+monitor sees SLO pressure as it builds — queue depth, TTFT digests,
+``router_admit`` rejection rate (PRs 12–14). This module closes the
+loop: a control process that LENDS training chips to a serving spike
+and RECLAIMS them when it passes, so two over-provisioned planes become
+one pod that degrades gracefully instead of shedding traffic — the
+runtime-reconfigurability shape Flex-TPU argues for in hardware
+(PAPERS.md), applied at the fleet level.
+
+The state machine::
+
+        sustained pressure >= PADDLE_CTL_PRESSURE
+        for PADDLE_CTL_SUSTAIN_N windows, cooldown elapsed,
+        lent < PADDLE_CTL_LEND_BUDGET
+    TRAIN+SERVE ───────────────────────────────────────▶ LENT
+        ◀───────────────────────────────────────
+        pressure <= PADDLE_CTL_RELEASE
+        for PADDLE_CTL_COOLDOWN_N windows, cooldown elapsed
+
+- **pressure** per control window is
+  ``max(reject_frac, queue_frac)``: the fraction of admissions the
+  router REJECTED this window (from the monitor's cumulative
+  ``router_metrics`` counters, differenced) and the total queue depth
+  relative to the fleet's admission bound. The first window after a
+  (re)start only seeds the baselines — a restart can never mistake a
+  lifetime of counters for one hot window.
+- **hysteresis**: separate lend/release thresholds with a dead band
+  between them, a sustain requirement on each side, a cooldown of
+  ``PADDLE_CTL_COOLDOWN_N`` windows between ANY two transitions, and a
+  concurrent-lend budget — an oscillating load (the ``ctl:flap`` fault)
+  cannot flap the mesh faster than one transition per cooldown window;
+  blocked decisions are counted as ``suppressed``.
+- **actuation** is injected, not owned: ``lend(ranks, sample)`` /
+  ``reclaim(ranks, sample)`` callbacks. The in-process co-tenant wires
+  the real ones — ``ElasticStep.notify_departure`` (the PR-11 depart
+  path, verbatim) + ``InferenceEngine.expand_slots`` +
+  ``Router.register_capacity`` for a lend; drain → ``retire_slots`` →
+  ``notify_return`` for the reclaim. With no callbacks the controller
+  is a DRYRUN: it decides and journals, moving nothing — the launcher
+  embedding (``PADDLE_CTL=dryrun``) runs this way so the incident
+  chain names the decision a human would have made.
+- **crash safety**: every transition is journaled to the launcher bus
+  stream as ``ctl_lend``/``ctl_reclaim`` rows with ``phase: begin`` →
+  actuate → ``phase: commit``. On restart ownership is re-derived by
+  replaying the journal — committed lends minus committed reclaims —
+  never from guesswork; a trailing ``begin`` without its ``commit``
+  (death mid-lend, the ``ctl:die`` fault) is resolved by the optional
+  ``probe`` callback against the planes themselves, else conservatively
+  journaled as ``ctl_abort`` and ignored. A controller death therefore
+  leaves both planes running and a restarted controller consistent.
+
+Runs EMBEDDED in the elastic launcher (``distributed/elastic.py``
+starts it at rank −1 next to the monitor thread when
+``PADDLE_CTL != off``) or STANDALONE::
+
+    python -m paddle_tpu.distributed.fleet_controller --obs_dir <dir>
+
+Stdlib-pure and standalone-loadable (no jax, no package imports) like
+``observability/monitor.py`` — safe on a login node.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+__all__ = ["CtlConfig", "LendPolicy", "FleetController",
+           "pressure_default", "sustain_n_default", "release_default",
+           "cooldown_n_default", "lend_budget_default",
+           "window_s_default", "main"]
+
+SCHEMA_VERSION = 1  # mirrors bus.SCHEMA_VERSION (stdlib-pure on purpose)
+
+_PRESSURE_ENV = "PADDLE_CTL_PRESSURE"
+_SUSTAIN_ENV = "PADDLE_CTL_SUSTAIN_N"
+_RELEASE_ENV = "PADDLE_CTL_RELEASE"
+_COOLDOWN_ENV = "PADDLE_CTL_COOLDOWN_N"
+_BUDGET_ENV = "PADDLE_CTL_LEND_BUDGET"
+_WINDOW_S_ENV = "PADDLE_CTL_WINDOW_S"
+
+#: journal kinds this module writes (tools/timeline.py renders the
+#: begin→commit pairs as duration slices on the controller track)
+_JOURNAL_KINDS = ("ctl_lend", "ctl_reclaim", "ctl_abort", "ctl_recover")
+
+_FALLBACK_WRITE_LOCK = threading.Lock()
+
+
+def _envf(name: str, default: float) -> float:
+    try:
+        raw = os.environ.get(name, "").strip()
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def pressure_default() -> float:
+    """``PADDLE_CTL_PRESSURE`` — serving pressure at or above which a
+    window counts as hot (default 0.5: half the admission attempts
+    rejected, or the queue half full fleet-wide)."""
+    return _envf(_PRESSURE_ENV, 0.5)
+
+
+def sustain_n_default() -> int:
+    """``PADDLE_CTL_SUSTAIN_N`` — consecutive hot windows before a lend
+    fires (default 3; one hot sample is noise, not a spike)."""
+    return max(int(_envf(_SUSTAIN_ENV, 3)), 1)
+
+
+def release_default() -> float:
+    """``PADDLE_CTL_RELEASE`` — pressure at or below which a window
+    counts as calm (default 0.05). The gap to ``PADDLE_CTL_PRESSURE``
+    is the hysteresis dead band: windows between the two reset BOTH
+    streaks and can never trigger a transition."""
+    return _envf(_RELEASE_ENV, 0.05)
+
+
+def cooldown_n_default() -> int:
+    """``PADDLE_CTL_COOLDOWN_N`` — consecutive calm windows before a
+    reclaim, AND the minimum windows between any two transitions
+    (default 5) — the anti-flap floor."""
+    return max(int(_envf(_COOLDOWN_ENV, 5)), 1)
+
+
+def lend_budget_default() -> int:
+    """``PADDLE_CTL_LEND_BUDGET`` — dp rows that may be lent to serving
+    concurrently (default 1; training never silently shrinks to
+    nothing)."""
+    return max(int(_envf(_BUDGET_ENV, 1)), 1)
+
+
+def window_s_default() -> float:
+    """``PADDLE_CTL_WINDOW_S`` — seconds per control window
+    (default 1)."""
+    return max(_envf(_WINDOW_S_ENV, 1.0), 0.01)
+
+
+def _consume_ctl_events() -> List:
+    """Drain armed ``ctl:*`` fault events (utils/fault_injection.py).
+    Package import first; standalone loads find the injector under the
+    names the test helpers register it as."""
+    fi = None
+    try:
+        from ..utils import fault_injection as fi  # type: ignore
+    except ImportError:
+        for name in ("fault_injection", "_pdtpu_fault"):
+            fi = sys.modules.get(name)
+            if fi is not None:
+                break
+    if fi is None:
+        return []
+    try:
+        return list(fi.consume_ctl_events())
+    except Exception:  # noqa: BLE001 — fault plumbing never kills control
+        return []
+
+
+def _launcher_write_lock():
+    """The telemetry bus's append lock when the package is importable
+    (the embedded controller shares its process — and launcher file —
+    with bus.emit and the monitor); module-local fallback otherwise."""
+    try:
+        from ..observability import bus as _bus
+
+        return _bus._lock
+    except Exception:  # noqa: BLE001 — standalone load, no package
+        return _FALLBACK_WRITE_LOCK
+
+
+def _read_rows(path: str) -> List[dict]:
+    """Every complete JSON row in one stream file (torn-line tolerant,
+    like bus.read_stream — local copy so standalone loads need no
+    package)."""
+    rows: List[dict] = []
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return rows
+    for line in data.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "kind" in rec:
+            rows.append(rec)
+    return rows
+
+
+class CtlConfig:
+    """Resolved controller knobs (env defaults, ctor overrides)."""
+
+    __slots__ = ("pressure", "sustain_n", "release", "cooldown_n",
+                 "lend_budget", "window_s")
+
+    def __init__(self, pressure: Optional[float] = None,
+                 sustain_n: Optional[int] = None,
+                 release: Optional[float] = None,
+                 cooldown_n: Optional[int] = None,
+                 lend_budget: Optional[int] = None,
+                 window_s: Optional[float] = None):
+        self.pressure = (pressure_default() if pressure is None
+                         else float(pressure))
+        self.sustain_n = (sustain_n_default() if sustain_n is None
+                          else max(int(sustain_n), 1))
+        self.release = (release_default() if release is None
+                        else float(release))
+        self.cooldown_n = (cooldown_n_default() if cooldown_n is None
+                           else max(int(cooldown_n), 1))
+        self.lend_budget = (lend_budget_default() if lend_budget is None
+                            else max(int(lend_budget), 1))
+        self.window_s = (window_s_default() if window_s is None
+                         else max(float(window_s), 0.01))
+        if self.release >= self.pressure:
+            raise ValueError(
+                f"hysteresis requires release < pressure, got "
+                f"{self.release} >= {self.pressure}")
+
+
+class LendPolicy:
+    """The pure hysteresis state machine — no I/O, no clock, one
+    :meth:`observe` per control window. Deterministic and unit-testable
+    apart from everything that moves chips."""
+
+    __slots__ = ("cfg", "hot", "calm", "since", "windows", "suppressed")
+
+    def __init__(self, cfg: CtlConfig):
+        self.cfg = cfg
+        self.hot = 0            # consecutive windows at/above pressure
+        self.calm = 0           # consecutive windows at/below release
+        self.since = cfg.cooldown_n  # windows since last transition
+        self.windows = 0
+        self.suppressed = 0     # decisions blocked by cooldown/budget
+
+    def observe(self, pressure: float, lent: int) -> Optional[str]:
+        """Fold one window's pressure in; returns ``"lend"``,
+        ``"reclaim"``, or None. ``lent`` is the number of rows
+        currently lent (the budget check and the reclaim precondition
+        — ownership lives in the journal, not here)."""
+        self.windows += 1
+        self.since += 1
+        if pressure >= self.cfg.pressure:
+            self.hot += 1
+            self.calm = 0
+        elif pressure <= self.cfg.release:
+            self.calm += 1
+            self.hot = 0
+        else:  # the dead band: neither streak survives it
+            self.hot = 0
+            self.calm = 0
+        if self.hot >= self.cfg.sustain_n:
+            if lent >= self.cfg.lend_budget:
+                return None  # budget-capped steady state, not a flap
+            if self.since <= self.cfg.cooldown_n:
+                self.suppressed += 1
+                return None
+            self.hot = 0
+            self.since = 0
+            return "lend"
+        if self.calm >= self.cfg.cooldown_n and lent > 0:
+            if self.since <= self.cfg.cooldown_n:
+                self.suppressed += 1
+                return None
+            self.calm = 0
+            self.since = 0
+            return "reclaim"
+        return None
+
+
+class FleetController:
+    """Consume the monitor's serving aggregates, decide, journal,
+    actuate.
+
+    ``monitor`` is a live ``FleetMonitor`` to share (the embedded
+    launcher mode — the manager already tails the streams); pass None
+    with ``own_monitor_factory`` (or use the CLI) to tail standalone.
+    ``lend`` / ``reclaim`` are ``fn(ranks, sample)`` actuation
+    callbacks; both None = dryrun. ``probe`` is the restart
+    reconciliation callback: ``probe(pending) -> bool`` asks the planes
+    whether a journaled ``begin`` without its ``commit`` actually
+    happened. ``die_hook`` exists for tests — the default really does
+    ``os.kill(os.getpid(), sig)`` when a ``ctl:die`` fault fires."""
+
+    def __init__(self, obs_dir: str, *,
+                 monitor=None,
+                 config: Optional[CtlConfig] = None,
+                 donor_ranks: Optional[List[int]] = None,
+                 lend: Optional[Callable] = None,
+                 reclaim: Optional[Callable] = None,
+                 probe: Optional[Callable] = None,
+                 emit: bool = True,
+                 die_hook: Optional[Callable] = None):
+        self.obs_dir = obs_dir
+        self.monitor = monitor
+        self.cfg = config or CtlConfig()
+        self.policy = LendPolicy(self.cfg)
+        self.donor_ranks = sorted(donor_ranks or [])
+        self.lend_fn = lend
+        self.reclaim_fn = reclaim
+        self.emit = bool(emit)
+        self.die_hook = die_hook or (
+            lambda sig: os.kill(os.getpid(), sig))
+        self._write_lock = _launcher_write_lock()
+        self.lent: Set[int] = set()
+        self.seq = 0
+        self.windows = 0
+        self.transitions: List[dict] = []
+        self._base: Optional[tuple] = None
+        self._flap_left = 0
+        self._flap_tick = 0
+        self._die_armed = False
+        self._die_sig = signal.SIGKILL
+        self._recover(probe)
+
+    # -- journal ----------------------------------------------------------
+    def _write_row(self, kind: str, payload: dict) -> None:
+        """Append one launcher-stream (rank −1) bus row directly — like
+        the monitor, the journal must land in the obs dir even when
+        this process has no PADDLE_OBS_DIR exported."""
+        if not self.emit:
+            return
+        row = {"v": SCHEMA_VERSION, "kind": kind, "step": None,
+               "time": time.time(), "rank": -1, "payload": payload}
+        try:
+            path = os.path.join(self.obs_dir, "telemetry.launcher.jsonl")
+            with self._write_lock, open(path, "a") as f:
+                f.write(json.dumps(row, default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())  # the crash-safety contract: a
+                # ``begin`` row must survive the SIGKILL it precedes
+        except (OSError, TypeError, ValueError):
+            pass  # journaling must never take the control loop down
+
+    def _recover(self, probe: Optional[Callable]) -> None:
+        """Re-derive ownership by replaying the journal: committed
+        lends minus committed reclaims = lent rows; a trailing begin
+        without commit is reconciled via ``probe`` or aborted. Never
+        guesswork — a controller that cannot read its journal starts
+        owning nothing."""
+        path = os.path.join(self.obs_dir, "telemetry.launcher.jsonl")
+        lent: Set[int] = set()
+        pending: Optional[dict] = None
+        max_seq = 0
+        rows = 0
+        for row in _read_rows(path):
+            kind = row.get("kind")
+            if kind not in ("ctl_lend", "ctl_reclaim", "ctl_abort"):
+                continue
+            p = row.get("payload") or {}
+            if not isinstance(p, dict):
+                continue
+            rows += 1
+            seq = p.get("seq")
+            if isinstance(seq, int):
+                max_seq = max(max_seq, seq)
+            if kind == "ctl_abort":
+                if pending is not None and pending["seq"] == seq:
+                    pending = None
+                continue
+            verb = "lend" if kind == "ctl_lend" else "reclaim"
+            ranks = [r for r in (p.get("ranks") or [])
+                     if isinstance(r, int)]
+            if p.get("phase") == "begin":
+                pending = {"verb": verb, "seq": seq, "ranks": ranks}
+            elif p.get("phase") == "commit":
+                if verb == "lend":
+                    lent.update(ranks)
+                else:
+                    lent.difference_update(ranks)
+                if pending is not None and pending["seq"] == seq:
+                    pending = None
+        self.lent = lent
+        self.seq = max_seq
+        if pending is not None:
+            committed = False
+            if probe is not None:
+                try:
+                    committed = bool(probe(dict(pending)))
+                except Exception:  # noqa: BLE001 — a broken probe is a "no"
+                    committed = False
+            if committed:
+                # the planes say the half-journaled transition landed:
+                # write the commit the dead controller never got to
+                if pending["verb"] == "lend":
+                    self.lent.update(pending["ranks"])
+                else:
+                    self.lent.difference_update(pending["ranks"])
+                self._write_row(f"ctl_{pending['verb']}", {
+                    "phase": "commit", "seq": pending["seq"],
+                    "ranks": pending["ranks"], "recovered": True,
+                    "lent": sorted(self.lent)})
+            else:
+                self._write_row("ctl_abort", {
+                    "verb": pending["verb"], "seq": pending["seq"],
+                    "ranks": pending["ranks"],
+                    "reason": "recovered begin without commit"})
+        if rows:
+            self._write_row("ctl_recover", {
+                "lent": sorted(self.lent), "rows": rows,
+                "seq": self.seq,
+                "pending": None if pending is None else pending["verb"]})
+            print(f"paddle_tpu.ctl: recovered from journal — "
+                  f"lent {sorted(self.lent)}, seq {self.seq}"
+                  + (f", reconciled pending {pending['verb']}"
+                     if pending is not None else ""),
+                  file=sys.stderr, flush=True)
+
+    # -- pressure ---------------------------------------------------------
+    def _sample(self) -> Dict:
+        """One window's pressure sample from the monitor's cumulative
+        serving aggregates (differenced against the previous window)."""
+        s = self.monitor.serving_sample() if self.monitor is not None \
+            else {}
+        adm = int(s.get("admitted") or 0)
+        rej = int(s.get("rejected") or 0)
+        first = self._base is None
+        base = self._base or (adm, rej)
+        d_adm, d_rej = adm - base[0], rej - base[1]
+        self._base = (adm, rej)
+        reject_frac = d_rej / float(max(d_adm + d_rej, 1))
+        qd = int(s.get("queue_depth") or 0)
+        aq = s.get("admit_queue")
+        hosts = int(s.get("hosts") or 1)
+        cap = aq * max(hosts, 1) if isinstance(aq, (int, float)) and \
+            aq > 0 else None
+        queue_frac = min(qd / cap, 1.0) if cap else 0.0
+        # the first window only seeds the baselines: a restarted
+        # controller must not read a lifetime of counters as one spike
+        pressure = 0.0 if first else max(reject_frac, queue_frac)
+        return {
+            "pressure": pressure,
+            "reject_frac": round(reject_frac, 4),
+            "queue_frac": round(queue_frac, 4),
+            "d_admitted": d_adm, "d_rejected": d_rej,
+            "queue_depth": qd,
+            "train_step_ms": s.get("train_step_ms"),
+        }
+
+    # -- the control window -----------------------------------------------
+    def window(self) -> Optional[dict]:
+        """One control window: drain faults, sample pressure, decide,
+        and (on a decision) journal + actuate. Returns the transition
+        record, or None on a quiet window."""
+        for action, arg in _consume_ctl_events():
+            if action == "flap":
+                self._flap_left = int(arg) if arg else 32
+                self._flap_tick = 0
+            elif action == "die":
+                self._die_armed = True
+                self._die_sig = int(arg) if arg else signal.SIGKILL
+        samp = self._sample()
+        if self._flap_left > 0:
+            # synthetic square wave: runs of sustain-length hot windows
+            # alternating with calm ones — each run WOULD trigger a
+            # transition were the cooldown not in the way
+            half = self.cfg.sustain_n
+            samp["pressure"] = (1.0 if (self._flap_tick // half) % 2 == 0
+                                else 0.0)
+            samp["flap"] = True
+            self._flap_tick += 1
+            self._flap_left -= 1
+        self.windows += 1
+        decision = self.policy.observe(samp["pressure"], len(self.lent))
+        if decision is None:
+            return None
+        return self._transition(decision, samp)
+
+    def _transition(self, verb: str, samp: dict) -> Optional[dict]:
+        if verb == "lend":
+            avail = [r for r in self.donor_ranks if r not in self.lent]
+            if not avail:
+                return None  # nothing left to lend (no donors wired)
+            ranks = [max(avail)]  # highest dp row first, the PR-11 order
+        else:
+            if not self.lent:
+                return None
+            ranks = [max(self.lent)]
+        self.seq += 1
+        seq = self.seq
+        kind = f"ctl_{verb}"
+        t0 = time.time()
+        base = {"seq": seq, "ranks": ranks,
+                "pressure": round(samp["pressure"], 4),
+                "lent": sorted(self.lent)}
+        self._write_row(kind, dict(base, phase="begin",
+                                   sample={k: samp[k] for k in
+                                           ("reject_frac", "queue_frac",
+                                            "queue_depth")
+                                           if k in samp}))
+        if self._die_armed:
+            # ctl:die aims HERE — after the begin row is durable,
+            # before actuation/commit: the journal-recovery path's prey
+            self._die_armed = False
+            print(f"fault_injection: ctl:die firing sig="
+                  f"{int(self._die_sig)} mid-{verb} seq {seq}",
+                  file=sys.stderr, flush=True)
+            self.die_hook(self._die_sig)
+        fn = self.lend_fn if verb == "lend" else self.reclaim_fn
+        try:
+            if fn is not None:
+                fn(ranks, samp)
+        except Exception as e:  # noqa: BLE001 — actuation failed: abort,
+            # ownership unchanged (the journal shows begin→abort, both
+            # planes keep running on their pre-transition shapes)
+            self._write_row("ctl_abort", {
+                "verb": verb, "seq": seq, "ranks": ranks,
+                "reason": repr(e)[:200]})
+            print(f"paddle_tpu.ctl: {verb} seq {seq} aborted: {e!r}",
+                  file=sys.stderr, flush=True)
+            return None
+        if verb == "lend":
+            self.lent.update(ranks)
+        else:
+            self.lent.difference_update(ranks)
+        dur_ms = (time.time() - t0) * 1000.0
+        self._write_row(kind, dict(base, phase="commit",
+                                   lent=sorted(self.lent),
+                                   dur_ms=round(dur_ms, 3)))
+        rec = {"verb": verb, "seq": seq, "ranks": ranks,
+               "pressure": samp["pressure"], "dur_ms": dur_ms,
+               "lent": sorted(self.lent), "dryrun": fn is None}
+        self.transitions.append(rec)
+        print(f"paddle_tpu.ctl: {verb} seq {seq} ranks {ranks} "
+              f"(pressure {samp['pressure']:.2f}, "
+              f"{dur_ms:.1f}ms{', dryrun' if fn is None else ''}) — "
+              f"lent now {sorted(self.lent)}",
+              file=sys.stderr, flush=True)
+        return rec
+
+    def run(self, max_seconds: Optional[float] = None,
+            stop: Optional[threading.Event] = None) -> int:
+        """Window loop for the standalone/embedded modes; returns the
+        number of transitions driven."""
+        t0 = time.monotonic()
+        while True:
+            if self.monitor is not None:
+                try:
+                    self.monitor.poll()
+                except Exception:  # noqa: BLE001 — keep controlling
+                    pass
+            self.window()
+            if max_seconds is not None and \
+                    time.monotonic() - t0 >= max_seconds:
+                return len(self.transitions)
+            if stop is not None:
+                if stop.wait(self.cfg.window_s):
+                    return len(self.transitions)
+            else:
+                time.sleep(self.cfg.window_s)
+
+
+# ---------------------------------------------------------------------------
+# standalone CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.fleet_controller",
+        description="train–serve co-tenancy controller over an "
+                    "observability dir (standalone = dryrun: decisions "
+                    "are journaled, nothing moves)")
+    ap.add_argument("--obs_dir", required=True,
+                    help="PADDLE_OBS_DIR of the running job")
+    ap.add_argument("--window_s", type=float, default=None,
+                    help="seconds per control window (default "
+                         "$PADDLE_CTL_WINDOW_S or 1)")
+    ap.add_argument("--donors", default="",
+                    help="comma-separated dp ranks eligible to lend "
+                         "(default: none — decisions log as "
+                         "unactionable)")
+    ap.add_argument("--max_seconds", type=float, default=None,
+                    help="exit after this long (default: run until ^C)")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.obs_dir):
+        print(f"ctl: {args.obs_dir} is not a directory", file=sys.stderr)
+        return 2
+    try:
+        from ..observability.monitor import FleetMonitor
+    except ImportError:  # standalone module load: tail-only fallback
+        FleetMonitor = None
+    mon = None
+    if FleetMonitor is not None:
+        mon = FleetMonitor(args.obs_dir, emit=False)
+    donors = [int(r) for r in args.donors.split(",") if r.strip()]
+    ctl = FleetController(
+        args.obs_dir, monitor=mon,
+        config=CtlConfig(window_s=args.window_s),
+        donor_ranks=donors)
+    try:
+        n = ctl.run(max_seconds=args.max_seconds)
+    except KeyboardInterrupt:
+        n = len(ctl.transitions)
+    print(f"ctl: {ctl.windows} window(s), {n} transition(s), "
+          f"lent {sorted(ctl.lent)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

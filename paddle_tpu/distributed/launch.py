@@ -65,7 +65,7 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
            elastic_retries: int = 0, watchdog_timeout: float = None,
            log_dir: str = None, coll_timeout: float = None,
            reshard: str = None, reshard_quorum: float = None,
-           monitor: bool = None) -> int:
+           monitor: bool = None, ctl: str = None) -> int:
     """Spawn THIS node's ranks and babysit them (launch_collective :208).
 
     `node_rank` selects which host of `ips` this invocation is (default
@@ -112,6 +112,13 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
       stream tailing, straggler ranking, percentile digests, and
       `incident` rows correlating co-occurring failures across ranks —
       flushed before launch() returns.
+    - `ctl` (or PADDLE_CTL, default off) = "dryrun" embeds the
+      train-serve co-tenancy controller (distributed/fleet_controller.py)
+      next to the monitor: the hysteresis state machine samples the
+      monitor's serving aggregates every control window and journals
+      lend/reclaim decisions (ctl_lend/ctl_reclaim rows, crash
+      recoverable) to the launcher bus stream — without actuating, since
+      the training step and serving engine live in the children.
     """
     if node_rank is None:
         node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
@@ -129,6 +136,7 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
         watchdog_timeout=watchdog_timeout, log_dir=log_dir,
         coll_timeout=coll_timeout, reshard=reshard,
         reshard_quorum=reshard_quorum, monitor=monitor,
+        controller=ctl,
     )
     return mgr.run()
 
@@ -180,6 +188,11 @@ def main(argv=None):
                         help="embed the live fleet monitor when an "
                              "observability dir exists (default: "
                              "$PADDLE_MON or on)")
+    parser.add_argument("--ctl", type=str, default=None,
+                        choices=("off", "dryrun"),
+                        help="embed the co-tenancy fleet controller "
+                             "(journal-only in the launcher; default: "
+                             "$PADDLE_CTL or off)")
     parser.add_argument("script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -192,6 +205,7 @@ def main(argv=None):
         reshard_quorum=args.reshard_quorum,
         monitor=(None if args.monitor is None
                  else args.monitor == "on"),
+        ctl=args.ctl,
     )
     sys.exit(rc)
 

@@ -458,6 +458,20 @@ def _notable_detail(kind: str, payload: dict) -> Optional[str]:
                 + (f" (worker rank {hr})" if hr is not None else "")
                 + f" draining: {payload.get('migrated')} migrated, "
                   f"{payload.get('in_place')} in place")
+    # train–serve co-tenancy (ISSUE 16): the fleet controller's lend /
+    # reclaim decisions are the causal hinge between the two planes —
+    # "admission rejected → ctl_lend ranks [3] → reshard 4->3" must
+    # read as ONE incident naming the decision that moved the chips
+    if kind in ("ctl_lend", "ctl_reclaim"):
+        verb = "lend" if kind == "ctl_lend" else "reclaim"
+        p = payload.get("pressure")
+        return (f"{verb} {payload.get('phase')} ranks "
+                f"{payload.get('ranks')}"
+                + (f" (pressure {p:.2f})"
+                   if isinstance(p, (int, float)) else ""))
+    if kind == "ctl_abort":
+        return (f"{payload.get('verb')} seq {payload.get('seq')} "
+                f"aborted: {payload.get('reason')}")
     return None
 
 
@@ -537,6 +551,13 @@ class FleetMonitor:
         self._rank_of: Dict[str, int] = {}
         self._last_snapshot = 0.0
         self._rows_seen = 0
+        #: cumulative serving-plane aggregates (router_metrics /
+        #: router_admit rows) — the fleet controller's pressure inputs;
+        #: counters are monotone per router so max() survives replays
+        self.serve: Dict[str, object] = {
+            "admitted": 0, "rejected": 0, "queue_depth": 0,
+            "admit_queue": None, "hosts": None, "last_time": None,
+        }
         #: serializes poll/finalize/snapshot against each other — the
         #: embedded monitor's thread and the manager's attribution path
         #: (`_attribute` polls for fresh incident context) both drive
@@ -633,6 +654,24 @@ class FleetMonitor:
             ttft = payload.get("ttft_ms")
             if isinstance(ttft, (int, float)):
                 rv.ttft_hist.add(float(ttft))
+        if kind == "router_metrics":
+            adm, rej = payload.get("admitted"), payload.get("rejected")
+            if isinstance(adm, int):
+                self.serve["admitted"] = max(self.serve["admitted"], adm)
+            if isinstance(rej, int):
+                self.serve["rejected"] = max(self.serve["rejected"], rej)
+            qd = payload.get("queue_depth_total")
+            if isinstance(qd, int):
+                self.serve["queue_depth"] = qd
+            hosts = payload.get("hosts")
+            if isinstance(hosts, int):
+                self.serve["hosts"] = hosts
+            if isinstance(t, (int, float)):
+                self.serve["last_time"] = t
+        elif kind == "router_admit":
+            aq = payload.get("admit_queue")
+            if isinstance(aq, (int, float)):
+                self.serve["admit_queue"] = aq
         if kind.startswith("guard_"):
             rv.guard += 1
         elif kind == "recompile":
@@ -740,6 +779,17 @@ class FleetMonitor:
         except (OSError, TypeError, ValueError):
             pass  # diagnostics never take the launcher down
 
+    def serving_sample(self) -> dict:
+        """One consistent read of the serving-plane aggregates plus the
+        training fleet's step_ms EWMA median — the fleet controller's
+        raw pressure inputs (it keeps its own last-window cumulatives
+        and differences them; the monitor stays stateless about the
+        controller's windows)."""
+        with self._lock:
+            out = dict(self.serve)
+            out["train_step_ms"] = self._fleet_median_ewma() or None
+            return out
+
     def snapshot_dict(self) -> dict:
         with self._lock:
             return self._snapshot_dict_locked()
@@ -796,6 +846,7 @@ class FleetMonitor:
                            (self.correlator.closed[-3:] +
                             ([open_inc.payload()] if open_inc else []))],
             },
+            "serving": dict(self.serve),
             "rows_seen": self._rows_seen,
         }
 

@@ -60,7 +60,7 @@ import jax
 import jax.numpy as jnp
 
 from ..jit.decode_step import (
-    DecodeState, DecodeStep, PrefillStep, SpecDecodeState,
+    NO_BUDGET, DecodeState, DecodeStep, PrefillStep, SpecDecodeState,
     SpeculativeDecodeStep, spec_k_default,
 )
 from . import paged_kv as pk
@@ -458,6 +458,7 @@ class InferenceEngine:
         self._key = jax.random.PRNGKey(seed)
         self._pool: Optional[pk.BlockPool] = None
         self._slot_blocks: Dict[int, List[int]] = {}
+        self._retiring: set = set()
         self._nmax = 0
         self._admit_deferred = 0
         self._ttft_window: List[float] = []
@@ -519,6 +520,140 @@ class InferenceEngine:
 
     def inflight(self) -> int:
         return len(self._active) + len(self._pending)
+
+    def expand_slots(self, n: int) -> int:
+        """Grow the decode pool by ``n`` slots at a turn boundary — the
+        serving half of a fleet-controller lend (ISSUE 16). Every cache
+        leaf gains ``n`` batch rows (paged: ``n * nmax`` fresh pool
+        blocks and ``n`` all-trash table rows, registered with the
+        BlockPool so admission sees the new capacity immediately), the
+        per-slot state vectors extend with done/free entries, and the
+        grown state is committed once so the next decode/insert call
+        compiles against a committed pool — one ledger-visible
+        recompile per expansion, priced in PERF.md, never hidden. New
+        slots fill from the queue on the next turn like any free slot;
+        weights are untouched (the replicated checkpoint already
+        resident serves the wider batch). Returns the new slot count."""
+        n = int(n)
+        if n <= 0:
+            return self.slots
+        t0 = time.perf_counter()
+        old = self.slots
+        st = self._state
+
+        def pad0(arr, count, fill=0):
+            z = jnp.full((count,) + arr.shape[1:], fill, arr.dtype)
+            return jnp.concatenate([arr, z], axis=0)
+
+        if self._pool is not None:
+            extra = n * self._nmax
+            self._pool.grow(extra)
+
+            def fix(leaf):
+                if not isinstance(leaf, pk.PagedKV):
+                    return leaf
+                kv = leaf.kv
+                if hasattr(kv, "q"):  # QuantKV: payload AND scales grow
+                    kv = type(kv)(pad0(kv.q, extra),
+                                  pad0(kv.scale, extra))
+                else:
+                    kv = pad0(kv, extra)
+                return pk.PagedKV(kv, pad0(leaf.table, n))
+
+            caches = jax.tree_util.tree_map(
+                fix, st.caches,
+                is_leaf=lambda v: isinstance(v, pk.PagedKV))
+        else:
+            caches = jax.tree_util.tree_map(
+                lambda lf: pad0(lf, n), st.caches)
+        self.slots = old + n
+        self._state = DecodeState(
+            caches, pad0(st.pos, n), pad0(st.tok, n),
+            pad0(st.done, n, True), st.key, pad0(st.temperature, n),
+            pad0(st.top_k, n), pad0(st.top_p, n, 1),
+            pad0(st.eos, n, -1), pad0(st.budget, n, NO_BUDGET))
+        from ..jit.decode_step import _commit_tree
+
+        self._state = DecodeState(*_commit_tree(self._state.astuple()))
+        from ..observability import bus as _bus
+
+        _bus.emit("engine_expand", {
+            "slots_before": old, "slots_after": self.slots,
+            "blocks_total": (None if self._pool is None
+                             else self._pool.total),
+            "dur_ms": round((time.perf_counter() - t0) * 1e3, 3)})
+        return self.slots
+
+    def retire_slots(self, n: int) -> List[int]:
+        """Mark the top ``n`` slots retiring — the reclaim half of a
+        lend round trip. A retiring slot is never refilled; work
+        in flight on it finishes first (drain semantics — nothing is
+        cancelled). The pool physically truncates lazily: once the
+        retiring tail is free — and, for a paged pool, as the highest
+        block ids free up (blocks are fungible, so an in-use high id
+        defers its withdrawal to a later turn) — cache leaves, state
+        vectors, and BlockPool shrink back, checked at every turn
+        boundary. Returns the slot ids still marked retiring."""
+        n = min(int(n), self.slots - 1)
+        if n > 0:
+            self._retiring.update(range(self.slots - n, self.slots))
+            self._maybe_shrink()
+        return sorted(self._retiring)
+
+    def _maybe_shrink(self) -> None:
+        cut = 0
+        while True:
+            top = self.slots - 1 - cut
+            if (top not in self._retiring or top in self._active
+                    or top in self._pending):
+                break
+            cut += 1
+        if cut == 0:
+            return
+        t0 = time.perf_counter()
+        for s in range(self.slots - cut, self.slots):
+            self._retiring.discard(s)
+        old = self.slots
+        new = old - cut
+        st = self._state
+        if self._pool is not None:
+            # live low slots never reference the withdrawn ids: shrink
+            # only surrenders FREE top-of-id-space blocks, and retired
+            # slots' table rows were redirected to trash at release
+            self._pool.shrink(cut * self._nmax)
+            P = self._pool.total + 1
+
+            def fix(leaf):
+                if not isinstance(leaf, pk.PagedKV):
+                    return leaf
+                kv = leaf.kv
+                if hasattr(kv, "q"):
+                    kv = type(kv)(kv.q[:P], kv.scale[:P])
+                else:
+                    kv = kv[:P]
+                return pk.PagedKV(kv, leaf.table[:new])
+
+            caches = jax.tree_util.tree_map(
+                fix, st.caches,
+                is_leaf=lambda v: isinstance(v, pk.PagedKV))
+        else:
+            caches = jax.tree_util.tree_map(lambda lf: lf[:new],
+                                            st.caches)
+        self.slots = new
+        self._state = DecodeState(
+            caches, st.pos[:new], st.tok[:new], st.done[:new], st.key,
+            st.temperature[:new], st.top_k[:new], st.top_p[:new],
+            st.eos[:new], st.budget[:new])
+        from ..jit.decode_step import _commit_tree
+
+        self._state = DecodeState(*_commit_tree(self._state.astuple()))
+        from ..observability import bus as _bus
+
+        _bus.emit("engine_shrink", {
+            "slots_before": old, "slots_after": new,
+            "blocks_total": (None if self._pool is None
+                             else self._pool.total),
+            "dur_ms": round((time.perf_counter() - t0) * 1e3, 3)})
 
     def progress(self) -> Dict[object, List[int]]:
         """rid -> tokens emitted so far, for every request the engine
@@ -630,6 +765,8 @@ class InferenceEngine:
             [s.req.trace_id for s in self._active.values()],
             steps=window)
         self._collect(tok_block, done, results)
+        if self._retiring:
+            self._maybe_shrink()  # a freed retiring tail truncates here
         ttfts, self._ttft_window = self._ttft_window, []
         self._metrics.window(
             steps=window, tokens=int((tok_block >= 0).sum()),
@@ -700,7 +837,8 @@ class InferenceEngine:
             return False
         progress = False
         free = [s for s in range(self.slots)
-                if s not in self._active and s not in self._pending]
+                if s not in self._active and s not in self._pending
+                and s not in self._retiring]
         for slot in free:
             if not self._queue:
                 break

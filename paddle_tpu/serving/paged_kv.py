@@ -277,6 +277,37 @@ class BlockPool:
         self.freed_total += len(blocks)
         self._free.extend(blocks)
 
+    def grow(self, extra: int) -> List[int]:
+        """Register ``extra`` NEW physical blocks (ids continue past
+        the current pool) — the engine's ``expand_slots`` pads the
+        device pool by the same count and the fresh ids go straight to
+        the free list (ISSUE 16: the serving half of a fleet-controller
+        lend)."""
+        if int(extra) <= 0:
+            return []
+        ids = list(range(self.total + 1, self.total + 1 + int(extra)))
+        self.total += int(extra)
+        self._free.extend(ids)
+        return ids
+
+    def shrink(self, want: int) -> int:
+        """Withdraw up to ``want`` blocks from the TOP of the id space —
+        only ids that are currently free can go (an in-use high block
+        defers; blocks are fungible, so the remainder is withdrawn on a
+        later attempt once traffic frees it). Returns how many ids were
+        withdrawn; the caller truncates the device pool to
+        ``total + 1`` blocks to match."""
+        free = set(self._free)
+        withdrawn = 0
+        while withdrawn < int(want) and self.total >= 1 \
+                and self.total in free:
+            free.discard(self.total)
+            self.total -= 1
+            withdrawn += 1
+        if withdrawn:
+            self._free = [b for b in self._free if b <= self.total]
+        return withdrawn
+
 
 # ---------------------------------------------------------------------------
 # byte accounting (static ints — bench/telemetry price HBM from shapes)

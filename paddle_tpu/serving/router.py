@@ -623,6 +623,11 @@ class Router:
         self._pending_guess = [0] * len(self.hosts)
         self._last_submit_t = [0.0] * len(self.hosts)
         self._health = [_HostHealth() for _ in self.hosts]
+        #: capacity units per host: the admission queue bound scales to
+        #: ``admit_queue * capacity[i]``, so a host that absorbed lent
+        #: devices (fleet controller, round 16) advertises the extra
+        #: slots to admission control the moment the lend commits
+        self.capacity = [1] * len(self.hosts)
         self._tracked: Dict[object, _Tracked] = {}
         self._orphans: List[_Tracked] = []
         #: rid -> result dict (token_ids reassembled across hosts);
@@ -641,6 +646,19 @@ class Router:
 
     def inflight(self) -> int:
         return len(self._tracked) + len(self._orphans)
+
+    def register_capacity(self, idx: int, units: int) -> None:
+        """Publish host ``idx``'s capacity in admission units (default
+        1). The queue bound admission control enforces becomes
+        ``admit_queue * units`` ON THE NEXT SUBMIT — the fleet
+        controller calls this right after a lend commits (the host
+        absorbed lent devices and can hold a deeper queue at the same
+        per-request wait) and again after the reclaim drains, so the
+        router starts admitting what it was shedding without a restart
+        or a host re-registration."""
+        if not (0 <= idx < len(self.hosts)):
+            raise ValueError(f"no host {idx}")
+        self.capacity[idx] = max(int(units), 1)
 
     def outstanding(self, idx: Optional[int] = None) -> List[object]:
         """rids tracked on one host (or orphaned, for ``idx=None``)."""
@@ -687,7 +705,7 @@ class Router:
         if not self._live(idx):
             return "not_live"
         depth = st.queue_depth + self._pending_guess[idx]
-        if depth >= self.admit_queue:
+        if depth >= self.admit_queue * self.capacity[idx]:
             return "queue_full"
         if self.admit_ttft_ms > 0 and self._predicted_wait_ms(
                 st, self._pending_guess[idx]) > self.admit_ttft_ms:
@@ -1089,6 +1107,11 @@ class Router:
         total = 0
         for i, h in enumerate(self.hosts):
             st = h.stats()
+            # the guess bridges telemetry lag WITHIN a tick; once the
+            # host's stats postdate our last submit they already count
+            # it — published depth must not double-count indefinitely
+            # (it feeds the fleet controller's queue pressure)
+            self._refresh_guess(i, st)
             depth = st.queue_depth + self._pending_guess[i]
             payload[f"host{i}_queue_depth"] = depth
             payload[f"host{i}_state"] = self._health[i].state

@@ -17,7 +17,9 @@
   (once per serving-router scheduling tick / host-worker poll,
   serving/router.py — the admission-control matrix's prey), ``mon``
   (once per telemetry-bus row write, observability/bus.py — the fleet
-  monitor's lossy-stream prey).
+  monitor's lossy-stream prey), ``ctl`` (once per fleet-controller
+  control window, distributed/fleet_controller.py — the co-tenancy
+  state machine's prey).
 - ``action`` one of ``fail`` (raise InjectedFault, an IOError),
   ``hang`` (sleep ``arg`` seconds, default 3600 — the watchdog's prey),
   ``kill`` (``os._exit(arg)``, default 17 — a hard preemption),
@@ -56,7 +58,15 @@
   sleep-``arg``-seconds semantics), or ``drop`` / ``dup`` (``mon`` only:
   the telemetry bus consumes the rule at its nth row write and drops /
   duplicates that one line — the monitor's incremental cursor and
-  count-based aggregation must survive a lossy, re-appending stream).
+  count-based aggregation must survive a lossy, re-appending stream),
+  or ``flap`` / ``die`` (``ctl`` only: ``flap`` overrides the fleet
+  controller's measured serving pressure with a synthetic square wave —
+  runs of sustain-length hot windows alternating with calm ones, for
+  ``arg`` windows total (default 32) — the hysteresis/cooldown
+  suppression test's prey; ``die`` SIGKILLs the controller process at
+  its nth control window (``arg`` = exit signal override, default
+  SIGKILL), mid-lend when aimed between journal ``begin`` and
+  ``commit`` — the journal-recovery path's prey).
 - ``nth``    1-based per-process call count at which the rule fires
   (each call to a site increments that site's counter), so a relaunched
   attempt that resumes later in training naturally skips the fault.
@@ -79,13 +89,13 @@ from typing import Dict, List, Optional
 
 __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
            "has_site", "consume_grad_action", "consume_rank_events",
-           "consume_serve_events", "consume_mon_action", "GRAD_POISONS",
-           "reset"]
+           "consume_serve_events", "consume_mon_action",
+           "consume_ctl_events", "GRAD_POISONS", "reset"]
 
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
 _ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
             "spike", "depart", "return", "burst", "slow_host",
-            "straggler", "host_crash", "drop", "dup")
+            "straggler", "host_crash", "drop", "dup", "flap", "die")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
 # grad poison only makes sense where a compiled step consumes the flag
@@ -105,6 +115,10 @@ _SERVE_SITES = ("serve",)
 # (observability/bus.py emit — the fleet monitor's cursor prey)
 _MON_ACTIONS = ("drop", "dup")
 _MON_SITES = ("mon",)
+# controller faults only make sense where the fleet controller's
+# control window polls for them (distributed/fleet_controller.py)
+_CTL_ACTIONS = ("flap", "die")
+_CTL_SITES = ("ctl",)
 # sites that pass a file path to fault_point (the only places a corrupt
 # rule can bite) — a corrupt rule elsewhere would be a silent no-op, so
 # the parser rejects it loudly instead
@@ -137,6 +151,7 @@ class FaultInjector:
         self.rank_events: List = []  # armed (action, rank|None), ordered
         self.serve_events: List = []  # armed (action, arg|None), ordered
         self.mon_events: List = []  # armed drop/dup bus-line actions
+        self.ctl_events: List = []  # armed (action, arg|None), ordered
         for item in filter(None, (s.strip() for s in spec.split(","))):
             parts = item.split(":")
             if len(parts) < 3:
@@ -180,6 +195,11 @@ class FaultInjector:
                 raise ValueError(
                     f"{action} rule targets un-instrumented site {site!r} "
                     f"(bus-line sites: {_MON_SITES})"
+                )
+            if action in _CTL_ACTIONS and site not in _CTL_SITES:
+                raise ValueError(
+                    f"{action} rule targets un-instrumented site {site!r} "
+                    f"(controller sites: {_CTL_SITES})"
                 )
             arg = parts[3] if len(parts) > 3 else None
             self._rules.append(_Rule(site, action, nth, arg))
@@ -244,6 +264,13 @@ class FaultInjector:
                   f"{'' if arg is None else f':{arg}'} at {tag}",
                   file=sys.stderr, flush=True)
             self.serve_events.append((r.action, arg))
+            return
+        if r.action in _CTL_ACTIONS:
+            arg = int(r.arg) if r.arg else None
+            print(f"fault_injection: arming ctl:{r.action}"
+                  f"{'' if arg is None else f':{arg}'} at {tag}",
+                  file=sys.stderr, flush=True)
+            self.ctl_events.append((r.action, arg))
             return
         if r.action in _MON_ACTIONS:
             # consumed synchronously by the bus write that fired this
@@ -344,6 +371,19 @@ def consume_mon_action() -> Optional[str]:
     if inj is None or not inj.mon_events:
         return None
     return inj.mon_events.pop(0)
+
+
+def consume_ctl_events() -> List:
+    """Fire the ``ctl`` site for this fleet-controller control window and
+    drain any armed controller events; returns an ordered list of
+    ``(action, arg)`` pairs (``arg`` is None when the rule named none —
+    the consumer picks its default: flap 32 windows, die SIGKILL)."""
+    fault_point("ctl")
+    inj = _active
+    if inj is None or not inj.ctl_events:
+        return []
+    out, inj.ctl_events = inj.ctl_events, []
+    return out
 
 
 def consume_grad_action() -> int:

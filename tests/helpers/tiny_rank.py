@@ -18,6 +18,12 @@ Modes (env TINY_MODE):
             rendezvous across the job's ranks; exits 31 on a
             desync/timeout diagnostic (armed via PADDLE_FAULT_SPEC
             coll:* rules), 0 on a clean pass
+  serve     emit a synthetic serving-pressure trajectory on the bus
+            (router_metrics/router_admit rows, standalone-loaded
+            bus.py): TINY_SERVE_HOT windows of rising rejections, then
+            calm windows with none — the embedded fleet controller's
+            prey (ISSUE 16 launcher dryrun: rank 0 emits, everyone
+            heartbeats until TINY_SERVE_WINDOWS windows elapse)
 """
 import importlib.util
 import os
@@ -128,6 +134,40 @@ elif mode == "reshard":
         with open(f"{ack}.{rank}", "w") as f:
             f.write(got)
     sys.exit(0 if got else 9)
+elif mode == "serve":
+    # ISSUE 16: a co-tenant job under a synthetic serving burst. Rank 0
+    # writes the same cumulative router_metrics counters a real Router
+    # publishes — TINY_SERVE_HOT windows where most submits are
+    # rejected, then calm ones where everything admits — so the
+    # launcher-embedded fleet controller (PADDLE_CTL=dryrun) sees
+    # pressure rise past its threshold, journals a lend, sees it fall,
+    # and journals the reclaim, all without a model or a router in the
+    # child.
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    windows = int(os.environ.get("TINY_SERVE_WINDOWS", "20"))
+    hot = int(os.environ.get("TINY_SERVE_HOT", "8"))
+    dt = float(os.environ.get("TINY_SERVE_DT", "0.1"))
+    bus = _load_standalone(
+        "obs_bus", ("paddle_tpu", "observability", "bus.py"))
+    admitted = rejected = 0
+    if rank == 0:
+        bus.emit("router_admit", {"outcome": "rejected", "host": None,
+                                  "admit_queue": 4, "reason": "queue_full"})
+    for w in range(windows):
+        beat()
+        if rank == 0:
+            if w < hot:
+                admitted += 1
+                rejected += 5
+            else:
+                admitted += 6
+            bus.emit("router_metrics", {
+                "hosts": 1, "admitted": admitted, "rejected": rejected,
+                "queue_depth_total": 4 if w < hot else 0,
+            })
+        time.sleep(dt)
+    beat()
+    sys.exit(0)
 elif mode == "notice":
     flag = os.environ["TINY_NOTICE_FILE"]
 

@@ -1,0 +1,366 @@
+"""Train-serve co-tenancy controller (ISSUE 16) — the fast layer.
+
+Covers the pieces that need no mesh and no model:
+- CtlConfig env knobs + the hysteresis invariant (release < pressure);
+- LendPolicy unit matrix: sustained pressure lends, sustained calm
+  reclaims, the dead band resets both streaks, the lend budget caps
+  concurrency, and the cooldown suppresses (and counts) flapping;
+- the `ctl` fault-injection site: grammar (wrong-site rules rejected
+  loudly), drain ordering, `ctl:flap` square-wave suppression with at
+  most one transition per cooldown window;
+- journal crash-safety in process: begin/commit replay, probe
+  reconciliation of a trailing begin, `ctl:die` via a raising die_hook
+  (the in-process stand-in for SIGKILL) followed by journal recovery;
+- Router.register_capacity scaling the admission bound.
+
+The heavy end-to-end lend/reclaim cycle (real mesh + engine + burst)
+lives in tests/test_serving_cotenancy.py.
+"""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.distributed import fleet_controller as fc
+from paddle_tpu.utils import fault_injection as FI
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("PADDLE_FAULT_SPEC", "PADDLE_OBS_DIR",
+              "PADDLE_OBS_BUS_FILE", "PADDLE_CTL", "PADDLE_CTL_PRESSURE",
+              "PADDLE_CTL_SUSTAIN_N", "PADDLE_CTL_RELEASE",
+              "PADDLE_CTL_COOLDOWN_N", "PADDLE_CTL_LEND_BUDGET",
+              "PADDLE_CTL_WINDOW_S"):
+        monkeypatch.delenv(k, raising=False)
+    FI.reset()
+    yield monkeypatch
+    FI.reset()
+
+
+def _cfg(**kw):
+    kw.setdefault("pressure", 0.5)
+    kw.setdefault("sustain_n", 2)
+    kw.setdefault("release", 0.1)
+    kw.setdefault("cooldown_n", 3)
+    kw.setdefault("lend_budget", 1)
+    kw.setdefault("window_s", 0.01)
+    return fc.CtlConfig(**kw)
+
+
+def _journal(obs_dir):
+    path = os.path.join(str(obs_dir), "telemetry.launcher.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+class TestCtlConfig:
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_CTL_PRESSURE", "0.7")
+        monkeypatch.setenv("PADDLE_CTL_SUSTAIN_N", "5")
+        monkeypatch.setenv("PADDLE_CTL_RELEASE", "0.02")
+        monkeypatch.setenv("PADDLE_CTL_COOLDOWN_N", "9")
+        monkeypatch.setenv("PADDLE_CTL_LEND_BUDGET", "2")
+        monkeypatch.setenv("PADDLE_CTL_WINDOW_S", "0.25")
+        cfg = fc.CtlConfig()
+        assert (cfg.pressure, cfg.sustain_n, cfg.release, cfg.cooldown_n,
+                cfg.lend_budget, cfg.window_s) == (0.7, 5, 0.02, 9, 2,
+                                                   0.25)
+
+    def test_defaults(self):
+        cfg = fc.CtlConfig()
+        assert (cfg.pressure, cfg.sustain_n, cfg.release,
+                cfg.cooldown_n, cfg.lend_budget) == (0.5, 3, 0.05, 5, 1)
+
+    def test_hysteresis_invariant(self):
+        with pytest.raises(ValueError, match="release < pressure"):
+            fc.CtlConfig(pressure=0.3, release=0.3)
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+class TestLendPolicy:
+    def test_sustained_pressure_lends_once(self):
+        pol = fc.LendPolicy(_cfg())
+        decisions = [pol.observe(0.9, 0) for _ in range(2)]
+        assert decisions == [None, "lend"]
+
+    def test_below_sustain_never_lends(self):
+        pol = fc.LendPolicy(_cfg(sustain_n=3))
+        assert [pol.observe(p, 0)
+                for p in (0.9, 0.9, 0.0, 0.9, 0.9)] == [None] * 5
+
+    def test_dead_band_resets_both_streaks(self):
+        pol = fc.LendPolicy(_cfg(sustain_n=2, cooldown_n=2))
+        # hot, dead-band, hot, hot: the mid-band window broke the streak
+        assert pol.observe(0.9, 0) is None
+        assert pol.observe(0.3, 0) is None      # between release and
+        assert pol.hot == 0 and pol.calm == 0   # pressure: both reset
+        assert pol.observe(0.9, 0) is None
+        assert pol.observe(0.9, 0) == "lend"
+
+    def test_budget_caps_without_counting_suppression(self):
+        pol = fc.LendPolicy(_cfg())
+        [pol.observe(0.9, 0) for _ in range(2)]  # -> lend
+        for _ in range(8):
+            assert pol.observe(0.9, 1) is None  # budget-capped steady
+        assert pol.suppressed == 0              # state is not a flap
+
+    def test_reclaim_needs_cooldown_of_calm(self):
+        pol = fc.LendPolicy(_cfg(cooldown_n=3))
+        [pol.observe(0.9, 0) for _ in range(2)]          # lend
+        assert pol.observe(0.0, 1) is None
+        assert pol.observe(0.0, 1) is None
+        assert pol.observe(0.0, 1) is None               # calm streak 3,
+        assert pol.observe(0.0, 1) == "reclaim"          # since-gate open
+
+    def test_cooldown_suppresses_and_counts(self):
+        pol = fc.LendPolicy(_cfg(sustain_n=2, cooldown_n=6,
+                                 lend_budget=2))
+        [pol.observe(0.9, 0) for _ in range(2)]          # lend #1
+        pol.observe(0.0, 1)
+        pol.observe(0.0, 1)
+        # a second hot run inside the cooldown: eligible by streak,
+        # suppressed by the since-gate — and counted
+        assert pol.observe(0.9, 1) is None
+        assert pol.observe(0.9, 1) is None
+        assert pol.suppressed >= 1
+
+
+# ---------------------------------------------------------------------------
+# the ctl fault site
+# ---------------------------------------------------------------------------
+
+
+class TestCtlFaultSite:
+    def test_grammar(self):
+        FI.FaultInjector("ctl:flap:1")
+        FI.FaultInjector("ctl:flap:1:16")
+        FI.FaultInjector("ctl:die:2")
+        with pytest.raises(ValueError, match="un-instrumented site"):
+            FI.FaultInjector("serve:flap:1")
+        with pytest.raises(ValueError, match="un-instrumented site"):
+            FI.FaultInjector("mon:die:1")
+
+    def test_consume_drains_in_order(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "ctl:flap:1:12,ctl:die:1")
+        FI.reset()
+        assert FI.consume_ctl_events() == [("flap", 12), ("die", None)]
+        assert FI.consume_ctl_events() == []
+
+    def test_flap_suppression_one_transition_per_cooldown(
+            self, tmp_path, monkeypatch):
+        """The acceptance bound: under ctl:flap's square wave, commits
+        are spaced at least a full cooldown apart and the suppressed
+        counter shows the policy actually refusing work."""
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "ctl:flap:1:24")
+        FI.reset()
+        cfg = _cfg(sustain_n=2, cooldown_n=6, lend_budget=2)
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1, 2, 3],
+                                 config=cfg)
+        marks = []  # window index of each committed transition
+        for w in range(24):
+            if ctl.window() is not None:
+                marks.append(w)
+        assert marks, "flap never drove a single transition"
+        for a, b in zip(marks, marks[1:]):
+            assert b - a > cfg.cooldown_n, (
+                f"transitions {a}->{b} flapped inside the cooldown")
+        assert ctl.policy.suppressed >= 1
+
+    def test_die_leaves_begin_then_recovery_aborts(
+            self, tmp_path, monkeypatch):
+        """ctl:die between the begin row and actuation: the journal
+        keeps the begin, a restarted controller (no probe) aborts the
+        half transition and owns nothing."""
+
+        class _Died(RuntimeError):
+            pass
+
+        def _boom(sig):
+            raise _Died(f"sig {sig}")
+
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "ctl:flap:1:8,ctl:die:1")
+        FI.reset()
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg(), die_hook=_boom)
+        with pytest.raises(_Died):
+            for _ in range(4):
+                ctl.window()
+        rows = _journal(tmp_path)
+        assert [r["kind"] for r in rows] == ["ctl_lend"]
+        assert rows[0]["payload"]["phase"] == "begin"
+        # restart: the trailing begin is reconciled to an abort
+        ctl2 = fc.FleetController(str(tmp_path), donor_ranks=[0, 1])
+        assert ctl2.lent == set() and ctl2.seq == 1
+        kinds = [r["kind"] for r in _journal(tmp_path)]
+        assert kinds == ["ctl_lend", "ctl_abort", "ctl_recover"]
+
+    def test_die_recovery_with_probe_commits(self, tmp_path, monkeypatch):
+        """Same crash, but the planes report the lend actually landed:
+        recovery writes the missing commit and owns the rank."""
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "ctl:flap:1:8,ctl:die:1")
+        FI.reset()
+
+        def _boom(sig):
+            raise RuntimeError(f"sig {sig}")
+
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg(), die_hook=_boom)
+        with pytest.raises(RuntimeError):
+            for _ in range(4):
+                ctl.window()
+        probed = {}
+
+        def probe(pending):
+            probed.update(pending)
+            return True
+
+        ctl2 = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                  probe=probe)
+        assert probed["verb"] == "lend" and probed["ranks"] == [1]
+        assert ctl2.lent == {1}
+        commits = [r for r in _journal(tmp_path)
+                   if r["kind"] == "ctl_lend"
+                   and r["payload"].get("phase") == "commit"]
+        assert commits and commits[-1]["payload"]["recovered"] is True
+
+
+# ---------------------------------------------------------------------------
+# journal replay
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def test_lend_reclaim_replay(self, tmp_path):
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1, 2, 3],
+                                 config=_cfg())
+        samp = {"pressure": 0.9, "reject_frac": 0.9, "queue_frac": 0.0,
+                "queue_depth": 0}
+        assert ctl._transition("lend", samp)["ranks"] == [3]
+        assert ctl._transition("lend", samp)["ranks"] == [2]
+        assert ctl._transition("reclaim", samp)["ranks"] == [3]
+        assert ctl.lent == {2}
+        fresh = fc.FleetController(str(tmp_path),
+                                   donor_ranks=[0, 1, 2, 3])
+        assert fresh.lent == {2} and fresh.seq == 3
+
+    def test_actuation_failure_aborts_ownership_unchanged(self, tmp_path):
+        def bad_lend(ranks, samp):
+            raise RuntimeError("reshard refused")
+
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg(), lend=bad_lend)
+        samp = {"pressure": 0.9, "reject_frac": 0.9, "queue_frac": 0.0,
+                "queue_depth": 0}
+        assert ctl._transition("lend", samp) is None
+        assert ctl.lent == set()
+        kinds = [r["kind"] for r in _journal(tmp_path)]
+        assert kinds == ["ctl_lend", "ctl_abort"]
+
+    def test_torn_trailing_line_tolerated(self, tmp_path):
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0],
+                                 config=_cfg())
+        ctl._transition("lend", {"pressure": 1.0, "reject_frac": 1.0,
+                                 "queue_frac": 0.0, "queue_depth": 0})
+        path = os.path.join(str(tmp_path), "telemetry.launcher.jsonl")
+        with open(path, "a") as f:
+            f.write('{"v": 1, "kind": "ctl_lend", "payl')  # torn write
+        fresh = fc.FleetController(str(tmp_path), donor_ranks=[0])
+        assert fresh.lent == {0}
+
+
+# ---------------------------------------------------------------------------
+# router capacity
+# ---------------------------------------------------------------------------
+
+
+class _InstantHost:
+    """Minimal endpoint: absorbs submits, reports its queue, completes
+    nothing — admission arithmetic is the whole test surface."""
+
+    def __init__(self):
+        self.subs = []
+
+    def submit(self, d):
+        self.subs.append(dict(d))
+
+    def stats(self):
+        from paddle_tpu.serving.router import HostStats
+
+        return HostStats(queue_depth=0, age_s=None)
+
+
+class TestRouterCapacity:
+    def _router(self, admit_queue=2):
+        from paddle_tpu.serving.router import Router
+
+        return Router([_InstantHost()], admit_queue=admit_queue,
+                      admit_ttft_ms=0)
+
+    def test_default_capacity_bound(self):
+        r = self._router(admit_queue=2)
+        got = [r.submit({"rid": f"a{i}", "prompt_ids": [1],
+                         "max_new_tokens": 4}) for i in range(5)]
+        assert got == [0, 0, None, None, None]
+        assert r.rejected == 3
+
+    def test_register_capacity_scales_bound(self):
+        r = self._router(admit_queue=2)
+        r.register_capacity(0, 3)
+        got = [r.submit({"rid": f"b{i}", "prompt_ids": [1],
+                         "max_new_tokens": 4}) for i in range(7)]
+        assert got == [0] * 6 + [None]
+
+    def test_register_capacity_validates(self):
+        r = self._router()
+        with pytest.raises(ValueError, match="no host 3"):
+            r.register_capacity(3, 2)
+        r.register_capacity(0, 0)   # floors at 1, never disables a host
+        assert r.capacity[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# pressure sampling
+# ---------------------------------------------------------------------------
+
+
+class _FakeMonitor:
+    def __init__(self, samples):
+        self.samples = list(samples)
+
+    def serving_sample(self):
+        return self.samples.pop(0) if self.samples else {}
+
+
+class TestSampling:
+    def test_first_window_seeds_baseline(self, tmp_path):
+        mon = _FakeMonitor([
+            {"admitted": 100, "rejected": 900},   # a lifetime of counters
+            {"admitted": 101, "rejected": 909},   # this window: 1 vs 9
+        ])
+        ctl = fc.FleetController(str(tmp_path), monitor=mon,
+                                 config=_cfg(), emit=False)
+        assert ctl._sample()["pressure"] == 0.0   # seed only, no spike
+        s = ctl._sample()
+        assert s["d_rejected"] == 9 and s["pressure"] == 0.9
+
+    def test_queue_pressure_needs_admit_queue(self, tmp_path):
+        mon = _FakeMonitor([
+            {"admitted": 0, "rejected": 0},
+            {"admitted": 0, "rejected": 0, "queue_depth": 8,
+             "admit_queue": 4, "hosts": 2},
+        ])
+        ctl = fc.FleetController(str(tmp_path), monitor=mon,
+                                 config=_cfg(), emit=False)
+        ctl._sample()
+        assert ctl._sample()["pressure"] == 1.0   # 8 / (4*2) capped

@@ -1,0 +1,467 @@
+"""Train-serve co-tenancy (ISSUE 16) — the end-to-end layer.
+
+- engine elasticity: `expand_slots` at a turn boundary (new slots fed
+  from the resident weights, paged pool grown block-aligned) and
+  `retire_slots` (lazy tail truncation once the retiring slots drain),
+  token-exact against a fixed-size reference engine;
+- the full lend/reclaim cycle in one process: an injected
+  ``serve:burst`` drives admission rejections, the controller lends a
+  dp row (PR-11 ``ElasticStep.notify_departure`` — the training mesh
+  reshards at the next step boundary) and re-registers router
+  capacity, the NEXT burst admits in full (rejection delta zero), calm
+  reclaims (``notify_return`` — training back on the full mesh), and
+  the training trajectory matches an uninterrupted run within the
+  PR-11 continuity bound;
+- ``ctl:die`` at process level: SIGKILL between the journal's begin
+  and commit, restart recovers from the journal alone;
+- the launcher-driven multi-process dryrun: jax-free ``tiny_rank``
+  children emit a synthetic burst, the EMBEDDED controller
+  (``PADDLE_CTL=dryrun``) journals lend + reclaim, the incident chain
+  names the lend decision, and tools/timeline.py renders the
+  CONTROLLER line + duration slices.
+
+Sorts with the other serving E2E files (after the tier-1 timeout
+horizon); run directly for the full-cycle acceptance check.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import comm, resharding
+from paddle_tpu.distributed.fleet_controller import (
+    CtlConfig, FleetController,
+)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.observability import bus
+from paddle_tpu.observability.monitor import FleetMonitor
+from paddle_tpu.serving import InferenceEngine, Request, TransformerLM
+from paddle_tpu.serving.router import HostStats, Router
+from paddle_tpu.utils import fault_injection as fi
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPERS = os.path.join(REPO, "tests", "helpers")
+
+LOSS = lambda o, y: paddle.nn.functional.cross_entropy(o, y)
+
+rng = np.random.RandomState(29)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _restore_mesh():
+    prev = comm._state.hybrid_mesh
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+@pytest.fixture()
+def trivial_mesh():
+    prev = comm._state.hybrid_mesh
+    comm._state.hybrid_mesh = None
+    comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("PADDLE_FAULT_SPEC", "PADDLE_OBS_DIR",
+              "PADDLE_OBS_BUS_FILE", "PADDLE_CTL", "PADDLE_CTL_PRESSURE",
+              "PADDLE_CTL_SUSTAIN_N", "PADDLE_CTL_RELEASE",
+              "PADDLE_CTL_COOLDOWN_N", "PADDLE_CTL_LEND_BUDGET",
+              "PADDLE_CTL_WINDOW_S"):
+        monkeypatch.delenv(k, raising=False)
+    fi.reset()
+    bus.reset()
+    yield monkeypatch
+    fi.reset()
+    bus.reset()
+
+
+def _tiny_lm(vocab=48, cap=64, layers=2, heads=4, d=32, seed=7):
+    paddle.seed(seed)
+    m = TransformerLM(vocab, d_model=d, num_heads=heads,
+                      num_layers=layers, max_position=cap)
+    m.eval()
+    return m
+
+
+def _prompts(n, lo=3, hi=9):
+    return [rng.randint(0, 48, size=(rng.randint(lo, hi),)).astype(
+        np.int32) for _ in range(n)]
+
+
+def _reqs(prompts, n=6):
+    return [Request(p, max_new_tokens=n, rid=i)
+            for i, p in enumerate(prompts)]
+
+
+def _net(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 10))
+
+
+def _batches(n, batch=12, seed=7):
+    rng_ = np.random.RandomState(seed)
+    return [(rng_.rand(batch, 16).astype(np.float32),
+             (np.arange(batch) % 10).astype(np.int64)) for _ in range(n)]
+
+
+def _journal(obs):
+    path = os.path.join(obs, "telemetry.launcher.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+class _AbsorbingHost:
+    """Endpoint that serves instantly: admission arithmetic (queue
+    bound x capacity) is the only contended resource, exactly what the
+    lend changes."""
+
+    def __init__(self):
+        self.received = []
+        self._backlog = 0
+
+    def submit(self, d):
+        self.received.append(dict(d))
+        self._backlog += 1
+
+    def drain(self):
+        """The test calls this between ticks — everything queued has
+        been served, like a live engine turning the crank."""
+        self._backlog = 0
+
+    def stats(self):
+        # fresh stats (age 0): admission reads the REAL backlog, which
+        # builds within a burst and empties between ticks
+        return HostStats(queue_depth=self._backlog, age_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# engine elasticity
+# ---------------------------------------------------------------------------
+
+
+class TestEngineElasticSlots:
+    def test_expand_paged_token_exact_then_retire(self, trivial_mesh,
+                                                  tmp_path, monkeypatch):
+        obs = str(tmp_path / "obs")
+        os.makedirs(obs, exist_ok=True)
+        monkeypatch.setenv("PADDLE_OBS_DIR", obs)
+        bus.reset()
+        m = _tiny_lm()
+        prompts = _prompts(6)
+        ref_engine = InferenceEngine(m, slots=4, max_length=64,
+                                     sync_every=4)
+        for r in _reqs(prompts):
+            ref_engine.submit(r)
+        ref = ref_engine.run()
+
+        e = InferenceEngine(m, slots=2, max_length=64, sync_every=4,
+                            block_size=8, pool_blocks=5)
+        for r in _reqs(prompts):
+            e.submit(r)
+        results = {}
+        e.turn(results)              # a real turn at the small shape
+        blocks_before = e._pool.total
+        assert e.expand_slots(2) == 4 and e.slots == 4
+        assert e._pool.total > blocks_before  # pool grew with the slots
+        while e.turn(results):
+            pass
+        for i in range(len(prompts)):
+            assert ref[i].tokens == results[i].tokens
+        # all slots idle: retirement truncates immediately
+        assert e.retire_slots(2) == []
+        assert e.slots == 2 and e._pool.total <= blocks_before + 16
+        # the truncated engine still serves token-exact
+        reqs2 = [Request(p, max_new_tokens=6, rid=f"r{i}")
+                 for i, p in enumerate(prompts)]
+        res2 = {}
+        for r in reqs2:
+            e.submit(r)
+        while e.turn(res2):
+            pass
+        for i in range(len(prompts)):
+            assert ref[i].tokens == res2[f"r{i}"].tokens
+        kinds = [json.loads(line)["kind"]
+                 for line in open(os.path.join(
+                     obs, "telemetry.rank0.jsonl"))]
+        assert "engine_expand" in kinds and "engine_shrink" in kinds
+
+    def test_expand_contiguous(self, trivial_mesh):
+        m = _tiny_lm()
+        prompts = _prompts(5)
+        ref_engine = InferenceEngine(m, slots=4, max_length=64,
+                                     sync_every=4)
+        for r in _reqs(prompts):
+            ref_engine.submit(r)
+        ref = ref_engine.run()
+        e = InferenceEngine(m, slots=2, max_length=64, sync_every=4)
+        for r in _reqs(prompts):
+            e.submit(r)
+        results = {}
+        e.turn(results)
+        e.expand_slots(2)
+        while e.turn(results):
+            pass
+        for i in range(len(prompts)):
+            assert ref[i].tokens == results[i].tokens
+
+    def test_busy_retiring_slot_defers_truncation(self, trivial_mesh):
+        """A retiring slot mid-request keeps decoding; the shape only
+        shrinks at the turn boundary after it drains."""
+        m = _tiny_lm()
+        long_req = Request(_prompts(1)[0], max_new_tokens=12, rid="long")
+        e = InferenceEngine(m, slots=3, max_length=64, sync_every=2)
+        e.submit(long_req)
+        results = {}
+        e.turn(results)                      # "long" occupies slot 0
+        e.retire_slots(2)                    # slots 1,2 retire at once
+        assert e.slots == 1                  # they were idle: immediate
+        e.submit(Request(_prompts(1)[0], max_new_tokens=4, rid="n"))
+        while e.turn(results):
+            pass
+        assert set(results) == {"long", "n"}
+
+
+# ---------------------------------------------------------------------------
+# the full in-process lend/reclaim cycle
+# ---------------------------------------------------------------------------
+
+
+class TestCoTenancyCycle:
+    def test_burst_lend_reclaim_loss_continuity(self, tmp_path,
+                                                monkeypatch):
+        """The acceptance path: serve:burst -> rejections -> lend (dp4
+        -> dp3 + router capacity up) -> the next burst admits in full
+        -> calm -> reclaim (dp3 -> dp4) -> training trajectory matches
+        an uninterrupted run within the PR-11 bound."""
+        obs = str(tmp_path / "obs")
+        os.makedirs(obs, exist_ok=True)
+        monkeypatch.setenv("PADDLE_OBS_BUS_FILE",
+                           os.path.join(obs, "telemetry.rank0.jsonl"))
+        monkeypatch.setenv(
+            "PADDLE_FAULT_SPEC",
+            "serve:burst:2:12,serve:burst:3:12,serve:burst:4:12")
+        fi.reset()
+        bus.reset()
+
+        comm.set_hybrid_mesh(None)
+        comm.init_hybrid_mesh(dp=4)
+        net = _net()
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters())
+        estep = resharding.ElasticStep(TrainStep(net, LOSS, opt),
+                                       policy="shrink_expand")
+        host = _AbsorbingHost()
+        router = Router([host], admit_queue=2, admit_ttft_ms=0,
+                        avg_new_tokens=8)
+        monitor = FleetMonitor(obs, emit=False)
+        events = []
+
+        def lend(ranks, samp):
+            for r in ranks:
+                estep.notify_departure(r)
+            router.register_capacity(0, 8)
+            events.append(("lend", list(ranks)))
+
+        def reclaim(ranks, samp):
+            router.register_capacity(0, 1)
+            for r in ranks:
+                estep.notify_return(r)
+            events.append(("reclaim", list(ranks)))
+
+        ctl = FleetController(
+            obs, monitor=monitor, donor_ranks=[0, 1, 2, 3],
+            config=CtlConfig(pressure=0.3, release=0.05, sustain_n=2,
+                             cooldown_n=3, window_s=0.01),
+            lend=lend, reclaim=reclaim)
+
+        data = _batches(14)
+        losses, rejected_trace = [], []
+        for x, y in data:
+            losses.append(float(estep(
+                estep.shard_input(x), estep.shard_input(y)).numpy()))
+            router.tick()
+            host.drain()
+            rejected_trace.append(router.rejected)
+            monitor.poll()
+            ctl.window()
+
+        # the transition story: exactly one lend, then one reclaim
+        assert [v for v, _ in events] == ["lend", "reclaim"]
+        assert events[0][1] == [3]          # highest dp row first
+        assert [t["verb"] for t in ctl.transitions] == ["lend",
+                                                        "reclaim"]
+        # bursts at ticks 2 and 3 shed; the post-lend burst (tick 4)
+        # admitted IN FULL — the rejection rate recovered to zero
+        lend_tick = next(i for i, r in enumerate(rejected_trace)
+                         if r == max(rejected_trace))
+        assert rejected_trace[-1] == rejected_trace[lend_tick], \
+            "rejections kept growing after the lend"
+        assert router.rejected > 0          # the pre-lend bursts did shed
+        # every admitted probe reached the host: nothing dropped
+        assert len(host.received) == router.admitted
+        assert router.admitted >= 12        # the post-lend burst landed
+
+        # training returned to the full mesh
+        assert estep.dp_size() == 4 and estep.reshards == 2
+
+        # journal: begin+commit for both verbs, recoverable by a fresh
+        # controller
+        kinds = [(r["kind"], r["payload"].get("phase"))
+                 for r in _journal(obs)
+                 if r["kind"] in ("ctl_lend", "ctl_reclaim")]
+        assert kinds == [("ctl_lend", "begin"), ("ctl_lend", "commit"),
+                         ("ctl_reclaim", "begin"),
+                         ("ctl_reclaim", "commit")]
+        fresh = FleetController(obs, donor_ranks=[0, 1, 2, 3])
+        assert fresh.lent == set()          # everything returned
+
+        # loss continuity vs an uninterrupted run on the same stream
+        comm.set_hybrid_mesh(None)
+        net_ref = _net()
+        opt_ref = optimizer.Adam(learning_rate=1e-3,
+                                 parameters=net_ref.parameters())
+        ref_step = TrainStep(net_ref, LOSS, opt_ref)
+        ref = [float(ref_step(x, y).numpy()) for x, y in data]
+        drift = max(abs(a - b) for a, b in zip(losses, ref))
+        assert drift < 1e-4, f"continuity broke: |d|={drift:.2e}"
+
+
+# ---------------------------------------------------------------------------
+# ctl:die at process level
+# ---------------------------------------------------------------------------
+
+
+class TestControllerCrashRecovery:
+    def test_sigkill_mid_lend_then_journal_recovery(self, tmp_path):
+        """The standalone controller under ctl:die:1 — SIGKILL lands
+        between the fsync'd begin row and the commit. The restarted
+        controller must re-derive ownership from the journal (the begin
+        is aborted without a probe) and exit clean."""
+        obs = str(tmp_path / "obs")
+        os.makedirs(obs, exist_ok=True)
+        stream = os.path.join(obs, "telemetry.rank0.jsonl")
+        stop = threading.Event()
+        counters = {"admitted": 0, "rejected": 0}
+
+        def feed():
+            while not stop.is_set():
+                counters["admitted"] += 1
+                counters["rejected"] += 9
+                with open(stream, "a") as f:
+                    f.write(json.dumps({
+                        "v": 1, "kind": "router_metrics", "step": None,
+                        "time": time.time(), "rank": 0,
+                        "payload": dict(counters, hosts=1,
+                                        queue_depth_total=0)}) + "\n")
+                time.sleep(0.05)
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_FAULT_SPEC="ctl:die:1",
+                   PADDLE_CTL_SUSTAIN_N="2", PADDLE_CTL_COOLDOWN_N="2",
+                   PADDLE_CTL_PRESSURE="0.3", PADDLE_CTL_RELEASE="0.05",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        try:
+            p = subprocess.run(
+                [sys.executable, "-m",
+                 "paddle_tpu.distributed.fleet_controller",
+                 "--obs_dir", obs, "--donors", "0,1",
+                 "--window_s", "0.1", "--max_seconds", "30"],
+                capture_output=True, text=True, env=env, timeout=120)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert p.returncode == -9, (p.returncode, p.stderr[-500:])
+        assert "ctl:die firing" in p.stderr
+        rows = [(r["kind"], r["payload"].get("phase"))
+                for r in _journal(obs) if r["kind"].startswith("ctl_")]
+        assert rows == [("ctl_lend", "begin")], rows
+
+        env.pop("PADDLE_FAULT_SPEC")
+        p2 = subprocess.run(
+            [sys.executable, "-m",
+             "paddle_tpu.distributed.fleet_controller",
+             "--obs_dir", obs, "--donors", "0,1",
+             "--window_s", "0.1", "--max_seconds", "0.5"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert p2.returncode == 0, p2.stderr[-500:]
+        assert "recovered from journal" in p2.stderr
+        kinds = [r["kind"] for r in _journal(obs)
+                 if r["kind"].startswith("ctl_")]
+        assert kinds == ["ctl_lend", "ctl_abort", "ctl_recover"]
+        # aborted, not guessed: the restarted controller owns nothing
+        fresh = FleetController(obs, donor_ranks=[0, 1], emit=False)
+        assert fresh.lent == set()
+
+
+# ---------------------------------------------------------------------------
+# launcher-driven multi-process dryrun
+# ---------------------------------------------------------------------------
+
+
+class TestLauncherDryrun:
+    def test_embedded_controller_journals_and_incident_names_lend(
+            self, tmp_path, monkeypatch):
+        """Two jax-free tiny_rank children emit a synthetic burst; the
+        launcher's embedded controller (PADDLE_CTL=dryrun) must journal
+        a lend while the burst is hot and the reclaim after it cools,
+        the monitor's incident chain must NAME the lend decision, and
+        tools/timeline.py must render the CONTROLLER summary + slices
+        from the obs dir alone."""
+        from paddle_tpu.distributed.launch import launch
+
+        logs = str(tmp_path / "logs")
+        monkeypatch.setenv("PADDLE_CTL", "dryrun")
+        monkeypatch.setenv("PADDLE_CTL_WINDOW_S", "0.15")
+        monkeypatch.setenv("PADDLE_CTL_SUSTAIN_N", "2")
+        monkeypatch.setenv("PADDLE_CTL_COOLDOWN_N", "2")
+        monkeypatch.setenv("PADDLE_CTL_PRESSURE", "0.3")
+        monkeypatch.setenv("PADDLE_CTL_RELEASE", "0.05")
+        monkeypatch.setenv("PADDLE_MON_POLL", "0.05")
+        monkeypatch.setenv("TINY_MODE", "serve")
+        monkeypatch.setenv("TINY_SERVE_WINDOWS", "30")
+        monkeypatch.setenv("TINY_SERVE_HOT", "10")
+        monkeypatch.setenv("TINY_SERVE_DT", "0.1")
+        rc = launch(os.path.join(HELPERS, "tiny_rank.py"), [],
+                    nproc_per_node=2, backend="cpu", log_dir=logs)
+        assert rc == 0
+        rows = _journal(logs)
+        lends = [r for r in rows if r["kind"] == "ctl_lend"
+                 and r["payload"].get("phase") == "commit"]
+        reclaims = [r for r in rows if r["kind"] == "ctl_reclaim"
+                    and r["payload"].get("phase") == "commit"]
+        assert lends, "embedded controller never lent under the burst"
+        assert lends[0]["payload"]["ranks"] == [1]  # highest child rank
+        assert reclaims, "calm never reclaimed"
+        # the incident chain names the lend decision
+        incs = [r for r in rows if r["kind"] == "incident"]
+        chains = " | ".join(r["payload"]["chain"] for r in incs)
+        assert "lend" in chains, chains
+        # the standalone timeline renders the controller story
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "timeline.py"),
+             logs, "--out", str(tmp_path / "trace.json")],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        ctl_lines = [line for line in out.stdout.splitlines()
+                     if line.startswith("CONTROLLER:")]
+        assert ctl_lines and "1 lend(s)" in ctl_lines[0]
+        assert "full mesh restored" in ctl_lines[0]
+        trace = json.load(open(str(tmp_path / "trace.json")))
+        slices = [e for e in trace["traceEvents"]
+                  if e.get("tid") == "controller"]
+        assert any(e["name"].startswith("ctl_lend") for e in slices)
+        assert any(e["name"].startswith("ctl_reclaim") for e in slices)

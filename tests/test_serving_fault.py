@@ -401,6 +401,7 @@ class TestHealthStateMachine:
         router._health.append(_HostHealth())
         router._pending_guess.append(0)
         router._last_submit_t.append(0.0)
+        router.capacity.append(1)
         assert _pump_until(router, [fresh],
                            lambda: "o" in router.completed)
         assert router.completed["o"]["tokens"] == _sim_chain([8], 4)

@@ -32,6 +32,12 @@ duration slices + INCIDENT summary lines; request-scoped `span` rows
 decode_request) group per trace_id, and ``--trace <id>`` prints one
 request's life with per-phase attribution.
 
+Round 16: committed `ctl_lend`/`ctl_reclaim` journal rows from the
+co-tenancy fleet controller render as duration slices on a
+``controller`` track (begin->commit wall time), and the summary grows
+a CONTROLLER line: lends/reclaims/aborts, journal recoveries, median
+transition cost, and who holds the lent ranks at end of trace.
+
 Stdlib-pure: loads the bus parser standalone, no jax import, safe on a
 login node against a dir rsync'd off the pod.
 
@@ -231,6 +237,25 @@ def chrome_trace(streams: Dict[int, List[dict]],
                     "args": {k: payload.get(k) for k in
                              ("rid", "tokens", "latency_ms",
                               "prefill_ms", "ttft_ms", "ms_per_token")},
+                })
+                continue
+            if kind in ("ctl_lend", "ctl_reclaim") and \
+                    payload.get("phase") == "commit":
+                # co-tenancy transitions (ISSUE 16): one slice per
+                # committed lend/reclaim, begin->commit wall time from
+                # the journal's dur_ms, ending at the commit row; begin
+                # and abort rows fall through as instants on the same
+                # lane, so an aborted transition reads as begin with no
+                # slice
+                dur = float(payload.get("dur_ms") or 0.0) * 1e3
+                events.append({
+                    "ph": "X",
+                    "name": f"{kind}:{payload.get('ranks')}",
+                    "pid": rank, "tid": "controller",
+                    "ts": max(us(t) - dur, 0.0), "dur": max(dur, 1.0),
+                    "args": {k: payload.get(k) for k in
+                             ("seq", "ranks", "pressure", "lent",
+                              "dur_ms", "recovered")},
                 })
                 continue
             if kind == "reshard":
@@ -457,6 +482,39 @@ def summarize(streams: Dict[int, List[dict]],
                     f"(worker rank {p.get('host_rank')}) — "
                     f"{p.get('migrated')} migrated, "
                     f"{p.get('in_place')} finished in place")
+    # co-tenancy controller (ISSUE 16): the lend/reclaim trajectory —
+    # committed transitions, aborts, recoveries, and what each cost
+    ctl = {"lend": 0, "reclaim": 0, "abort": 0, "recover": 0}
+    ctl_ms: List[float] = []
+    ctl_last_lent = None
+    for rows in streams.values():
+        for r in rows:
+            p = r.get("payload")
+            if not isinstance(p, dict):
+                continue
+            k = r.get("kind")
+            if k in ("ctl_lend", "ctl_reclaim") and \
+                    p.get("phase") == "commit":
+                ctl["lend" if k == "ctl_lend" else "reclaim"] += 1
+                if isinstance(p.get("dur_ms"), (int, float)):
+                    ctl_ms.append(float(p["dur_ms"]))
+                ctl_last_lent = p.get("lent", ctl_last_lent)
+            elif k == "ctl_abort":
+                ctl["abort"] += 1
+            elif k == "ctl_recover":
+                ctl["recover"] += 1
+                ctl_last_lent = p.get("lent", ctl_last_lent)
+    if any(ctl.values()):
+        med = _median(ctl_ms)
+        lines.append(
+            f"CONTROLLER: {ctl['lend']} lend(s), "
+            f"{ctl['reclaim']} reclaim(s), {ctl['abort']} abort(s)"
+            + (f", {ctl['recover']} journal recovery(ies)"
+               if ctl["recover"] else "")
+            + (f", median transition {med:.1f}ms" if med is not None
+               else "")
+            + (f" — lent now {ctl_last_lent}"
+               if ctl_last_lent else " — full mesh restored"))
     for p in incidents:
         lines.append(f"INCIDENT #{p.get('id')} ranks {p.get('ranks')}: "
                      f"{p.get('chain')}")
