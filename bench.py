@@ -776,6 +776,115 @@ def _bench_serve_failover(n_requests=6, budget=48, rate=4000.0):
     return out
 
 
+def _bench_serve_failover_migrate(n_requests=6, budget=48, rate=4000.0):
+    """KV block migration plane (ISSUE 17): drain-triggered recovery
+    over the MIGRATE fast path — drain_host -> extract verb -> bundle
+    blob -> CRC gate -> splice -> first post-migration token on the
+    survivor. `serve_failover_recovery_ms_migrate` lands next to the
+    round-15 re-prefill key under the continuity gate (the pair IS the
+    PERF.md round-17 pricing: block-move vs re-prefill);
+    `serve_migrate_bytes` / `serve_migrate_blocks` ride report-only.
+    Token-exactness and zero-drop are asserted inside, like the
+    re-prefill bench; at least one request must take the fast path
+    (migrations >= 1) or the number would silently price the wrong
+    ladder rung."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    from paddle_tpu.serving.router import FileHost, Router, sim_next_token
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="pdtpu_migrate_bench_")
+    base = os.path.join(tmp, "mail")
+    obs = os.path.join(tmp, "obs")
+    os.makedirs(obs, exist_ok=True)
+    worker = os.path.join(repo, "paddle_tpu", "serving", "router.py")
+    procs = []
+    out = {}
+    try:
+        for rank in (0, 1):
+            env = dict(os.environ, PADDLE_TRAINER_ID=str(rank),
+                       PADDLE_OBS_DIR=obs)
+            env.pop("PADDLE_FAULT_SPEC", None)
+            env.pop("PADDLE_OBS_BUS_FILE", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, worker, repo, base, str(rate), "0.005"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        hosts = [FileHost(os.path.join(base, f"host{r}"), r, obs_dir=obs)
+                 for r in (0, 1)]
+        # drain_inplace_tokens small so mid-decode victims clear the
+        # cost boundary and take the migrate path (the thing priced)
+        router = Router(hosts, admit_queue=64, avg_new_tokens=budget,
+                        host_timeout_ms=250, retry_backoff_ms=50,
+                        retry_max=2, migrate_timeout_ms=2000,
+                        drain_inplace_tokens=4)
+        prompts = {}
+        for i in range(n_requests):
+            rid = f"mg{i}"
+            prompts[rid] = [i + 1, i + 2, i + 3]
+            router.submit({"rid": rid, "prompt_ids": prompts[rid],
+                           "max_new_tokens": budget})
+        deadline = time.time() + 60
+        # the drained host must be mid-decode: the fast path moves KV
+        # that exists, not an empty cache
+        while time.time() < deadline:
+            router.tick()
+            if any(e.progress for e in router._tracked.values()
+                   if e.host == 0):
+                break
+            time.sleep(0.005)
+        t_drain = time.perf_counter()
+        router.drain_host(0)
+        assert router.migrations >= 1, (
+            "migrate bench: drain took the re-prefill path "
+            f"(migrate_failed={router.migrate_failed})")
+        recovery_ms = None
+        while time.time() < deadline and \
+                len(router.completed) < n_requests:
+            router.tick()
+            if recovery_ms is None:
+                resumed_live = any(
+                    e.attempts > 1 and e.progress
+                    for e in router._tracked.values())
+                resumed_done = any(
+                    r.get("resumed") for r in router.completed.values())
+                if resumed_live or resumed_done:
+                    recovery_ms = (time.perf_counter() - t_drain) * 1e3
+            time.sleep(0.005)
+        assert len(router.completed) == n_requests, (
+            f"migrate bench dropped requests: "
+            f"{len(router.completed)}/{n_requests}")
+        assert recovery_ms is not None
+        for rid, prompt in prompts.items():
+            chain = list(prompt)
+            expect = []
+            for _ in range(budget):
+                t = sim_next_token(chain)
+                chain.append(t)
+                expect.append(t)
+            assert router.completed[rid]["tokens"] == expect, (
+                f"migrate bench: {rid} not token-exact vs the "
+                f"uninterrupted chain")
+        out["serve_failover_recovery_ms_migrate"] = round(recovery_ms, 1)
+        out["serve_migrate_blocks"] = router.migrate_blocks
+        out["serve_migrate_bytes"] = router.migrate_bytes
+    finally:
+        try:
+            os.makedirs(base, exist_ok=True)
+            open(os.path.join(base, "stop"), "w").close()
+            for p in procs:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _bench_ctl(waves=8, per_wave=6, budget=8, rate=4000.0):
     """Train-serve co-tenancy (ISSUE 16): what a serving burst sheds
     with the fleet controller OFF vs ON, plus the cost of one lend
@@ -1147,6 +1256,18 @@ def main():
         )
         extra.update(fo_bd)
         extra["serve_failover_recovery_ms_spread"] = fo_sp
+        # KV block migration plane (ISSUE 17): the recompute-free twin
+        # of the key above — drain-triggered extract->blob->splice
+        # recovery; gated next to the re-prefill number so the fast
+        # path staying fast IS a continuity invariant. bytes/blocks
+        # moved ride report-only
+        mg_ms, mg_bd, mg_sp = _repeat(
+            lambda: (lambda d: (
+                d["serve_failover_recovery_ms_migrate"], d))(
+                _bench_serve_failover_migrate())
+        )
+        extra.update(mg_bd)
+        extra["serve_failover_recovery_ms_migrate_spread"] = mg_sp
         # train-serve co-tenancy (ISSUE 16): burst tokens shed with the
         # fleet controller off vs on (report-only pair) and the
         # begin->commit cost of the lend transition (gated _ms key)

@@ -222,6 +222,24 @@ def coverage_report(leaves: Dict[str, object],
             if not leaf_coverage(arr, lost_devices)]
 
 
+def relayout_tree(tree, target_sharding):
+    """Re-place every array leaf of ``tree`` onto ``target_sharding``
+    with one ``device_put`` per leaf — the reshard transition's
+    re-layout primitive factored out for reuse. The PR-11 reshard moves
+    whole training states between meshes this way; the KV migration
+    plane (ISSUE 17) moves a request's gathered cache blocks onto the
+    survivor pool's placement with the same call before the compiled
+    splice, so an in-process migration is device-to-device (XLA picks
+    direct transfer when source and destination share a backend) rather
+    than a host bounce per leaf. ``target_sharding`` may be a Sharding
+    or a bare Device; None leaves pass through."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, target_sharding)
+        if a is not None else a, tree)
+
+
 # ---------------------------------------------------------------------------
 # launcher notice channel (the SIGTERM-notice pattern from PR 1)
 # ---------------------------------------------------------------------------

@@ -4,8 +4,8 @@ from .control_flow import case, cond, scan, switch_case, while_loop  # noqa: F40
 from .functional_call import functional_call, named_state, raw_state  # noqa: F401
 from .program import InputSpec, StaticFunction, declarative, to_static  # noqa: F401
 from .decode_step import (  # noqa: F401
-    DecodeState, DecodeStep, PrefillStep, SpecDecodeState,
-    SpeculativeDecodeStep,
+    DecodeState, DecodeStep, MigrateInsert, PrefillStep,
+    SpecDecodeState, SpeculativeDecodeStep,
 )
 from .recompute import recompute  # noqa: F401
 from .save_load import TranslatedLayer, load, save  # noqa: F401

@@ -39,7 +39,7 @@ from ..core import autograd as AG
 from ..core.tensor import Tensor
 from .functional_call import _swapped
 
-__all__ = ["DecodeState", "DecodeStep", "PrefillStep",
+__all__ = ["DecodeState", "DecodeStep", "PrefillStep", "MigrateInsert",
            "SpecDecodeState", "SpeculativeDecodeStep", "spec_k_default"]
 
 
@@ -376,6 +376,85 @@ class PrefillStep(_CompiledDecodeBase):
             self._jitted = self._instrumented(donate, out_sh)
         self._n_steps += 1
         return self._jitted(*args)
+
+
+class MigrateInsert:
+    """Compiled insert-WITH-HISTORY (ISSUE 17): splice a migrated KV
+    bundle's gathered block rows into a paged pool slot and reset that
+    slot's decode-state entries to the SOURCE's mid-decode values — the
+    `CacheInsert` seam's third form, next to the engine's contiguous and
+    paged prefill splices (same ledger label, so the recompile contract
+    covers it).
+
+    Where `CacheInsert` writes a freshly PREFILLED batch-1 cache at
+    position 0 with a first sampled token, this writes a cache with
+    ``ctx`` rows of decode HISTORY already in it and resumes feeding the
+    source's last emitted token at position ``ctx`` — the survivor's
+    very next `DecodeStep` continues the sequence as if the request had
+    never moved (zero `PrefillStep` invocations; the parity tests assert
+    token-exactness against an uninterrupted run).
+
+    ``rows`` is a flat list over the cache pytree's `PagedKV` leaves
+    (tree_flatten order), each entry the bundle's zero-padded
+    ``[nmax, H, bs, rest]`` stack — a bare payload tuple or a
+    (payload, scales) pair for QuantKV pools, adopted NARROW
+    (`paged_kv.paged_adopt`). ``slot``/``table_row`` and every state
+    scalar ride traced, so ALL migrations into an engine share one
+    compile."""
+
+    _label = "CacheInsert"
+
+    def __init__(self, *, donate: bool = True):
+        self._donate = donate and jax.default_backend() != "cpu"
+        self._jitted = None
+        self._n_steps = 0
+        from ..observability import bus as _bus, ledger as _ledger
+
+        if _bus.enabled():
+            _ledger.install_backend_listener()
+
+    def _step_fn(self, cache_raws, rows, slot, table_row, pos, tok,
+                 done, temp, top_k, top_p, eos, budget, ctx, last_tok,
+                 t_val, k_val, p_val, e_val, b_val):
+        from ..serving import paged_kv as pk
+
+        flat, treedef = jax.tree_util.tree_flatten(
+            cache_raws, is_leaf=lambda v: isinstance(v, pk.PagedKV))
+        it = iter(rows)
+        out = [pk.paged_adopt(leaf, next(it), slot, table_row)
+               if isinstance(leaf, pk.PagedKV) else leaf
+               for leaf in flat]
+        caches = jax.tree_util.tree_unflatten(treedef, out)
+        return (
+            caches,
+            pos.at[slot].set(ctx),
+            tok.at[slot].set(last_tok),
+            done.at[slot].set(False),
+            temp.at[slot].set(t_val),
+            top_k.at[slot].set(k_val),
+            top_p.at[slot].set(p_val),
+            eos.at[slot].set(e_val),
+            budget.at[slot].set(b_val),
+        )
+
+    @property
+    def compiles(self) -> Optional[int]:
+        return None if self._jitted is None else self._jitted.compiles
+
+    def __call__(self, cache_raws, rows, slot, table_row, pos, tok,
+                 done, temp, top_k, top_p, eos, budget, ctx, last_tok,
+                 t_val, k_val, p_val, e_val, b_val):
+        if self._jitted is None:
+            from ..observability import ledger as _ledger
+
+            donate = (0,) if self._donate else ()
+            self._jitted = _ledger.instrument(
+                jax.jit(self._step_fn, donate_argnums=donate),
+                label=self._label, donate=donate)
+        self._n_steps += 1
+        return self._jitted(cache_raws, rows, slot, table_row, pos, tok,
+                            done, temp, top_k, top_p, eos, budget, ctx,
+                            last_tok, t_val, k_val, p_val, e_val, b_val)
 
 
 # ---------------------------------------------------------------------------

@@ -458,6 +458,17 @@ def _notable_detail(kind: str, payload: dict) -> Optional[str]:
                 + (f" (worker rank {hr})" if hr is not None else "")
                 + f" draining: {payload.get('migrated')} migrated, "
                   f"{payload.get('in_place')} in place")
+    # KV block migration (ISSUE 17): a broken ladder rung is a causal
+    # link in the recovery story — "host 0 draining → kv migrate fail
+    # (crc block 2) → failover re-prefill" must name the block (or the
+    # missing bundle) that cost the fleet a recompute
+    if kind == "kv_migrate_fail":
+        why = payload.get("reason")
+        blk = payload.get("block")
+        return (f"kv migrate failed for {payload.get('rid')} "
+                f"(host {payload.get('from_host')}): {why}"
+                + (f" at block {blk}" if blk is not None else "")
+                + " — fell back to re-prefill")
     # train–serve co-tenancy (ISSUE 16): the fleet controller's lend /
     # reclaim decisions are the causal hinge between the two planes —
     # "admission rejected → ctl_lend ranks [3] → reshard 4->3" must

@@ -452,6 +452,7 @@ class InferenceEngine:
         self._prefill = PrefillStep(model)
         self._decode = DecodeStep(model)
         self._insert_jitted = None
+        self._migrate = None  # lazy jit.MigrateInsert (ISSUE 17)
         self._queue: deque = deque()
         self._active: Dict[int, _Slot] = {}
         self._pending: Dict[int, _Pending] = {}
@@ -597,6 +598,7 @@ class InferenceEngine:
         n = min(int(n), self.slots - 1)
         if n > 0:
             self._retiring.update(range(self.slots - n, self.slots))
+            self._relocate_retiring()
             self._maybe_shrink()
         return sorted(self._retiring)
 
@@ -699,6 +701,208 @@ class InferenceEngine:
                 return True
         return False
 
+    # -- KV block migration (ISSUE 17) -------------------------------------
+    def _quant_name(self) -> Optional[str]:
+        """The pool's QuantKV policy name (None = raw payload) — bundle
+        compatibility is checked by NAME, the narrow form never
+        converts."""
+        for leaf in jax.tree_util.tree_leaves(
+                self._state.caches,
+                is_leaf=lambda v: isinstance(v, pk.PagedKV)):
+            if isinstance(leaf, pk.PagedKV) and hasattr(leaf.kv, "q"):
+                return ("int8" if str(leaf.kv.q.dtype) == "int8"
+                        else "fp8")
+        return None
+
+    def extract_kv(self, rid):
+        """Package an ACTIVE request's live KV into a sealed
+        `kv_migration.KVBundle` (paged pools only; None = not
+        extractable here, the caller falls back to re-prefill). Pure
+        host/gather work at a turn boundary: the request's cache
+        position, feed token, and remaining budget are all derivable
+        from host state (``ctx = len(prefill) + len(tokens) - 1`` — the
+        DecodeStep feed contract), so extraction never reads the decode
+        state vectors. The source keeps serving until the caller
+        cancels — extraction is a COPY, which is what makes the
+        CRC-fail fallback safe."""
+        if self._pool is None:
+            return None
+        for slot, st in self._active.items():
+            if st.req.rid == rid:
+                break
+        else:
+            return None
+        from . import kv_migration as kvm
+
+        req, k = st.req, len(st.tokens)
+        budget_left = int(req.max_new_tokens) - k
+        blocks = self._slot_blocks.get(slot)
+        if not blocks or k < 1 or budget_left < 1:
+            return None  # nothing left worth moving — finish in place
+        ctx = int(req.prefill_ids.size) + k - 1
+        n_used = pk.blocks_for(ctx, self.block_size)
+        leaves = kvm.gather_leaves(self._state.caches,
+                                   blocks[:n_used])
+        bundle = kvm.KVBundle({
+            "rid": req.rid, "trace_id": req.trace_id,
+            "prompt_ids": [int(t) for t in req.prompt_ids],
+            "resume": [int(t) for t in req.resume_tokens],
+            "emitted": [int(t) for t in st.tokens],
+            "ctx": ctx, "last_tok": int(st.tokens[-1]),
+            "temperature": req.temperature, "top_k": req.top_k,
+            "top_p": req.top_p, "eos_id": req.eos_id,
+            "budget_left": budget_left,
+            "block_size": self.block_size, "n_blocks": n_used,
+            "quant": self._quant_name(),
+        }, leaves).seal()
+        self._metrics.span(
+            "kv_extract", trace_id=req.trace_id, rid=rid, slot=slot,
+            blocks=n_used, bytes=bundle.nbytes)
+        return bundle
+
+    def insert_migrated(self, req: Request, bundle) -> bool:
+        """Splice a migrated bundle into a free slot and resume it
+        mid-decode — the receive half of the migration plane. False =
+        this engine cannot host the bundle (layout mismatch, no free
+        slot, pool can't cover) and the caller degrades to re-prefill;
+        True = the request decodes its NEXT token here with zero
+        `PrefillStep` work. The slot's block budget covers the FULL
+        remaining lifetime (``ctx + budget_left``), so the defrag-free
+        append contract holds exactly as for a prefilled insert."""
+        if self._pool is None:
+            return False
+        man = bundle.manifest
+        ctx = int(man.get("ctx", 0))
+        budget_left = int(man.get("budget_left", 0))
+        if (int(man.get("block_size", -1)) != self.block_size
+                or man.get("quant") != self._quant_name()
+                or budget_left < 1
+                or ctx + budget_left > self.max_length):
+            return False
+        n_pool_leaves = sum(
+            1 for leaf in jax.tree_util.tree_leaves(
+                self._state.caches,
+                is_leaf=lambda v: isinstance(v, pk.PagedKV))
+            if isinstance(leaf, pk.PagedKV))
+        if len(bundle.leaves) != n_pool_leaves:
+            return False
+        free = [s for s in range(self.slots)
+                if s not in self._active and s not in self._pending
+                and s not in self._retiring]
+        if not free:
+            return False
+        blocks = self._pool.alloc(
+            pk.blocks_for(ctx + budget_left, self.block_size))
+        if blocks is None:
+            return False
+        slot = free[0]
+        if req.t_submit is None:
+            req.t_submit = time.perf_counter()
+        self._splice_bundle(slot, bundle, blocks)
+        sl = _Slot(req, time.perf_counter(), 0.0, 0, ttft_ms=0.0)
+        sl.tokens = []  # results carry only tokens emitted HERE; the
+        #                 router owns prefix reassembly (round-15 rule)
+        self._active[slot] = sl
+        self._slot_blocks[slot] = blocks
+        self._metrics.span(
+            "kv_insert", trace_id=req.trace_id, rid=req.rid, slot=slot,
+            blocks=bundle.n_blocks, bytes=bundle.nbytes, ctx=ctx)
+        return True
+
+    def _splice_bundle(self, slot, bundle, blocks) -> None:
+        """The compiled gather-scatter insert (`jit.MigrateInsert`, the
+        CacheInsert seam): zero-pad the bundle rows to the table width,
+        re-layout them onto the pool's placement (the PR-11 device_put
+        path — device-to-device when source and survivor share the
+        process), and splice + reset the slot state in ONE program."""
+        from ..distributed import resharding as rs
+        from ..jit.decode_step import MigrateInsert
+
+        man = bundle.manifest
+        pool_leaves = [
+            leaf for leaf in jax.tree_util.tree_leaves(
+                self._state.caches,
+                is_leaf=lambda v: isinstance(v, pk.PagedKV))
+            if isinstance(leaf, pk.PagedKV)]
+        rows = []
+        for leaf, pool in zip(bundle.leaves, pool_leaves):
+            padded = []
+            for arr in leaf:
+                full = np.zeros((self._nmax,) + tuple(arr.shape[1:]),
+                                arr.dtype)
+                full[: arr.shape[0]] = arr
+                padded.append(full)
+            target = getattr(pk._payload(pool.kv), "sharding", None)
+            rows.append(tuple(rs.relayout_tree(padded, target)))
+        row = np.zeros((self._nmax,), np.int32)
+        row[: len(blocks)] = blocks  # trash-padded past the allocation
+        if self._migrate is None:
+            self._migrate = MigrateInsert()
+        st = self._state
+        (caches, pos, tok, done, temp, top_k, top_p, eos, budget) = \
+            self._migrate(
+                st.caches, rows, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(row),
+                st.pos, st.tok, st.done, st.temperature, st.top_k,
+                st.top_p, st.eos, st.budget,
+                jnp.asarray(int(man["ctx"]), jnp.int32),
+                jnp.asarray(int(man["last_tok"]), jnp.int32),
+                jnp.asarray(float(man["temperature"]), jnp.float32),
+                jnp.asarray(int(man["top_k"]), jnp.int32),
+                jnp.asarray(float(man["top_p"]), jnp.float32),
+                jnp.asarray(int(man["eos_id"]), jnp.int32),
+                jnp.asarray(int(man["budget_left"]), jnp.int32))
+        self._state = DecodeState(caches, pos, tok, done, st.key, temp,
+                                  top_k, top_p, eos, budget)
+
+    def _relocate_retiring(self) -> None:
+        """Move ACTIVE requests off retiring top slots into free low
+        slots through the migration plane, so `retire_slots` reclaim
+        stops waiting on in-flight completion (ISSUE 17). Each move is
+        extract -> splice-low -> release-high at a turn boundary; the
+        pool transiently charges both allocations, so a pool too full
+        to double-charge simply retries next turn (drain semantics are
+        unchanged — nothing is ever cancelled)."""
+        if self._pool is None or not self._retiring:
+            return
+        from . import kv_migration as kvm
+
+        if not kvm.migrate_enabled():
+            return
+        for slot in sorted(self._retiring, reverse=True):
+            st = self._active.get(slot)
+            if st is None:
+                continue  # free or pending-prefill: shrink/chunks handle it
+            free = [s for s in range(self.slots)
+                    if s < slot and s not in self._active
+                    and s not in self._pending
+                    and s not in self._retiring]
+            if not free:
+                continue
+            bundle = self.extract_kv(st.req.rid)
+            if bundle is None:
+                continue  # e.g. one token from done: finish in place
+            blocks = self._pool.alloc(pk.blocks_for(
+                int(bundle.manifest["ctx"])
+                + int(bundle.manifest["budget_left"]),
+                self.block_size))
+            if blocks is None:
+                continue
+            tgt = free[0]
+            self._splice_bundle(tgt, bundle, blocks)
+            self._active.pop(slot)
+            self._state.done = self._state.done.at[slot].set(True)
+            self._release(slot, self._slot_blocks.pop(slot, None))
+            moved = _Slot(st.req, st.t_start, st.prefill_ms, 0,
+                          st.ttft_ms)
+            moved.tokens = list(st.tokens)  # same life, new slot
+            self._active[tgt] = moved
+            self._slot_blocks[tgt] = blocks
+            self._metrics.span(
+                "kv_relocate", trace_id=st.req.trace_id,
+                rid=st.req.rid, from_slot=slot, to_slot=tgt,
+                blocks=bundle.n_blocks, bytes=bundle.nbytes)
+
     def submit(self, req: Request) -> None:
         if req.prefill_ids.size + req.max_new_tokens > self.max_length:
             raise ValueError(
@@ -766,6 +970,9 @@ class InferenceEngine:
             steps=window)
         self._collect(tok_block, done, results)
         if self._retiring:
+            # relocate in-flight work off the retiring tail first (the
+            # ISSUE-17 fast path), THEN try the truncation it unblocks
+            self._relocate_retiring()
             self._maybe_shrink()  # a freed retiring tail truncates here
         ttfts, self._ttft_window = self._ttft_window, []
         self._metrics.window(

@@ -46,7 +46,8 @@ from typing import List, Optional
 __all__ = [
     "PagedKV", "block_size_default", "is_paged", "num_blocks",
     "blocks_for", "paged_zero", "paged_write", "paged_gather",
-    "paged_splice", "retire_tables", "pool_bytes", "worst_case_bytes",
+    "paged_splice", "paged_adopt", "retire_tables", "pool_bytes",
+    "worst_case_bytes",
     "BlockPool",
 ]
 
@@ -214,6 +215,31 @@ def paged_splice(paged, slot_kv, slot, table_row):
         return pool.at[table_row[:nmax]].set(rows.astype(pool.dtype))
 
     new_kv = jax.tree_util.tree_map(leaf, paged.kv, slot_kv)
+    return PagedKV(new_kv, paged.table.at[slot].set(table_row))
+
+
+def paged_adopt(paged, rows, slot, table_row):
+    """The CacheInsert splice, MIGRATED form (ISSUE 17): adopt a KV
+    bundle's gathered block rows into this pool. ``rows`` is the
+    bundle's per-leaf stack zero-padded to the table width —
+    ``[nmax, H, bs, rest]`` raw payload, or a ``(payload, scales)``
+    pair for a QuantKV pool, adopted NARROW with no dequantize round
+    trip (that is the bit-exact contract) — and ``table_row`` ([nmax]
+    int32) names the destination physical blocks, trash-padded past
+    the slot's allocation. Rows past the transferred prefix are zeros
+    landing in blocks the resumed request has not written yet (or in
+    trash), which nothing live attends to. One scatter per array;
+    ``slot``/``table_row`` ride traced so every migration shares one
+    compile."""
+    kv = paged.kv
+    if hasattr(kv, "q"):
+        qrows, srows = rows
+        new_kv = type(kv)(
+            kv.q.at[table_row].set(qrows.astype(kv.q.dtype)),
+            kv.scale.at[table_row].set(srows.astype(kv.scale.dtype)))
+    else:
+        payload = rows[0] if isinstance(rows, (tuple, list)) else rows
+        new_kv = kv.at[table_row].set(payload.astype(kv.dtype))
     return PagedKV(new_kv, paged.table.at[slot].set(table_row))
 
 

@@ -50,6 +50,21 @@ Round 15 makes the plane survive host DEATH, not just host slowness:
   retried every tick — shrunk capacity sheds new work deterministically
   but never drops admitted work.
 
+Round 17 makes recovery RECOMPUTE-FREE where it can be: failover and
+drain first try to MOVE the request's live KV blocks to the survivor
+(serving/kv_migration.py — extract through the block table, per-block
+CRC, splice via the compiled `jit.MigrateInsert` gather-scatter) so
+decode continues mid-sentence with zero `PrefillStep` invocations.
+In-process hosts hand the bundle across directly; mailbox hosts answer
+an ``extract`` verb with an ``outbox/kv_<rid>.json`` blob. Any rung
+failing — source device gone, blob timeout, a block failing CRC, no
+survivor pool capacity — emits `kv_migrate_fail` naming the cause and
+falls back to the round-15 re-prefill resume above (graceful
+degradation: the ladder changes the COST of recovery, never whether a
+request survives). :meth:`Router.drain_host` prices the move per
+request (`kv_migration.migrate_cost_tokens`) against finishing in
+place, and ``PADDLE_SERVE_MIGRATE=0`` turns the whole plane off.
+
 Pieces:
 
 - :class:`LocalHost` — an in-process engine endpoint (single-host
@@ -78,13 +93,16 @@ Run as a script (what `distributed.launch` spawns)::
 """
 from __future__ import annotations
 
+import base64
 import importlib.util
 import itertools
 import json
 import os
 import signal as _signal
+import struct
 import sys
 import time
+import zlib
 from typing import Dict, List, Optional
 
 __all__ = ["HostStats", "LocalHost", "FileHost", "Router",
@@ -197,6 +215,15 @@ def _monitor():
         return monitor
     except ImportError:
         return _load_rel("_pdtpu_mon", "observability", "monitor.py")
+
+
+def _kvm():
+    try:
+        from . import kv_migration
+
+        return kv_migration
+    except ImportError:
+        return _load_rel("_pdtpu_kvm", "serving", "kv_migration.py")
 
 
 # ---------------------------------------------------------------------------
@@ -364,6 +391,56 @@ class LocalHost:
         # "drain" is router-side for an in-process engine: admissions
         # stop and the remaining work is pumped dry
 
+    # -- KV block migration (round 17) -------------------------------------
+    def extract_kv(self, rid, timeout_ms=None):
+        """Pull ``rid``'s live KV bundle straight off the engine (the
+        in-process transport never waits — ``timeout_ms`` is the wire
+        contract shared with :meth:`FileHost.extract_kv`). None when
+        the engine has no migratable state for the request — the
+        caller's ladder falls back to re-prefill."""
+        fn = getattr(self.engine, "extract_kv", None)
+        if fn is None:
+            return None
+        try:
+            return fn(rid)
+        except Exception:
+            # extraction is an optimization rung: a broken source must
+            # degrade to re-prefill, never take the router down
+            return None
+
+    def insert_kv(self, bundle) -> bool:
+        """Splice a migrated bundle into this host's engine; the
+        MANIFEST is the resume truth (prefix = resume + emitted,
+        budget = what the source had left). False = this pool cannot
+        cover it (the router tries the next survivor)."""
+        fn = getattr(self.engine, "insert_migrated", None)
+        if fn is None:
+            return False
+        from .engine import Request
+
+        m = bundle.manifest
+        prefix = [int(t) for t in (m.get("resume") or [])] + \
+            [int(t) for t in (m.get("emitted") or [])]
+        req = Request(
+            [int(t) for t in m.get("prompt_ids") or []],
+            max_new_tokens=int(m.get("budget_left", 0)),
+            temperature=float(m.get("temperature", 0.0)),
+            top_k=int(m.get("top_k", 0)),
+            top_p=float(m.get("top_p", 1.0)),
+            eos_id=(None if m.get("eos_id", -1) in (-1, None)
+                    else int(m["eos_id"])),
+            rid=m.get("rid"), trace_id=m.get("trace_id"),
+            resume_tokens=prefix)
+        try:
+            ok = bool(fn(req, bundle))
+        except Exception:
+            ok = False
+        if not ok:
+            return False
+        self._reqs[req.rid] = req
+        self._submitted += 1
+        return True
+
     def signals(self) -> dict:
         now = time.time()
         return {"live_t": now, "service_t": now,
@@ -436,6 +513,65 @@ class FileHost:
         # signals() snapshot copies it for the host's lifetime
         self._progress.pop(rid, None)
 
+    # -- KV block migration (round 17) -------------------------------------
+    def extract_kv(self, rid, timeout_ms=None, _send=True):
+        """Ask the worker for ``rid``'s KV bundle: drop an ``extract``
+        verb, poll ``outbox/kv_<rid>.json`` until it lands or
+        ``timeout_ms`` (default ``PADDLE_SERVE_MIGRATE_TIMEOUT_MS``)
+        expires. None on timeout or a torn blob — the caller's ladder
+        falls back to re-prefill. ``_send=False`` is the hand of the
+        ``serve:kv_lost`` fault: the verb is swallowed so the bundle
+        genuinely never arrives and the deadline does the judging."""
+        kvm = _kvm()
+        if _send:
+            self.send_verb("extract", rid)
+        if timeout_ms is None:
+            timeout_ms = kvm.migrate_timeout_ms_default()
+        path = os.path.join(self.outbox, f"kv_{rid}.json")
+        deadline = time.time() + float(timeout_ms) / 1e3
+        while True:
+            if os.path.exists(path):
+                try:
+                    bundle = kvm.KVBundle.read_blob(path)
+                except (OSError, ValueError):
+                    return None
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                self._progress.pop(rid, None)
+                return bundle
+            if time.time() >= deadline:
+                return None
+            time.sleep(0.005)
+
+    def insert_kv(self, bundle) -> bool:
+        """Hand a migrated request to this worker. The dryrun worker
+        holds no real device KV — its \"cache\" IS the token chain —
+        so the splice degenerates to a resume submit built from the
+        bundle's MANIFEST (still the control-plane contract: same rid,
+        manifest-fresh prefix, decremented budget, ``migrated`` flag
+        on the mailbox row); a real RPC host would ship the leaves."""
+        m = bundle.manifest
+        prefix = [int(t) for t in (m.get("resume") or [])] + \
+            [int(t) for t in (m.get("emitted") or [])]
+        if int(m.get("budget_left", 0)) < 1:
+            return False
+        self.submit({
+            "rid": m.get("rid"),
+            "prompt_ids": [int(t) for t in m.get("prompt_ids") or []],
+            "max_new_tokens": int(m.get("budget_left", 0)),
+            "temperature": float(m.get("temperature", 0.0)),
+            "top_k": int(m.get("top_k", 0)),
+            "top_p": float(m.get("top_p", 1.0)),
+            "eos_id": (-1 if m.get("eos_id") is None
+                       else int(m.get("eos_id", -1))),
+            "trace_id": m.get("trace_id"),
+            "resume_tokens": prefix,
+            "migrated": True,
+        })
+        return True
+
     def _stream_path(self) -> str:
         return os.path.join(self.obs_dir,
                             f"telemetry.rank{self.rank}.jsonl")
@@ -482,6 +618,8 @@ class FileHost:
         for name in sorted(os.listdir(self.outbox)):
             if not name.endswith(".json"):
                 continue
+            if name.startswith("kv_"):
+                continue  # a migration bundle blob, not a result
             path = os.path.join(self.outbox, name)
             try:
                 with open(path) as f:
@@ -585,7 +723,7 @@ class Router:
                  avg_new_tokens=16, burst_prompt_len=4,
                  burst_new_tokens=None, host_timeout_ms=None,
                  retry_max=None, retry_backoff_ms=None,
-                 drain_inplace_tokens=None):
+                 drain_inplace_tokens=None, migrate_timeout_ms=None):
         self.hosts = list(hosts)
         if not self.hosts:
             raise ValueError("Router needs at least one host")
@@ -612,12 +750,22 @@ class Router:
         self.drain_inplace_tokens = (self.avg_new_tokens
                                      if drain_inplace_tokens is None
                                      else int(drain_inplace_tokens))
+        #: cross-process bundle arrival deadline (round 17); None =
+        #: resolve ``PADDLE_SERVE_MIGRATE_TIMEOUT_MS`` per attempt
+        self.migrate_timeout_ms = migrate_timeout_ms
         self.admitted = 0
         self.rejected = 0
         self.failovers = 0
         self.duplicates = 0
+        self.migrations = 0       # recovery moves that spliced KV
+        self.migrate_failed = 0   # ladder falls to re-prefill
+        self.migrate_blocks = 0   # blocks moved (bench: report-only)
+        self.migrate_bytes = 0    # bytes moved (bench: report-only)
         self._ticks = 0
         self._burst_rid = 0
+        #: armed serve:kv_corrupt / serve:kv_lost faults, consumed one
+        #: per migration attempt (the router's side of the serve site)
+        self._kv_faults: List = []
         # submits this router made that the host telemetry cannot have
         # absorbed yet; decays when a fresher stats row shows up
         self._pending_guess = [0] * len(self.hosts)
@@ -789,12 +937,9 @@ class Router:
         finish drains, retry orphans, and publish `router_metrics`.
         Returns the burst routing outcomes (host index or None per
         synthetic request)."""
-        fi = _fault()
         self._ticks += 1
         outcomes: List[Optional[int]] = []
-        for action, arg in fi.consume_serve_events():
-            if action != "burst":
-                continue  # the other serve events are the worker's
+        for action, arg in self._consume_serve():
             n = int(arg) if arg else 8
             for _ in range(n):
                 self._burst_rid += 1
@@ -810,6 +955,21 @@ class Router:
         self._resubmit_orphans(now)
         self._emit_metrics()
         return outcomes
+
+    def _consume_serve(self) -> List:
+        """Drain armed ``serve`` events on the ROUTER's side of the
+        site: ``burst`` pairs are returned for :meth:`tick` to submit;
+        ``kv_corrupt`` / ``kv_lost`` are stashed for the next migration
+        attempt (round 17); the worker-side actions (slow_host,
+        straggler, host_crash, hang) are dropped — each worker process
+        drains its own injector."""
+        out: List = []
+        for action, arg in _fault().consume_serve_events():
+            if action in ("kv_corrupt", "kv_lost"):
+                self._kv_faults.append((action, arg))
+            elif action == "burst":
+                out.append((action, arg))
+        return out
 
     # -- health: signal folding --------------------------------------------
     def _poll_hosts(self, now: float) -> None:
@@ -969,10 +1129,12 @@ class Router:
     # -- failover / resume --------------------------------------------------
     def _failover(self, e: _Tracked, from_host: int, now: float, *,
                   kind: str) -> Optional[int]:
-        """Move one in-flight request off ``from_host`` via the resume
-        path: prefix = old resume + everything the host emitted, budget
-        decremented, SAME rid (idempotent — a recovering host's late
-        copy deduplicates instead of double-serving)."""
+        """Move one in-flight request off ``from_host``: first try the
+        round-17 KV block migration (recompute-free — the survivor
+        splices the source's cache and decodes on), else the round-15
+        resume path: prefix = old resume + everything the host emitted,
+        budget decremented, SAME rid (idempotent — a recovering host's
+        late copy deduplicates instead of double-serving)."""
         self._tracked.pop(e.rid, None)
         prefix = list(e.fields.get("resume_tokens") or []) + \
             [int(t) for t in e.progress]
@@ -996,10 +1158,20 @@ class Router:
                 "resumed": len(prefix) - len(e.progress),
                 "trace_id": e.trace_id,
             })
+            if kind == "drain":
+                self._cancel_on_host(from_host, e.rid)
             span_payload["to_host"] = None
             span_payload["completed_from_progress"] = True
             self._emit_fail_span(kind, e.trace_id, span_payload)
             return None
+        if _kvm().migrate_enabled():
+            placed = self._try_migrate(e, from_host, now, kind=kind,
+                                       span_payload=span_payload)
+            if placed is not None:
+                return placed
+        # re-prefill resume (round 15) — the asserted fallback rung
+        if kind == "drain":
+            self._cancel_on_host(from_host, e.rid)
         fields = dict(e.fields)
         fields["resume_tokens"] = prefix
         fields["max_new_tokens"] = budget_left
@@ -1016,6 +1188,147 @@ class Router:
             # shrunk capacity sheds NEW work, never admitted work
             self._orphans.append(e)
         return placed
+
+    # -- KV block migration (round 17) --------------------------------------
+    def _try_migrate(self, e: _Tracked, from_host: int, now: float, *,
+                     kind: str, span_payload: dict) -> Optional[int]:
+        """The recompute-free rung of the recovery ladder: pull the
+        request's KV bundle off the source, CRC-gate it, splice it into
+        the best eligible survivor, and re-track the request there with
+        the bundle MANIFEST as the resume truth (the extract-side
+        snapshot is at least as fresh as the router's progress rows).
+        Every failure emits `kv_migrate_fail` naming the cause
+        (``source_dead`` / ``timeout`` / ``lost`` / ``crc`` + block /
+        ``no_capacity``) and returns None — the caller re-prefills.
+        Armed ``serve:kv_corrupt`` / ``serve:kv_lost`` faults bite
+        here, one per migration attempt."""
+        src = (self.hosts[from_host]
+               if 0 <= from_host < len(self.hosts) else None)
+        if src is None or not hasattr(src, "extract_kv"):
+            return None  # no migration plane on this endpoint
+        if not e.progress and not e.fields.get("resume_tokens"):
+            # nothing decoded yet (still queued / mid-prefill): there
+            # is no KV worth moving and re-prefill costs nothing extra
+            return None
+        hh = self._health[from_host]
+        if hh.state == "dead" and hh.reason == "silent":
+            # heartbeat gone = process (and its device state) gone:
+            # there is nothing to extract — the asserted degradation
+            # case, not worth burning the blob deadline on
+            self._emit_migrate_fail(e, from_host, "source_dead", None)
+            return None
+        t0 = time.perf_counter()
+        fault = self._kv_faults.pop(0) if self._kv_faults else None
+        bundle = None
+        reason = "timeout"
+        block = None
+        if fault is not None and fault[0] == "kv_lost":
+            # the bundle never arrives: a mailbox source burns the real
+            # arrival deadline (suppressed verb -> poll -> timeout); an
+            # in-process source has no wire to lose it on, so the loss
+            # reports synchronously
+            if getattr(src, "inbox", None) is not None:
+                bundle = src.extract_kv(e.rid, self.migrate_timeout_ms,
+                                        _send=False)
+            else:
+                reason = "lost"
+        else:
+            try:
+                bundle = src.extract_kv(e.rid, self.migrate_timeout_ms)
+            except OSError:
+                reason = "error"
+        if bundle is not None:
+            if fault is not None and fault[0] == "kv_corrupt":
+                block = bundle.flip_bit(fault[1])
+            bad = bundle.verify()
+            if bad:
+                reason, block = "crc", bad[0]
+                bundle = None
+        if bundle is None:
+            self._emit_migrate_fail(e, from_host, reason, block)
+            return None
+        m = bundle.manifest
+        prefix = [int(t) for t in (m.get("resume") or [])] + \
+            [int(t) for t in (m.get("emitted") or [])]
+        budget_left = int(m.get("budget_left", 0))
+        # survivor choice mirrors _route (live, in admission bounds,
+        # lowest predicted wait) but probes the SPLICE host by host: a
+        # pool that cannot cover the blocks refuses and the next
+        # candidate is tried — only when every survivor refuses does
+        # the ladder fall to re-prefill, which can QUEUE where a
+        # splice cannot
+        stats, reasons = [], []
+        for i, h in enumerate(self.hosts):
+            st = h.stats()
+            self._refresh_guess(i, st)
+            stats.append(st)
+            reasons.append(self._ineligible_why(i, st))
+        order = sorted(
+            (i for i, why in enumerate(reasons)
+             if why is None and i != from_host
+             and hasattr(self.hosts[i], "insert_kv")),
+            key=lambda i: self._predicted_wait_ms(
+                stats[i], self._pending_guess[i]))
+        placed = None
+        for i in order:
+            try:
+                if self.hosts[i].insert_kv(bundle):
+                    placed = i
+                    break
+            except OSError:
+                continue
+        if placed is None:
+            self._emit_migrate_fail(e, from_host, "no_capacity", None)
+            return None
+        fields = dict(e.fields)
+        fields["resume_tokens"] = prefix
+        fields["max_new_tokens"] = budget_left
+        e.fields = fields
+        e.progress = []
+        e.host = placed
+        e.t_submit = now
+        e.attempts += 1
+        self._tracked[e.rid] = e
+        self._pending_guess[placed] += 1
+        self._last_submit_t[placed] = time.time()
+        self.failovers += 1
+        self.migrations += 1
+        self.migrate_blocks += bundle.n_blocks
+        self.migrate_bytes += bundle.nbytes
+        # the source stops wasting work (and frees the blocks) the
+        # moment the survivor owns the request
+        self._cancel_on_host(from_host, e.rid)
+        bus = _bus()
+        if bus.enabled():
+            # begin->commit duration slice on the request's trace lane
+            bus.emit_span("kv_migrate", e.trace_id, {
+                "rid": e.rid, "from_host": from_host, "to_host": placed,
+                "kind": kind, "blocks": bundle.n_blocks,
+                "bytes": bundle.nbytes, "resumed": len(prefix),
+                "budget_left": budget_left,
+                "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }, step=self._ticks)
+        span_payload["to_host"] = placed
+        span_payload["migrated"] = True
+        span_payload["resumed"] = len(prefix)
+        self._emit_fail_span(kind, e.trace_id, span_payload)
+        return placed
+
+    def _emit_migrate_fail(self, e: _Tracked, from_host: int,
+                           reason: str, block) -> None:
+        """One `kv_migrate_fail` row per broken ladder rung — the
+        incident correlator folds it into the chain NAMING the failed
+        block (reason ``crc``) or the missing bundle (``timeout`` /
+        ``lost`` / ``source_dead`` / ``no_capacity``)."""
+        self.migrate_failed += 1
+        bus = _bus()
+        if not bus.enabled():
+            return
+        payload = {"rid": e.rid, "from_host": from_host,
+                   "reason": reason, "trace_id": e.trace_id}
+        if block is not None:
+            payload["block"] = int(block)
+        bus.emit("kv_migrate_fail", payload, step=self._ticks)
 
     def _resubmit_orphans(self, now: float) -> None:
         if not self._orphans:
@@ -1034,18 +1347,25 @@ class Router:
     # -- drain --------------------------------------------------------------
     def drain_host(self, idx: int) -> dict:
         """Live drain (round 15): stop admissions to host ``idx``, let
-        short requests (≤ ``drain_inplace_tokens`` left) finish in
-        place, migrate long ones via the resume path (cancelling them
-        on the drainer), and send the ``drain`` verb so the worker
-        retires rc 0 once its queue is empty. Returns a summary dict;
-        the host reaches ``retired`` state on the tick that sees its
-        last outstanding request finish."""
+        short requests finish in place, move long ones (round 17: KV
+        block migration first, resume re-prefill as the fallback,
+        cancelling them on the drainer either way), and send the
+        ``drain`` verb so the worker retires rc 0 once its queue is
+        empty. The in-place/move boundary is COST-BASED: a request
+        moves only when its remaining tokens exceed both
+        ``drain_inplace_tokens`` and the priced transfer
+        (`kv_migration.migrate_cost_tokens` over its context) — a
+        request a few tokens from done finishes in place even when its
+        long context would make the move dearer than the remainder.
+        Returns a summary dict; the host reaches ``retired`` state on
+        the tick that sees its last outstanding request finish."""
         if not (0 <= idx < len(self.hosts)):
             raise ValueError(f"no host {idx}")
         hh = self._health[idx]
         if hh.state in ("dead", "retired"):
             raise ValueError(
                 f"host {idx} is {hh.state}; nothing to drain")
+        kvm = _kvm()
         now = time.time()
         # fold the freshest progress in first: migration resumes from
         # what the host actually emitted, not a stale view
@@ -1057,8 +1377,13 @@ class Router:
         for e in [t for t in self._tracked.values() if t.host == idx]:
             left = int(e.fields.get("max_new_tokens", 0)) - \
                 len(e.progress)
-            if left > self.drain_inplace_tokens:
-                self._cancel_on_host(idx, e.rid)
+            threshold = float(self.drain_inplace_tokens)
+            if kvm.migrate_enabled():
+                ctx = (len(e.fields.get("prompt_ids") or []) +
+                       len(e.fields.get("resume_tokens") or []) +
+                       len(e.progress))
+                threshold = max(threshold, kvm.migrate_cost_tokens(ctx))
+            if left > threshold:
                 self._failover(e, idx, now, kind="drain")
                 migrated += 1
             else:
@@ -1102,6 +1427,8 @@ class Router:
             "rejected": self.rejected,
             "failovers": self.failovers,
             "duplicates": self.duplicates,
+            "migrations": self.migrations,
+            "migrate_failed": self.migrate_failed,
             "orphans": len(self._orphans),
         }
         total = 0
@@ -1186,6 +1513,56 @@ class Router:
 #: most one window of host-visible progress (exactly like the engine)
 _WORKER_WINDOW = 4
 
+#: the sim worker's "KV block": its deterministic cache is the token
+#: chain itself, packed this many int32 per block for the bundle blob
+_SIM_KV_BLOCK = 4
+
+
+def _sim_kv_blob(current: dict, rank: int) -> dict:
+    """The dryrun worker's answer to the ``extract`` verb (round 17):
+    the SAME wire form ``serving/kv_migration.KVBundle`` reads — ``v``
+    / ``manifest`` / ``leaves`` with base64 little-endian arrays and a
+    chained per-block CRC32 — built with nothing but the stdlib (the
+    worker must stay jax- and numpy-free). The sim's "KV" is its token
+    chain packed :data:`_SIM_KV_BLOCK` ints per block, padded with -1:
+    real bytes for the CRC gate and the ``kv_corrupt`` fault to bite
+    on, while the manifest carries the resume truth the survivor
+    decodes from."""
+    req = current["req"]
+    chain = [int(t) for t in current["chain"]]
+    bs = _SIM_KV_BLOCK
+    n = max((len(chain) + bs - 1) // bs, 1)
+    rows = chain + [-1] * (n * bs - len(chain))
+    crcs = [zlib.crc32(
+        struct.pack(f"<{bs}i", *rows[b * bs:(b + 1) * bs]), 0)
+        & 0xFFFFFFFF for b in range(n)]
+    emitted = [int(t) for t in current["emitted"]]
+    manifest = {
+        "rid": req.get("rid"),
+        "trace_id": req.get("trace_id"),
+        "prompt_ids": [int(t) for t in req.get("prompt_ids") or []],
+        "resume": [int(t) for t in current["resume"]],
+        "emitted": emitted,
+        "ctx": len(chain),
+        "last_tok": chain[-1],
+        "temperature": req.get("temperature", 0.0),
+        "top_k": req.get("top_k", 0),
+        "top_p": req.get("top_p", 1.0),
+        "eos_id": req.get("eos_id", -1),
+        "budget_left": int(req.get("max_new_tokens", 16)) - len(emitted),
+        "block_size": bs,
+        "n_blocks": n,
+        "quant": None,
+        "sim": True,
+        "rank": rank,
+        "crcs": crcs,
+    }
+    data = base64.b64encode(
+        struct.pack(f"<{n * bs}i", *rows)).decode("ascii")
+    return {"v": 1, "manifest": manifest,
+            "leaves": [[{"dtype": "int32", "shape": [n, bs],
+                         "data": data}]]}
+
 
 def worker_main(argv: Optional[List[str]] = None) -> int:
     """Simulated host worker for the launcher-driven multi-process
@@ -1205,7 +1582,10 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     Verbs (round 15): a ``{"verb": "drain"}`` inbox file finishes the
     queue then exits rc 0 (planned retirement); ``{"verb": "cancel",
     "rid": r}`` withdraws one request (dropped from the queue, or
-    abandoned mid-decode without a result).
+    abandoned mid-decode without a result). Round 17 adds ``{"verb":
+    "extract", "rid": r}``: the worker writes ``outbox/kv_<rid>.json``
+    — a :func:`_sim_kv_blob` bundle in the `kv_migration.KVBundle`
+    wire form — and hands the request off to the survivor.
 
     Faults (``serve`` site, rank-targeted): ``slow_host`` multiplies
     simulated work 20x; ``straggler`` adds a fixed per-window delay;
@@ -1283,6 +1663,30 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                     if current is not None and \
                             current["req"].get("rid") == row.get("rid"):
                         current = None  # abandon mid-decode, no result
+                    continue
+                if verb == "extract":
+                    # round 17: answer with the in-flight request's KV
+                    # bundle blob, then hand the request off — the
+                    # survivor owns it the moment the blob lands, so
+                    # keeping it serving would double-spend the budget
+                    # the manifest just promised away. An unknown or
+                    # not-yet-started rid writes nothing: the router's
+                    # blob deadline judges, re-prefill recovers.
+                    rid = row.get("rid")
+                    if current is not None and \
+                            current["req"].get("rid") == rid and \
+                            current["emitted"]:
+                        blob = _sim_kv_blob(current, rank)
+                        path = os.path.join(outbox, f"kv_{rid}.json")
+                        with open(path + ".tmp", "w") as f:
+                            json.dump(blob, f)
+                        os.replace(path + ".tmp", path)
+                        bus.emit("kv_extract", {
+                            "rid": rid,
+                            "trace_id": current["req"].get("trace_id"),
+                            "blocks": blob["manifest"]["n_blocks"],
+                        }, step=windows)
+                        current = None
                     continue
                 row["t_arrive"] = time.time()
                 queue.append(row)

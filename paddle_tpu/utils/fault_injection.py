@@ -49,8 +49,15 @@
   detector must NAME that rank), ``serve:host_crash:2:0`` SIGKILLs the
   host-rank-0 worker at its next MID-DECODE window after its 2nd poll
   (the failover path's prey: the process dies with a request half
-  served, ISSUE 15); ``arg`` defaults: burst 8 requests,
-  slow_host/straggler/host_crash rank 0. At the ``serve`` site the
+  served, ISSUE 15), ``serve:kv_corrupt:1[:block]`` bit-flips one
+  block of the NEXT KV migration bundle the router extracts (default
+  block 0) so the per-block CRC catches it and that one request falls
+  back to re-prefill, and ``serve:kv_lost:1`` makes the next migration
+  bundle never arrive (the extract verb is swallowed, the router's
+  bundle wait times out, same per-request fallback — ISSUE 17);
+  ``arg`` defaults: burst 8 requests,
+  slow_host/straggler/host_crash rank 0, kv_corrupt block 0. At the
+  ``serve`` site the
   generic ``hang`` action is ALSO rank-targeted and event-armed
   (``serve:hang:1:1`` = host rank 1 stops draining its mailbox but
   keeps the process — and its telemetry heartbeat — alive, the
@@ -95,7 +102,8 @@ __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
 _ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
             "spike", "depart", "return", "burst", "slow_host",
-            "straggler", "host_crash", "drop", "dup", "flap", "die")
+            "straggler", "host_crash", "kv_corrupt", "kv_lost", "drop",
+            "dup", "flap", "die")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
 # grad poison only makes sense where a compiled step consumes the flag
@@ -109,7 +117,8 @@ _RANK_SITES = ("rank",)
 # for them (serving/router.py scheduling tick / host-worker loop);
 # `hang` doubles as a serve event when a rule targets that site (the
 # worker consumes it as "stop draining the mailbox, stay alive")
-_SERVE_ACTIONS = ("burst", "slow_host", "straggler", "host_crash")
+_SERVE_ACTIONS = ("burst", "slow_host", "straggler", "host_crash",
+                  "kv_corrupt", "kv_lost")
 _SERVE_SITES = ("serve",)
 # bus-line faults only make sense where a bus row is being written
 # (observability/bus.py emit — the fleet monitor's cursor prey)

@@ -302,6 +302,23 @@ class TestFaultMatrix:
         assert router.completed["s"]["tokens"] == _sim_chain([7, 7], 4)
         assert router.host_state(0) == "retired"
 
+    def test_drain_cost_boundary_prices_the_move(self, monkeypatch):
+        # ISSUE 17: the drain decision is cost-based, not a bare token
+        # threshold — the same mid-decode request flips from migrate to
+        # in-place when the priced transfer (per-kctx knob) exceeds the
+        # tokens left to decode. 8 left vs cost 3+5*per_kctx/1e3:
+        # per_kctx=1 -> ~3 (move), per_kctx=5000 -> 28 (stay).
+        for per_kctx, want in (("1.0", "migrated"), ("5000.0",
+                                                     "in_place")):
+            monkeypatch.setenv("PADDLE_SERVE_MIGRATE_COST_PER_KCTX",
+                               per_kctx)
+            victim, survivor = _ScriptHost(), _ScriptHost()
+            router = _fast_router([victim, survivor],
+                                  drain_inplace_tokens=2)
+            _submit_phase(router, victim, "mid_decode")
+            summary = router.drain_host(0)
+            assert summary[want] == 1, (per_kctx, summary)
+
 
 class TestHealthStateMachine:
     def test_probation_recovery_no_failover(self):
@@ -604,6 +621,21 @@ class TestServeFaultGrammar:
         inj.fire("epoch")
         assert time.time() - t0 >= 0.01  # still a sleep elsewhere
 
+    def test_kv_fault_grammar_and_arming(self):
+        # ISSUE 17: the two migration faults parse, fire in nth order,
+        # and carry their arg (corrupt: block index; lost: no arg)
+        inj = fi.FaultInjector("serve:kv_corrupt:1:2,serve:kv_lost:2")
+        inj.fire("serve")
+        assert ("kv_corrupt", 2) in inj.serve_events
+        inj.fire("serve")
+        assert ("kv_lost", None) in inj.serve_events
+
+    def test_kv_fault_wrong_site_rejected(self):
+        with pytest.raises(ValueError, match="un-instrumented"):
+            fi.FaultInjector("grad:kv_corrupt:1")
+        with pytest.raises(ValueError, match="un-instrumented"):
+            fi.FaultInjector("mon:kv_lost:1")
+
     def test_sim_chain_resume_property(self):
         prompt = [11, 3, 5]
         full = _sim_chain(prompt, 20)
@@ -678,6 +710,53 @@ class TestFaultObservability:
                                  "migrated": 2, "in_place": 1})
         assert "host 0" in d and "2 migrated" in d
 
+    def test_kv_migrate_fail_names_the_block(self):
+        mon = _load_monitor()
+        d = mon._notable_detail("kv_migrate_fail",
+                                {"rid": "r9", "from_host": 1,
+                                 "reason": "crc", "block": 2,
+                                 "trace_id": "t"})
+        assert "r9" in d and "crc" in d and "block 2" in d
+        assert "re-prefill" in d
+        # a bundle that never arrived names the timeout, no block
+        d2 = mon._notable_detail("kv_migrate_fail",
+                                 {"rid": "r9", "from_host": 1,
+                                  "reason": "timeout"})
+        assert "timeout" in d2 and "block" not in d2
+
+    def test_kv_migrate_fail_folds_into_incident_chain(self, tmp_path):
+        # ISSUE 17: the broken ladder rung is a causal link — death,
+        # the failed migrate (naming the block), then the re-prefill
+        # recovery, all in ONE incident
+        mon = _load_monitor()
+        m = mon.FleetMonitor(str(tmp_path), window_s=5.0)
+        t = time.time()
+        rows = [
+            {"v": 1, "kind": "router_host_dead", "step": 2, "time": t,
+             "rank": 0, "payload": {"host": 0, "host_rank": 0,
+                                    "reason": "unresponsive",
+                                    "inflight": 1}},
+            {"v": 1, "kind": "kv_migrate_fail", "step": 2,
+             "time": t + 0.2, "rank": 0,
+             "payload": {"rid": "rq", "from_host": 0,
+                         "reason": "crc", "block": 3}},
+            {"v": 1, "kind": "router_failover", "step": 2,
+             "time": t + 0.3, "rank": 0,
+             "payload": {"host": 0, "requests": 1, "orphaned": 0}},
+        ]
+        with open(os.path.join(str(tmp_path),
+                               "telemetry.rank0.jsonl"), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        m.poll()
+        closed = m.correlator.flush()
+        assert closed is not None
+        chain = closed["chain"]
+        assert "kv_migrate_fail" in chain and "block 3" in chain
+        assert (chain.index("router_host_dead")
+                < chain.index("kv_migrate_fail")
+                < chain.index("router_failover"))
+
     def test_timeline_failover_slice_and_trace(self, obs_dir):
         timeline = _load_timeline()
         t = time.time()
@@ -709,6 +788,29 @@ class TestFaultObservability:
         # and the summary names the dead host
         summary = "\n".join(timeline.summarize(streams, {}))
         assert "HOST DEAD: host 0" in summary
+
+    def test_timeline_kv_migrate_slice_and_summary(self, obs_dir):
+        # ISSUE 17: a successful migration renders begin->commit as a
+        # duration slice on the request's trace lane; the summary
+        # prices the plane and names every fallback reason
+        timeline = _load_timeline()
+        bus.emit_span("kv_migrate", "tM", {
+            "rid": "r", "from_host": 0, "to_host": 1, "kind": "drain",
+            "blocks": 4, "bytes": 4096, "resumed": 5, "budget_left": 3,
+            "dur_ms": 12.0})
+        bus.emit("kv_migrate_fail", {"rid": "r2", "from_host": 0,
+                                     "reason": "crc", "block": 2,
+                                     "trace_id": "tM"})
+        streams = timeline._load_bus().rank_streams(obs_dir)
+        trace = timeline.chrome_trace(streams, {})
+        slices = [e for e in trace["traceEvents"]
+                  if e.get("ph") == "X" and e["name"] == "kv_migrate"]
+        assert len(slices) == 1
+        assert slices[0]["tid"] == "trace tM"
+        assert abs(slices[0]["dur"] - 12e3) < 1.0
+        summary = "\n".join(timeline.summarize(streams, {}))
+        assert "kv migration: 1 request(s) moved, 4 block(s)" in summary
+        assert "fell back to re-prefill: 1x crc block 2" in summary
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,490 @@
+"""KV block migration plane (ISSUE 17): recompute-free failover,
+drain, and slot-reclaim via paged-block transfer.
+
+Acceptance contracts tested here (the heavy E2E half; the fast units
+live in test_serving_fault.py):
+- router failover and drain complete a MID-DECODE migration with ZERO
+  `PrefillStep` invocations on the fast path, token-identical both to
+  an uninterrupted run and to the round-15 re-prefill path
+  (``PADDLE_SERVE_MIGRATE=0``), on a REAL engine pair;
+- int8/fp8 QuantKV bundles round-trip the wire bit-exact (the narrow
+  payload + scales never convert) and splice only into a pool with the
+  SAME quant policy — a mismatched survivor refuses and the caller
+  degrades;
+- ``retire_slots`` relocates a retiring slot's live request to a low
+  slot through the same plane (extract -> splice -> release) with no
+  prefill work and no cancellation, letting the pool shrink early;
+- injected ``serve:kv_corrupt`` / ``serve:kv_lost`` degrade to the
+  PR-14 re-prefill fallback with zero dropped requests, still
+  token-exact, and the incident chain names the cause (the CRC-failed
+  block / the bundle that never arrived);
+- the launcher-driven multi-process dryrun drains over the mailbox
+  blob transport (extract verb -> ``kv_<rid>.json`` -> splice) with
+  ``router.migrations >= 1`` and a ``kv_extract`` row in the drained
+  worker's telemetry.
+
+This file sorts AFTER test_serving_fault.py on purpose: the compiled
+engine pairs and subprocess dryruns here are the suite's heavy tail.
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.observability import bus
+from paddle_tpu.serving import kv_migration as kvm
+from paddle_tpu.serving.router import (
+    FileHost, LocalHost, Router, sim_next_token,
+)
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _restore_mesh():
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+@pytest.fixture()
+def trivial_mesh():
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    comm._state.hybrid_mesh = None
+    comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+@pytest.fixture()
+def obs_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "obs")
+    os.makedirs(d, exist_ok=True)
+    monkeypatch.setenv("PADDLE_OBS_DIR", d)
+    bus.reset()
+    yield d
+    bus.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PADDLE_FAULT_SPEC", raising=False)
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _tiny_lm(vocab=48, cap=64, layers=2, heads=4, d=32, seed=7):
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import TransformerLM
+
+    paddle.seed(seed)
+    m = TransformerLM(vocab, d_model=d, num_heads=heads,
+                      num_layers=layers, max_position=cap)
+    m.eval()
+    return m
+
+
+def _sim_chain(prompt, n):
+    chain = list(prompt)
+    out = []
+    for _ in range(n):
+        t = sim_next_token(chain)
+        chain.append(t)
+        out.append(t)
+    return out
+
+
+def _fast_router(hosts, **kw):
+    kw.setdefault("host_timeout_ms", 120)
+    kw.setdefault("retry_backoff_ms", 25)
+    kw.setdefault("retry_max", 2)
+    kw.setdefault("avg_new_tokens", 8)
+    return Router(hosts, **kw)
+
+
+def _oracle(model, prompt, budget):
+    from paddle_tpu.serving import InferenceEngine, Request
+
+    eng = InferenceEngine(model, slots=2, max_length=64, sync_every=4)
+    eng.submit(Request(list(prompt), max_new_tokens=budget, rid="u"))
+    return eng.run()["u"].tokens
+
+
+class _HangableLocal(LocalHost):
+    """A LocalHost whose death keeps the ENGINE reachable: the process
+    hangs (heartbeat fresh, service frozen, no decoding) but its device
+    memory — and thus `extract_kv` — survives. This is the failover
+    cell where migration beats re-prefill; a silently-dead host (frozen
+    heartbeat) is skipped by the ladder without burning the timeout."""
+
+    can_fail = True
+
+    def __init__(self, engine):
+        super().__init__(engine)
+        self.dead = False
+        self._t_dead = None
+
+    def die(self):
+        self.dead = True
+        self._t_dead = time.time()
+
+    def pump(self):
+        if self.dead:
+            return False
+        return super().pump()
+
+    def submit(self, req):
+        if self.dead:
+            return
+        super().submit(req)
+
+    def signals(self):
+        if not self.dead:
+            return super().signals()
+        return {"live_t": time.time(), "service_t": self._t_dead,
+                "progress": {}, "results": []}
+
+
+def _mid_decode(router, host, rid, prompt, budget):
+    """Submit one request onto ``host`` and pump it to mid-decode;
+    returns the emitted prefix the router has folded in."""
+    placed = router.submit({"rid": rid, "prompt_ids": list(prompt),
+                            "max_new_tokens": budget})
+    assert placed == 0
+    host.pump()  # prefill + one readback window
+    router.tick()
+    pre = list(router._tracked[rid].progress)
+    assert 0 < len(pre) < budget, "need a mid-decode victim"
+    return pre
+
+
+# ---------------------------------------------------------------------------
+# parity: the fast path is token-identical to the uninterrupted run AND
+# to the re-prefill path, with zero PrefillStep work on the survivor
+# ---------------------------------------------------------------------------
+
+
+class TestMigrationParity:
+    def test_failover_migrate_token_exact_zero_prefill(self,
+                                                       trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine
+
+        m = _tiny_lm()
+        prompt, budget = [4, 5, 6, 7], 12
+        oracle = _oracle(m, prompt, budget)
+        hosts = [
+            _HangableLocal(InferenceEngine(m, slots=2, max_length=64,
+                                           sync_every=4, block_size=8))
+            for _ in range(2)
+        ]
+        router = _fast_router(hosts)
+        pre = _mid_decode(router, hosts[0], "r", prompt, budget)
+        hosts[0].die()
+        deadline = time.time() + 30
+        while "r" not in router.completed and time.time() < deadline:
+            router.tick()
+            hosts[1].pump()
+            time.sleep(0.01)
+        got = router.completed["r"]
+        assert got["host"] == 1
+        assert got["tokens"] == oracle
+        assert got["resumed"] >= len(pre)
+        assert router.migrations == 1 and router.migrate_failed == 0
+        assert router.failovers == 1
+        # THE fast-path pin: the survivor never ran a prefill program —
+        # the request resumed from spliced blocks alone
+        assert hosts[1].engine._prefill._n_steps == 0
+        assert router.migrate_blocks >= 1
+        assert router.migrate_bytes > 0
+
+    def test_failover_reprefill_parity_when_disabled(self, trivial_mesh,
+                                                     monkeypatch):
+        monkeypatch.setenv("PADDLE_SERVE_MIGRATE", "0")
+        from paddle_tpu.serving import InferenceEngine
+
+        m = _tiny_lm()
+        prompt, budget = [4, 5, 6, 7], 12
+        oracle = _oracle(m, prompt, budget)
+        hosts = [
+            _HangableLocal(InferenceEngine(m, slots=2, max_length=64,
+                                           sync_every=4, block_size=8))
+            for _ in range(2)
+        ]
+        router = _fast_router(hosts)
+        _mid_decode(router, hosts[0], "r", prompt, budget)
+        hosts[0].die()
+        deadline = time.time() + 30
+        while "r" not in router.completed and time.time() < deadline:
+            router.tick()
+            hosts[1].pump()
+            time.sleep(0.01)
+        # same tokens, the slow way: the off-switch restores round-15
+        # re-prefill exactly, which is what makes it the safe fallback
+        assert router.completed["r"]["tokens"] == oracle
+        assert router.migrations == 0
+        assert hosts[1].engine._prefill._n_steps >= 1
+
+    def test_drain_migrate_token_exact_zero_prefill(self, trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine
+
+        m = _tiny_lm()
+        prompt, budget = [9, 8, 7], 16
+        oracle = _oracle(m, prompt, budget)
+        hosts = [LocalHost(InferenceEngine(m, slots=2, max_length=64,
+                                           sync_every=4, block_size=8))
+                 for _ in range(2)]
+        router = _fast_router(hosts, drain_inplace_tokens=2)
+        pre = _mid_decode(router, hosts[0], "long", prompt, budget)
+        summary = router.drain_host(0)
+        assert summary == {"host": 0, "migrated": 1, "in_place": 0}
+        assert router.migrations == 1
+        # the drainer's engine released the request (cancel-on-source)
+        assert "long" not in hosts[0].engine.progress()
+        deadline = time.time() + 30
+        while "long" not in router.completed and time.time() < deadline:
+            router.tick()
+            hosts[0].pump()
+            hosts[1].pump()
+            time.sleep(0.01)
+        got = router.completed["long"]
+        assert got["tokens"] == oracle
+        assert got["resumed"] >= len(pre)
+        assert hosts[1].engine._prefill._n_steps == 0
+        assert router.host_state(0) == "retired"
+        assert router.duplicates == 0
+
+
+# ---------------------------------------------------------------------------
+# QuantKV bundles: the narrow form crosses the wire bit-exact and only
+# splices into a pool speaking the same policy
+# ---------------------------------------------------------------------------
+
+
+class TestQuantBundles:
+    @pytest.mark.parametrize("qname", ("int8", "fp8"))
+    def test_quant_bundle_roundtrip_bit_exact(self, trivial_mesh,
+                                              monkeypatch, qname):
+        from paddle_tpu.serving import InferenceEngine, Request
+
+        monkeypatch.setenv("PADDLE_SERVE_KV_QUANT", qname)
+        m = _tiny_lm()
+        prompt, budget = [5, 6, 7], 12
+        oracle = _oracle(m, prompt, budget)
+        src = InferenceEngine(m, slots=2, max_length=64, sync_every=2,
+                              block_size=8)
+        src.submit(Request(list(prompt), max_new_tokens=budget,
+                           rid="q"))
+        results = {}
+        deadline = time.time() + 30
+        while not src.progress().get("q") and time.time() < deadline:
+            src.turn(results)
+        bundle = src.extract_kv("q")
+        assert bundle is not None
+        assert bundle.manifest["quant"] == qname
+        assert bundle.verify() == []
+        rt = kvm.KVBundle.from_wire(bundle.to_wire())
+        assert rt.verify() == []
+        # bit-exact: payload AND scales survive serialization with no
+        # dequantize round trip anywhere
+        for la, lb in zip(bundle.leaves, rt.leaves):
+            for a, b in zip(la, lb):
+                assert a.dtype == b.dtype and a.shape == b.shape
+                assert a.tobytes() == b.tobytes()
+        man = rt.manifest
+        req = Request(list(prompt),
+                      max_new_tokens=man["budget_left"], rid="q",
+                      resume_tokens=list(man["resume"])
+                      + list(man["emitted"]))
+        dst = InferenceEngine(m, slots=2, max_length=64, sync_every=2,
+                              block_size=8)
+        assert dst.insert_migrated(req, rt) is True
+        out = dst.run()
+        assert list(man["emitted"]) + out["q"].tokens == oracle
+        assert dst._prefill._n_steps == 0
+        # a raw-pool survivor refuses the narrow bundle by NAME — the
+        # caller's re-prefill fallback handles it, never a bad splice
+        monkeypatch.delenv("PADDLE_SERVE_KV_QUANT")
+        raw = InferenceEngine(m, slots=2, max_length=64, sync_every=2,
+                              block_size=8)
+        assert raw.insert_migrated(req, rt) is False
+
+
+# ---------------------------------------------------------------------------
+# slot reclaim: retire_slots relocates instead of waiting
+# ---------------------------------------------------------------------------
+
+
+class TestRetireRelocation:
+    def test_retire_slots_relocates_active(self, trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine, Request
+
+        m = _tiny_lm()
+        prompt, budget = [2, 3, 4], 12
+        oracle = _oracle(m, prompt, budget)
+        eng = InferenceEngine(m, slots=4, max_length=64, sync_every=2,
+                              block_size=8)
+        for i in range(4):
+            eng.submit(Request([2, 3, 4], max_new_tokens=budget,
+                               rid=f"r{i}"))
+        results = {}
+        deadline = time.time() + 30
+        while time.time() < deadline and not all(
+                eng.progress().get(f"r{i}") for i in range(4)):
+            eng.turn(results)
+        # leave one live request on a TOP slot, free the low ones
+        top_slot = max(s for s in eng._active)
+        keep = eng._active[top_slot].req.rid
+        for i in range(4):
+            if f"r{i}" != keep:
+                assert eng.cancel(f"r{i}") is True
+        pre_steps = eng._prefill._n_steps
+        pre_tokens = list(eng.progress()[keep])
+        still = eng.retire_slots(2)
+        # the live request moved low, so nothing is left retiring and
+        # the pool shrank immediately instead of waiting for completion
+        assert still == []
+        assert eng.slots == 2
+        new_slot = next(s for s, st in eng._active.items()
+                        if st.req.rid == keep)
+        assert new_slot < top_slot
+        out = eng.run()
+        assert out[keep].tokens == oracle
+        assert out[keep].tokens[: len(pre_tokens)] == pre_tokens
+        # relocation is extract->splice, never a prefill
+        assert eng._prefill._n_steps == pre_steps
+
+
+# ---------------------------------------------------------------------------
+# injected migration faults: every broken rung degrades to re-prefill
+# with zero dropped requests, and the incident chain names the cause
+# ---------------------------------------------------------------------------
+
+
+class TestInjectedKVFaults:
+    def _drain_with_fault(self, spec, monkeypatch):
+        from paddle_tpu.serving import InferenceEngine
+
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", spec)
+        fi.reset()
+        m = _tiny_lm()
+        prompt, budget = [3, 1, 4], 12
+        oracle = _oracle(m, prompt, budget)
+        hosts = [LocalHost(InferenceEngine(m, slots=2, max_length=64,
+                                           sync_every=4, block_size=8))
+                 for _ in range(2)]
+        router = _fast_router(hosts, drain_inplace_tokens=2)
+        _mid_decode(router, hosts[0], "v", prompt, budget)
+        summary = router.drain_host(0)
+        assert summary["migrated"] == 1  # moved — by the SLOW rung
+        deadline = time.time() + 30
+        while "v" not in router.completed and time.time() < deadline:
+            router.tick()
+            hosts[0].pump()
+            hosts[1].pump()
+            time.sleep(0.01)
+        assert router.completed["v"]["tokens"] == oracle
+        assert router.migrations == 0 and router.migrate_failed == 1
+        # the fallback re-prefilled on the survivor — degraded, not
+        # dropped
+        assert hosts[1].engine._prefill._n_steps >= 1
+        return router
+
+    def test_kv_corrupt_falls_back_with_incident(self, trivial_mesh,
+                                                 obs_dir, monkeypatch):
+        import importlib.util
+
+        self._drain_with_fault("serve:kv_corrupt:1:0", monkeypatch)
+        bus.reset()  # flush rows to disk before the monitor reads them
+        spec = importlib.util.spec_from_file_location(
+            "_t_mon_mig", os.path.join(REPO, "paddle_tpu",
+                                       "observability", "monitor.py"))
+        mon = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mon)
+        mm = mon.FleetMonitor(obs_dir, window_s=5.0)
+        mm.poll()
+        closed = mm.correlator.flush()
+        assert closed is not None
+        chain = closed["chain"]
+        assert "kv_migrate_fail" in chain
+        assert "crc" in chain and "block 0" in chain
+        assert "re-prefill" in chain
+
+    def test_kv_lost_falls_back(self, trivial_mesh, monkeypatch):
+        self._drain_with_fault("serve:kv_lost:1", monkeypatch)
+
+
+# ---------------------------------------------------------------------------
+# the launcher-driven dryrun: migration over the mailbox blob transport
+# ---------------------------------------------------------------------------
+
+
+class TestMigrationDryrun:
+    def test_drain_migrates_over_blob_transport(self, tmp_path,
+                                                monkeypatch):
+        from paddle_tpu.distributed.launch import launch
+
+        base = str(tmp_path / "mail")
+        logs = str(tmp_path / "logs")
+        rc_box = {}
+
+        def run():
+            rc_box["rc"] = launch(
+                os.path.join(REPO, "paddle_tpu", "serving",
+                             "router.py"),
+                [REPO, base, "800", "0.02"],
+                nproc_per_node=2, backend="cpu", log_dir=logs)
+
+        t = threading.Thread(target=run)
+        t.start()
+        monkeypatch.setenv("PADDLE_OBS_DIR", logs)
+        bus.reset()
+        hosts = [FileHost(os.path.join(base, f"host{r}"), r,
+                          obs_dir=logs) for r in (0, 1)]
+        router = Router(hosts, admit_queue=32, avg_new_tokens=24,
+                        drain_inplace_tokens=4)
+        prompts = {}
+        for i in range(4):
+            rid = f"g{i}"
+            prompts[rid] = [i + 3, i + 4]
+            router.submit({"rid": rid, "prompt_ids": prompts[rid],
+                           "max_new_tokens": 24})
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            router.tick()
+            if any(e.progress for e in router._tracked.values()
+                   if e.host == 0):
+                break
+            time.sleep(0.02)
+        router.drain_host(0)
+        # the verb round trip happened: extract -> kv_<rid>.json blob
+        # -> CRC-verified splice on the survivor
+        assert router.migrations >= 1
+        while len(router.completed) < 4 and time.time() < deadline:
+            router.tick()
+            time.sleep(0.02)
+        open(os.path.join(base, "stop"), "w").close()
+        t.join(timeout=60)
+        bus.reset()
+        assert rc_box.get("rc") == 0
+        assert len(router.completed) == 4
+        for rid, prompt in prompts.items():
+            assert router.completed[rid]["tokens"] == _sim_chain(
+                prompt, 24), rid
+        assert router.duplicates == 0
+        # the drained worker's telemetry names the hand-off
+        rows = [json.loads(ln) for ln in
+                open(os.path.join(logs, "telemetry.rank0.jsonl"))]
+        assert any(r["kind"] == "kv_extract" for r in rows)
+        # no orphaned bundle blob left in the mailbox
+        outbox = os.path.join(base, "host0", "outbox")
+        if os.path.isdir(outbox):
+            assert not [n for n in os.listdir(outbox)
+                        if n.startswith("kv_")]

@@ -81,6 +81,8 @@ def metric_direction(name: str):
         return None  # observability trend lines (mfu_report), never gated
     if "per_sec" in name:
         return 1
+    if name == "serve_failover_recovery_ms_migrate":
+        return -1  # round-17 migrate twin of the gated _ms key
     if name.endswith("_ms") or name.endswith("_s"):
         return -1
     return None

@@ -482,6 +482,43 @@ def summarize(streams: Dict[int, List[dict]],
                     f"(worker rank {p.get('host_rank')}) — "
                     f"{p.get('migrated')} migrated, "
                     f"{p.get('in_place')} finished in place")
+    # KV block migration (ISSUE 17): recompute-free recoveries vs the
+    # fallback ladder — moves, blocks/bytes on the wire, and why any
+    # rung broke (the kv_migrate span itself renders as a begin->commit
+    # duration slice on the request's trace lane, like every dur_ms
+    # span)
+    mig_n, mig_blocks, mig_bytes = 0, 0, 0
+    mig_ms: List[float] = []
+    mig_fail: Dict[str, int] = {}
+    for rows in streams.values():
+        for r in rows:
+            p = r.get("payload")
+            if not isinstance(p, dict):
+                continue
+            k = r.get("kind")
+            if k == "span" and p.get("name") == "kv_migrate":
+                mig_n += 1
+                mig_blocks += int(p.get("blocks") or 0)
+                mig_bytes += int(p.get("bytes") or 0)
+                if isinstance(p.get("dur_ms"), (int, float)):
+                    mig_ms.append(float(p["dur_ms"]))
+            elif k == "kv_migrate_fail":
+                why = str(p.get("reason") or "?")
+                if why == "crc" and p.get("block") is not None:
+                    why = f"crc block {p.get('block')}"
+                mig_fail[why] = mig_fail.get(why, 0) + 1
+    if mig_n or mig_fail:
+        med = _median(mig_ms)
+        line = (f"kv migration: {mig_n} request(s) moved, "
+                f"{mig_blocks} block(s), "
+                f"{mig_bytes / 1e6:.2f} MB")
+        if med is not None:
+            line += f", median {med:.1f} ms"
+        if mig_fail:
+            why = ", ".join(f"{n}x {w}" for w, n in
+                            sorted(mig_fail.items()))
+            line += f"; fell back to re-prefill: {why}"
+        lines.append(line)
     # co-tenancy controller (ISSUE 16): the lend/reclaim trajectory —
     # committed transitions, aborts, recoveries, and what each cost
     ctl = {"lend": 0, "reclaim": 0, "abort": 0, "recover": 0}
