@@ -992,6 +992,74 @@ def _bench_ctl(waves=8, per_wave=6, budget=8, rate=4000.0):
     return out
 
 
+def _bench_serve_multitenant(prompt_len=128, new_tokens=32, block=16):
+    """Multi-tenant serving plane (ISSUE 18): the submit->first-token
+    time of a borrower whose preamble is already PUBLISHED in the
+    refcounted CoW prefix cache (`serve_gpt_medium_ttft_ms_prefix_warm`,
+    lower-better gated — the shared-prefill saving the cache exists to
+    buy; compare against the cold `serve_gpt_medium_ttft_ms` key), and
+    the decode-tier throughput when every prefill burns on a DEDICATED
+    prefill host and ships across as a KV bundle
+    (`serve_gpt_medium_tokens_per_sec_b8_disagg`, gated — the decode
+    tier's steady cadence with the prefill steal removed).
+    `serve_prefix_hit_rate` and `serve_adapter_count` ride report-only
+    (PERF.md round 18 prices both)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (
+        AdapterSet, InferenceEngine, Request, TransformerLM,
+    )
+    from paddle_tpu.serving.router import LocalHost, PrefillHost, Router
+
+    paddle.seed(0)
+    cap = prompt_len + new_tokens
+    cap += (-cap) % block  # engine pools splice block-aligned
+    model = TransformerLM(32000, d_model=1024, num_heads=16,
+                          num_layers=24, max_position=cap)
+    model.eval()
+    # adapters attach BEFORE any engine: the compiled steps snapshot
+    # the stacked buffers at construction
+    adapters = AdapterSet(model, n_adapters=4, rank=8)
+    adapters.load(1)
+    adapters.load(2)
+    out = {"serve_adapter_count": len(adapters.resident) - 1}
+    prompt = (np.arange(prompt_len) % 31000).astype(np.int32)
+
+    # -- warm-prefix TTFT: cold publishes, the borrower shares --------
+    eng = InferenceEngine(model, slots=2, max_length=cap,
+                          block_size=block, prefix_cache=True)
+    eng.submit(Request(prompt, max_new_tokens=8, rid="cold"))
+    eng.run()
+    eng.submit(Request(prompt, max_new_tokens=8, rid="warm"))
+    warm = eng.run()["warm"]
+    out["serve_gpt_medium_ttft_ms_prefix_warm"] = round(warm.ttft_ms, 2)
+    out["serve_prefix_hit_rate"] = round(eng._prefix_hits / 2.0, 3)
+
+    # -- disaggregated decode-tier throughput: B=8 mixed-adapter ------
+    B = 8
+    decode = LocalHost(InferenceEngine(model, slots=B, max_length=cap,
+                                       block_size=block))
+    prefill = PrefillHost(InferenceEngine(model, slots=2,
+                                          max_length=cap,
+                                          block_size=block))
+    router = Router([decode], prefill_hosts=[prefill],
+                    admit_queue=2 * B, avg_new_tokens=new_tokens)
+    t0 = time.perf_counter()
+    for i in range(B):
+        router.submit({"rid": f"d{i}", "prompt_ids": prompt.tolist(),
+                       "max_new_tokens": new_tokens,
+                       "adapter": i % 3})
+    while len(router.completed) < B:
+        router.tick()
+        decode.pump()
+    dt = time.perf_counter() - t0
+    assert router.disagg_prefills == B, (
+        f"disagg bench: {router.disagg_fallbacks} handoffs fell back "
+        f"to colocated prefill")
+    out["serve_gpt_medium_tokens_per_sec_b8_disagg"] = round(
+        B * new_tokens / dt, 1)
+    return out
+
+
 def _bench_flash_attention(steps=500):
     """Long-context attention: the Pallas flash kernel vs XLA dense at
     S=2048 causal. The `steps` iterations run INSIDE one jitted lax.scan
@@ -1276,6 +1344,17 @@ def main():
         )
         extra.update(ctl_bd)
         extra["ctl_lend_ms_spread"] = ctl_sp
+        # multi-tenant serving plane (ISSUE 18): warm-prefix TTFT and
+        # the disaggregated decode-tier throughput land under the gate
+        # (_ms lower-better / per_sec higher-better); the prefix hit
+        # rate and resident-adapter count ride report-only
+        mt_ms, mt_bd, mt_sp = _repeat(
+            lambda: (lambda d: (
+                d["serve_gpt_medium_ttft_ms_prefix_warm"], d))(
+                _bench_serve_multitenant())
+        )
+        extra.update(mt_bd)
+        extra["serve_gpt_medium_ttft_ms_prefix_warm_spread"] = mt_sp
     # r04 measured the same model/optimizer at batch 64 with two-pass
     # f32-blacklisted batch norm: 41.78 ms / 64 imgs = 1531.7 imgs/sec
     extra["vs_r04_resnet50_bf16"] = round(r50_bf16_ips / 1531.7, 2)

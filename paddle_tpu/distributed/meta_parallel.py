@@ -463,7 +463,7 @@ class ParallelGPTBlock(Layer):
         self.fc2 = RowParallelLinear(ffn, d_model, input_is_parallel=True)
         self.dropout = dropout
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, adapter=None):
         if cache is not None:
             a, new_cache = self.attn(self.ln1(x), cache=cache, pos=pos)
         else:
@@ -476,11 +476,41 @@ class ParallelGPTBlock(Layer):
             self.ln2.weight, self.ln2.bias, self.ln2._epsilon,
             mesh=self.mesh,
         )
-        m = F.gelu(self.fc1(n2))
+        m_in = self.fc1(n2)
+        if adapter is not None and "adapter_A" in self._buffers:
+            # per-slot LoRA delta on the fc1 projection (ISSUE 18
+            # adapter fleets): rows gathered from the resident stacks
+            # by the traced [B] id vector — one program serves every
+            # adapter mix; row 0 is zeros, so id 0 adds exact zeros
+            m_in = m_in + self._adapter_delta(n2, adapter)
+        m = F.gelu(m_in)
         if self.dropout:
             m = F.dropout(m, p=self.dropout, training=self.training)
         out = h + self.fc2(m)
         return out if new_cache is None else (out, new_cache)
+
+    def _adapter_delta(self, x, ids):
+        """``scale * B[a] @ (A[a] @ x)`` with ``a`` the per-row adapter
+        id: two batched low-rank einsums over rows gathered in-graph
+        from the stacked buffers. ``B`` is sharded on the ffn axis like
+        the ``fc1`` weight, so the delta lands feature-sharded exactly
+        where ``fc1``'s output does."""
+        scale = self._adapter_scale
+
+        def d(xr, ar, br, ir):
+            import jax.numpy as jnp
+
+            xf = xr.astype(jnp.float32)
+            a = ar[ir].astype(jnp.float32)   # [B, r, d]
+            b = br[ir].astype(jnp.float32)   # [B, ffn, r]
+            u = jnp.einsum("btd,brd->btr", xf, a)
+            out = jnp.einsum("btr,bfr->btf", u, b)
+            return (scale * out).astype(xr.dtype)
+
+        out = AG.apply(
+            d, (x, self.adapter_A, self.adapter_B, ids),
+            name="adapter_delta")
+        return _constrain(out, self.mesh, P(None, None, "mp"))
 
     def gen_cache(self, batch_size, max_length, dtype=None,
                   block_size=None, pool_blocks=None):

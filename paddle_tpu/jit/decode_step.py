@@ -116,14 +116,18 @@ class DecodeState:
               folds into the in-graph done mask, so heterogeneous
               max_new_tokens never force the host loop below its sync
               cadence (NO_BUDGET = bounded by the host loop only)
+    adapter : [B] int32 — per-slot adapter id (ISSUE 18 fleets; 0 =
+              base model / identity delta). ALWAYS materialized (zeros
+              when no fleet is attached) so the step keeps ONE jit
+              signature regardless of adapter mix
     """
 
     FIELDS = ("caches", "pos", "tok", "done", "key", "temperature",
-              "top_k", "top_p", "eos", "budget")
+              "top_k", "top_p", "eos", "budget", "adapter")
     __slots__ = FIELDS
 
     def __init__(self, caches, pos, tok, done, key, temperature, top_k,
-                 top_p, eos, budget):
+                 top_p, eos, budget, adapter=None):
         self.caches = caches
         self.pos = pos
         self.tok = tok
@@ -134,13 +138,15 @@ class DecodeState:
         self.top_p = top_p
         self.eos = eos
         self.budget = budget
+        self.adapter = (adapter if adapter is not None
+                        else jnp.zeros_like(pos))
 
     def astuple(self):
         return tuple(getattr(self, f) for f in self.FIELDS)
 
     @classmethod
     def make(cls, caches, first_tokens, pos, *, seed=0, temperature=0.0,
-             top_k=0, top_p=1.0, eos_id=None, budget=None):
+             top_k=0, top_p=1.0, eos_id=None, budget=None, adapter=0):
         """Build a fresh state from host values (one-time transfer).
         Scalars broadcast to per-slot [B] vectors. ``budget`` is the
         remaining step count per slot AFTER the first token (None =
@@ -164,6 +170,7 @@ class DecodeState:
             eos=vec(eos, jnp.int32),
             budget=vec(NO_BUDGET if budget is None else budget,
                        jnp.int32),
+            adapter=vec(adapter, jnp.int32),
         )
 
 
@@ -193,6 +200,13 @@ class _CompiledDecodeBase:
                 ):
                     o._data = jax.device_put(o._data, repl)
         self._donate = donate and jax.default_backend() != "cpu"
+        # STATIC at construction (like the model objects themselves):
+        # a model with an AdapterSet attached threads per-slot adapter
+        # ids into its forward; without one the traced program is
+        # byte-identical to the pre-adapter step (the bitwise
+        # off-switch the round-18 acceptance demands)
+        self._use_adapters = (
+            getattr(model, "_serve_adapters", None) is not None)
         self._jitted = None
         self._n_steps = 0
         from ..observability import bus as _bus, ledger as _ledger
@@ -202,29 +216,36 @@ class _CompiledDecodeBase:
 
     # -- the pure forward segment -----------------------------------------
     def _fwd_objs(self, model, p_objs, b_objs, p_raws, b_raws, ids,
-                  cache_raws, pos, label=None):
+                  cache_raws, pos, label=None, adapter=None):
         """A model forward with the KV-cache seam as a pure function of
         (params, buffers, ids, caches, pos) -> (logits, new caches).
         Parameterized over the model so SpeculativeDecodeStep can run
-        the draft AND the target inside one program."""
+        the draft AND the target inside one program. ``adapter`` ([B]
+        int32 per-slot ids) is forwarded only when the model carries an
+        AdapterSet — a bare model's call signature stays untouched."""
         from .. import profiler as _prof
 
         objs = p_objs + b_objs
         caches = _wrap_tree(cache_raws)
+        kw = {}
+        if adapter is not None:
+            kw["adapter"] = Tensor._wrap(adapter)
         with AG.trace_mode(), \
                 _prof.device_annotation(
                     label or f"{self._label}::forward"), \
                 _swapped(objs, list(p_raws) + list(b_raws)):
             out, new_caches = model(
-                Tensor._wrap(ids), cache=caches, pos=Tensor._wrap(pos)
+                Tensor._wrap(ids), cache=caches, pos=Tensor._wrap(pos),
+                **kw
             )
             logits = out._data if isinstance(out, Tensor) else out
             new_raws = _raw_tree(new_caches)
         return logits, new_raws
 
-    def _fwd(self, p_raws, b_raws, ids, cache_raws, pos):
+    def _fwd(self, p_raws, b_raws, ids, cache_raws, pos, adapter=None):
         return self._fwd_objs(self.model, self._p_objs, self._b_objs,
-                              p_raws, b_raws, ids, cache_raws, pos)
+                              p_raws, b_raws, ids, cache_raws, pos,
+                              adapter=adapter)
 
     def _instrumented(self, donate, out_shardings):
         from ..observability import ledger as _ledger
@@ -259,11 +280,12 @@ class DecodeStep(_CompiledDecodeBase):
     _label = "DecodeStep"
 
     def _step_fn(self, p_raws, b_raws, cache_raws, pos, tok, done, key,
-                 temp, top_k, top_p, eos, budget):
+                 temp, top_k, top_p, eos, budget, adapter):
         from ..serving import sampling as _sampling
 
         logits, new_caches = self._fwd(
-            p_raws, b_raws, tok[:, None], cache_raws, pos
+            p_raws, b_raws, tok[:, None], cache_raws, pos,
+            adapter=adapter if self._use_adapters else None,
         )
         last = logits[:, -1, :].astype(jnp.float32)
         key, sub = jax.random.split(key)
@@ -295,7 +317,7 @@ class DecodeStep(_CompiledDecodeBase):
             tuple(b._data for b in self._b_objs),
             state.caches, state.pos, state.tok, state.done, state.key,
             state.temperature, state.top_k, state.top_p, state.eos,
-            state.budget,
+            state.budget, state.adapter,
         )
         if self._jitted is None:
             donate = (2,) if self._donate else ()
@@ -315,7 +337,7 @@ class DecodeStep(_CompiledDecodeBase):
             self._jitted(*args)
         new_state = DecodeState(
             caches, pos, tok, done, key, state.temperature, state.top_k,
-            state.top_p, state.eos, budget,
+            state.top_p, state.eos, budget, state.adapter,
         )
         return emit, logits, new_state
 
@@ -343,10 +365,12 @@ class PrefillStep(_CompiledDecodeBase):
 
     _label = "PrefillStep"
 
-    def _step_fn(self, p_raws, b_raws, cache_raws, ids, length, start):
+    def _step_fn(self, p_raws, b_raws, cache_raws, ids, length, start,
+                 adapter):
         logits, new_caches = self._fwd(
             p_raws, b_raws, ids, cache_raws,
             jnp.asarray(start, jnp.int32),
+            adapter=adapter if self._use_adapters else None,
         )
         idx = jnp.clip(length - 1, 0, ids.shape[1] - 1)
         last = jnp.take_along_axis(
@@ -354,14 +378,17 @@ class PrefillStep(_CompiledDecodeBase):
         )[:, 0, :].astype(jnp.float32)
         return last, new_caches, jnp.asarray(start + length, jnp.int32)
 
-    def __call__(self, caches, ids, lengths, start=None):
+    def __call__(self, caches, ids, lengths, start=None, adapter=None):
         """-> (last_logits [B, V] f32, new cache pytree, pos [B]).
         ``last_logits`` are the logits of the last REAL token of this
-        chunk; ``pos`` = start + lengths (the next write position)."""
+        chunk; ``pos`` = start + lengths (the next write position).
+        ``adapter`` — per-row adapter ids (default all-zeros = base)."""
         cache_raws = _raw_tree(caches)
         ids = jnp.asarray(ids, jnp.int32)
         if start is None:
             start = jnp.zeros((int(ids.shape[0]),), jnp.int32)
+        if adapter is None:
+            adapter = jnp.zeros((int(ids.shape[0]),), jnp.int32)
         args = (
             tuple(p._data for p in self._p_objs),
             tuple(b._data for b in self._b_objs),
@@ -369,6 +396,7 @@ class PrefillStep(_CompiledDecodeBase):
             ids,
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(start, jnp.int32),
+            jnp.asarray(adapter, jnp.int32),
         )
         if self._jitted is None:
             donate = (2,) if self._donate else ()
@@ -414,8 +442,8 @@ class MigrateInsert:
             _ledger.install_backend_listener()
 
     def _step_fn(self, cache_raws, rows, slot, table_row, pos, tok,
-                 done, temp, top_k, top_p, eos, budget, ctx, last_tok,
-                 t_val, k_val, p_val, e_val, b_val):
+                 done, temp, top_k, top_p, eos, budget, adapter, ctx,
+                 last_tok, t_val, k_val, p_val, e_val, b_val, a_val):
         from ..serving import paged_kv as pk
 
         flat, treedef = jax.tree_util.tree_flatten(
@@ -435,6 +463,7 @@ class MigrateInsert:
             top_p.at[slot].set(p_val),
             eos.at[slot].set(e_val),
             budget.at[slot].set(b_val),
+            adapter.at[slot].set(a_val),
         )
 
     @property
@@ -442,8 +471,8 @@ class MigrateInsert:
         return None if self._jitted is None else self._jitted.compiles
 
     def __call__(self, cache_raws, rows, slot, table_row, pos, tok,
-                 done, temp, top_k, top_p, eos, budget, ctx, last_tok,
-                 t_val, k_val, p_val, e_val, b_val):
+                 done, temp, top_k, top_p, eos, budget, adapter, ctx,
+                 last_tok, t_val, k_val, p_val, e_val, b_val, a_val):
         if self._jitted is None:
             from ..observability import ledger as _ledger
 
@@ -453,8 +482,9 @@ class MigrateInsert:
                 label=self._label, donate=donate)
         self._n_steps += 1
         return self._jitted(cache_raws, rows, slot, table_row, pos, tok,
-                            done, temp, top_k, top_p, eos, budget, ctx,
-                            last_tok, t_val, k_val, p_val, e_val, b_val)
+                            done, temp, top_k, top_p, eos, budget,
+                            adapter, ctx, last_tok, t_val, k_val, p_val,
+                            e_val, b_val, a_val)
 
 
 # ---------------------------------------------------------------------------

@@ -182,7 +182,9 @@ class DecodeMetricsSampler:
     def window(self, *, steps: int, tokens: int, wall_s: float,
                inflight: int, queue_depth: int, ttft_ms=None,
                blocks_in_use=None, blocks_total=None, blocks_freed=None,
-               admit_deferred=None) -> None:
+               admit_deferred=None, prefix_hits=None,
+               prefix_blocks_shared=None, cow_copies=None,
+               adapters_resident=None) -> None:
         if not self.enabled or not bus.enabled():
             return
         self._windows += 1
@@ -207,6 +209,16 @@ class DecodeMetricsSampler:
             payload["blocks_freed"] = int(blocks_freed or 0)
         if admit_deferred:
             payload["admit_deferred"] = int(admit_deferred)
+        # round-18 multi-tenant gauges — cumulative host counters the
+        # engine already holds at its readback (None = feature off, the
+        # key is omitted so pre-18 rows stay byte-identical)
+        if prefix_hits is not None:
+            payload["prefix_hits"] = int(prefix_hits)
+            payload["prefix_blocks_shared"] = int(
+                prefix_blocks_shared or 0)
+            payload["cow_copies"] = int(cow_copies or 0)
+        if adapters_resident is not None:
+            payload["adapters_resident"] = int(adapters_resident)
         bus.emit("decode_metrics", payload, step=self._windows)
 
     def request_done(self, *, rid, tokens: int, latency_ms: float,

@@ -41,17 +41,38 @@ live drain (`Router.drain_host` + the `drain`/`cancel` mailbox verbs),
 and reasoned load shedding against the surviving fleet; the engine
 grows the host-side seam it rides (`InferenceEngine.turn` /
 `progress` / `cancel`).
+
+Round 18 (ISSUE 18) makes the plane multi-tenant:
+
+- `prefix_cache` — a refcounted copy-on-write prefix index over the
+  paged pool: published prompt blocks become immutable content-hashed
+  entries, sharing requests take them by table reference and prefill
+  only the unshared tail (`PADDLE_SERVE_PREFIX_CACHE`);
+- `adapters` — `AdapterSet` fleets of low-rank fine-tunes resident
+  beside the base weights, applied in-graph per slot by a traced
+  adapter-id vector (one compiled step for the whole fleet; adapter
+  0 is the base model bit-for-bit);
+- `router` disaggregation — `PrefillHost`/`FilePrefillHost` run only
+  the prefill phase and ship the context as a CRC-gated
+  `kv_migration.KVBundle` to a decode host picked by slot
+  availability (`PADDLE_SERVE_DISAGG`, `PADDLE_SERVE_ROLE`), falling
+  back to colocated admission on any broken rung.
 """
 from . import paged_kv  # noqa: F401
 from . import sampling  # noqa: F401
+from .adapters import AdapterSet  # noqa: F401
 from .engine import (  # noqa: F401
     GeneratedResult, GenerationConfig, InferenceEngine, Request, generate,
 )
 from .model import TransformerLM  # noqa: F401
-from .router import FileHost, LocalHost, Router  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
+from .router import (  # noqa: F401
+    FileHost, FilePrefillHost, LocalHost, PrefillHost, Router,
+)
 
 __all__ = [
     "sampling", "TransformerLM", "generate", "GenerationConfig",
     "Request", "InferenceEngine", "GeneratedResult", "paged_kv",
-    "Router", "LocalHost", "FileHost",
+    "Router", "LocalHost", "FileHost", "PrefillHost", "FilePrefillHost",
+    "PrefixCache", "AdapterSet",
 ]

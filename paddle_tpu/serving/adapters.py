@@ -1,0 +1,198 @@
+"""Adapter fleets: per-slot LoRA-style deltas resident beside the base
+model (ISSUE 18 tentpole, pillar 3).
+
+One engine, one compiled step, many fine-tunes: an :class:`AdapterSet`
+attaches a stacked pair of low-rank buffers to every
+``ParallelGPTBlock`` — ``adapter_A`` ``[n_adapters, r, d_model]``
+(replicated) and ``adapter_B`` ``[n_adapters, ffn, r]`` (sharded
+``P(None, 'mp', None)``, the same feature-axis split as the ``fc1``
+weight it perturbs) — and the block's MLP becomes
+
+    ``fc1(x) + scale * B[a] @ (A[a] @ x)``
+
+with ``a`` the slot's int32 adapter id, gathered IN-GRAPH from the
+stack. Row 0 is pinned to zeros, so adapter id 0 is the base model
+bit-for-bit; and because the ids ride :class:`jit.DecodeState` as a
+traced ``[B]`` vector, a batch mixing ten different fine-tunes runs
+the SAME compiled program as a homogeneous one (the
+ledger-asserted compiles-once contract).
+
+Loading a fine-tune is an eager row write into the resident stacks —
+no recompile, no engine restart: the compiled steps snapshot the
+buffer *objects* at construction and re-read ``_data`` every call.
+Attach the set BEFORE building the engine (or any ``*Step``) so the
+buffers ride the step's snapshot; the engine admission path rejects a
+``Request.adapter`` id that is not loaded.
+
+Env knobs (documented in README): ``PADDLE_SERVE_ADAPTERS`` (fleet
+size when the ctor is not given one; 0 = no fleet unless explicitly
+constructed), ``PADDLE_SERVE_ADAPTER_RANK`` (low-rank r, default 8),
+``PADDLE_SERVE_ADAPTER_SCALE`` (delta scale, default 1.0).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["AdapterSet", "adapters_default", "adapter_rank_default",
+           "adapter_scale_default"]
+
+_COUNT_ENV = "PADDLE_SERVE_ADAPTERS"
+_RANK_ENV = "PADDLE_SERVE_ADAPTER_RANK"
+_SCALE_ENV = "PADDLE_SERVE_ADAPTER_SCALE"
+
+
+def adapters_default() -> int:
+    """``PADDLE_SERVE_ADAPTERS`` — resident fleet size (0 = off)."""
+    try:
+        return max(int(os.environ.get(_COUNT_ENV, "0")), 0)
+    except ValueError:
+        return 0
+
+
+def adapter_rank_default() -> int:
+    """``PADDLE_SERVE_ADAPTER_RANK`` — low-rank r (default 8)."""
+    try:
+        return max(int(os.environ.get(_RANK_ENV, "8")), 1)
+    except ValueError:
+        return 8
+
+
+def adapter_scale_default() -> float:
+    """``PADDLE_SERVE_ADAPTER_SCALE`` — delta scale (default 1.0)."""
+    try:
+        return float(os.environ.get(_SCALE_ENV, "1.0"))
+    except ValueError:
+        return 1.0
+
+
+class AdapterSet:
+    """Stacked low-rank adapter fleet over a ``TransformerLM``-shaped
+    model (anything exposing ``.blocks`` of ``ParallelGPTBlock``s).
+
+    Construct BEFORE the engine / compiled steps::
+
+        adapters = AdapterSet(model, n_adapters=8, rank=4)
+        adapters.load(1, seed=11)          # random fine-tune
+        adapters.load(2, a_mats=..., b_mats=...)  # explicit weights
+        eng = InferenceEngine(model, ...)
+        eng.submit(Request(ids, adapter=1))
+    """
+
+    def __init__(self, model, n_adapters: Optional[int] = None,
+                 rank: Optional[int] = None,
+                 scale: Optional[float] = None, dtype="float32"):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..core.tensor import Tensor
+
+        env_n = adapters_default()
+        n = int(n_adapters) if n_adapters is not None else (env_n or 8)
+        if n < 2:
+            raise ValueError(
+                f"AdapterSet needs n_adapters >= 2 (row 0 is the "
+                f"reserved base/identity row; got {n})")
+        self.n_adapters = n
+        self.rank = int(rank) if rank is not None \
+            else adapter_rank_default()
+        self.scale = float(scale) if scale is not None \
+            else adapter_scale_default()
+        self.dtype = dtype
+        self._loaded = {0}
+        #: host-side copies of each loaded fine-tune's matrices, per
+        #: block: aid -> list of (A_rows [r, d], B_rows [ffn, r]) —
+        #: the dense-reference oracle tests compare against
+        self.weights: Dict[int, List] = {}
+        self.blocks = list(model.blocks)
+        for blk in self.blocks:
+            d = int(blk._d_model)
+            ffn = int(blk.fc1._out)
+            mesh = blk.mesh
+            a = Tensor._wrap(jnp.zeros((n, self.rank, d), dtype))
+            b = Tensor._wrap(jnp.zeros((n, ffn, self.rank), dtype))
+            a._data = jax.device_put(a._data, NamedSharding(mesh, P()))
+            b._data = jax.device_put(
+                b._data, NamedSharding(mesh, P(None, "mp", None)))
+            blk.register_buffer("adapter_A", a)
+            blk.register_buffer("adapter_B", b)
+            blk._adapter_scale = self.scale
+        model._serve_adapters = self
+
+    # -- fleet management --------------------------------------------
+
+    @property
+    def resident(self) -> List[int]:
+        return sorted(self._loaded)
+
+    def is_loaded(self, aid: int) -> bool:
+        return int(aid) in self._loaded
+
+    def _check_id(self, aid: int) -> int:
+        aid = int(aid)
+        if not 1 <= aid < self.n_adapters:
+            raise ValueError(
+                f"adapter id {aid} out of range 1..{self.n_adapters - 1} "
+                f"(0 is the reserved base row)")
+        return aid
+
+    def load(self, aid: int, *, seed: Optional[int] = None,
+             a_mats=None, b_mats=None) -> None:
+        """Write one fine-tune's rows into the resident stacks — an
+        eager per-block ``at[aid].set`` on the SAME buffer arrays the
+        compiled steps read, so the next step call serves the new
+        adapter with zero recompiles. Either explicit per-block
+        ``a_mats``/``b_mats`` lists or a ``seed`` for a small random
+        delta (test fleets)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        aid = self._check_id(aid)
+        if a_mats is None:
+            rng = np.random.RandomState(
+                (17 + aid) if seed is None else int(seed))
+            a_mats, b_mats = [], []
+            for blk in self.blocks:
+                d = int(blk._d_model)
+                ffn = int(blk.fc1._out)
+                a_mats.append(rng.normal(
+                    0.0, 1.0 / np.sqrt(d),
+                    (self.rank, d)).astype(np.float32))
+                b_mats.append(rng.normal(
+                    0.0, 1.0 / np.sqrt(self.rank),
+                    (ffn, self.rank)).astype(np.float32))
+        if len(a_mats) != len(self.blocks) \
+                or len(b_mats) != len(self.blocks):
+            raise ValueError(
+                f"adapter {aid}: want one (A, B) pair per block "
+                f"({len(self.blocks)}), got {len(a_mats)}/{len(b_mats)}")
+        for blk, a_rows, b_rows in zip(self.blocks, a_mats, b_mats):
+            for buf, rows in ((blk.adapter_A, a_rows),
+                              (blk.adapter_B, b_rows)):
+                sh = buf._data.sharding
+                buf._data = jax.device_put(
+                    buf._data.at[aid].set(
+                        jnp.asarray(rows, buf._data.dtype)), sh)
+        self._loaded.add(aid)
+        self.weights[aid] = [
+            (np.asarray(a), np.asarray(b))
+            for a, b in zip(a_mats, b_mats)]
+
+    def unload(self, aid: int) -> None:
+        """Zero the rows and drop residency (admission rejects the id
+        afterwards — the ``adapter_missing`` fault's clean-reject
+        contract)."""
+        import jax
+        import jax.numpy as jnp
+
+        aid = self._check_id(aid)
+        for blk in self.blocks:
+            for buf in (blk.adapter_A, blk.adapter_B):
+                sh = buf._data.sharding
+                buf._data = jax.device_put(
+                    buf._data.at[aid].set(
+                        jnp.zeros_like(buf._data[aid])), sh)
+        self._loaded.discard(aid)
+        self.weights.pop(aid, None)

@@ -38,6 +38,18 @@ Round 13 grows the engine into the production tier:
 - **TTFT accounting**: submit -> first-token latency per request,
   riding the existing readback cadence onto `decode_metrics`.
 
+Round 18 (multi-tenant serving): a paged engine can attach a
+`serving.prefix_cache.PrefixCache` (``PADDLE_SERVE_PREFIX_CACHE=1`` or
+the ``prefix_cache`` ctor arg) — published prompt blocks are shared by
+table reference, admission charges only the UNSHARED block demand, the
+borrower prefills just the tail (prefix K/V materialized into the
+scratch by the compiled ``PrefixFetch`` gather first, so the tail's
+attention sees real history), and the splice is the copy-on-write
+``paged_splice_tail`` form of CacheInsert. A `serving.adapters
+.AdapterSet` attached to the model BEFORE the engine threads per-slot
+adapter ids through every insert path and the decode state, so one
+compiled step serves a whole fine-tune fleet.
+
 Env knobs (documented in README):
   ``PADDLE_SERVE_SYNC_EVERY``    decode steps per engine readback (16)
   ``PADDLE_SERVE_BUCKETS``       prefill length buckets ("16,32,64,128,
@@ -45,6 +57,8 @@ Env knobs (documented in README):
   ``PADDLE_SERVE_BLOCK_SIZE``    KV block size; 0 = contiguous cache
   ``PADDLE_SERVE_PREFILL_CHUNK`` prefill chunk length; 0 = whole-prompt
   ``PADDLE_SERVE_SPEC_K``        draft tokens per speculative round (4)
+  ``PADDLE_SERVE_PREFIX_CACHE``  1 = refcounted CoW prefix cache (0)
+  ``PADDLE_SERVE_PREFIX_BLOCKS`` max prefix-cache entries (0 = pool)
 """
 from __future__ import annotations
 
@@ -65,6 +79,7 @@ from ..jit.decode_step import (
 )
 from . import paged_kv as pk
 from . import sampling
+from .prefix_cache import PrefixCache, prefix_cache_enabled
 
 __all__ = ["GenerationConfig", "generate", "Request", "GeneratedResult",
            "InferenceEngine", "prefill_buckets", "bucket_for",
@@ -329,11 +344,16 @@ class Request:
     already decremented ``max_new_tokens`` by the resumed count), so a
     greedy request continues TOKEN-EXACTLY where the dead host stopped.
     The engine's result holds only the NEW tokens; the router owns the
-    prefix reassembly."""
+    prefix reassembly.
+
+    ``adapter`` (ISSUE 18) names the fine-tune serving this request —
+    a row of the engine model's resident :class:`serving.adapters
+    .AdapterSet`; 0 (default) is the base model. Admission rejects ids
+    that are not loaded."""
 
     def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                  top_k=0, top_p=1.0, eos_id=None, rid=None,
-                 trace_id=None, resume_tokens=None):
+                 trace_id=None, resume_tokens=None, adapter=0):
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.resume_tokens = (
             np.asarray([], np.int32) if resume_tokens is None
@@ -343,6 +363,7 @@ class Request:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.eos_id = -1 if eos_id is None else int(eos_id)
+        self.adapter = int(adapter)
         self.rid = next(_rid_counter) if rid is None else rid
         #: request-scoped trace id (ISSUE 14): Router.submit stamps one
         #: so the engine's admission/prefill/decode-window/retire span
@@ -437,7 +458,8 @@ class InferenceEngine:
 
     def __init__(self, model, *, slots=4, max_length=256,
                  sync_every=None, seed=0, block_size=None,
-                 pool_blocks=None, prefill_chunk=None):
+                 pool_blocks=None, prefill_chunk=None,
+                 prefix_cache=None):
         model.eval()
         self.model = model
         self.slots = int(slots)
@@ -453,6 +475,15 @@ class InferenceEngine:
         self._decode = DecodeStep(model)
         self._insert_jitted = None
         self._migrate = None  # lazy jit.MigrateInsert (ISSUE 17)
+        #: resident fine-tune fleet, if the model carries one (attach
+        #: the AdapterSet BEFORE building the engine — the compiled
+        #: steps snapshot the buffers at construction)
+        self.adapters = getattr(model, "_serve_adapters", None)
+        self._prefix_fetch_jitted = None
+        self._prefix_insert_jitted = None
+        self._prefix_hits = 0
+        self._prefix_blocks_shared = 0
+        self._cow_copies = 0
         self._queue: deque = deque()
         self._active: Dict[int, _Slot] = {}
         self._pending: Dict[int, _Pending] = {}
@@ -490,6 +521,14 @@ class InferenceEngine:
         else:
             caches = model.gen_cache(self.slots, self.max_length,
                                      block_size=0)
+        # refcounted CoW prefix cache (ISSUE 18): explicit ctor arg
+        # wins; the env knob defaults OFF so round-17 admission stays
+        # bitwise. Needs the paged pool (the share unit is a block).
+        use_px = (prefix_cache if prefix_cache is not None
+                  else prefix_cache_enabled())
+        self._prefix: Optional[PrefixCache] = (
+            PrefixCache(self.block_size)
+            if use_px and self._pool is not None else None)
         self._state = DecodeState.make(
             caches, first_tokens=np.zeros(self.slots, np.int32),
             pos=np.zeros(self.slots, np.int32), seed=seed)
@@ -572,7 +611,8 @@ class InferenceEngine:
             caches, pad0(st.pos, n), pad0(st.tok, n),
             pad0(st.done, n, True), st.key, pad0(st.temperature, n),
             pad0(st.top_k, n), pad0(st.top_p, n, 1),
-            pad0(st.eos, n, -1), pad0(st.budget, n, NO_BUDGET))
+            pad0(st.eos, n, -1), pad0(st.budget, n, NO_BUDGET),
+            pad0(st.adapter, n))
         from ..jit.decode_step import _commit_tree
 
         self._state = DecodeState(*_commit_tree(self._state.astuple()))
@@ -622,6 +662,11 @@ class InferenceEngine:
             # live low slots never reference the withdrawn ids: shrink
             # only surrenders FREE top-of-id-space blocks, and retired
             # slots' table rows were redirected to trash at release
+            if self._prefix is not None:
+                # idle index entries pinning top-of-id-space blocks
+                # would deadlock the withdrawal — evict them first
+                self._prefix.evict_above(
+                    self._pool, self._pool.total - cut * self._nmax)
             self._pool.shrink(cut * self._nmax)
             P = self._pool.total + 1
 
@@ -645,7 +690,7 @@ class InferenceEngine:
         self._state = DecodeState(
             caches, st.pos[:new], st.tok[:new], st.done[:new], st.key,
             st.temperature[:new], st.top_k[:new], st.top_p[:new],
-            st.eos[:new], st.budget[:new])
+            st.eos[:new], st.budget[:new], st.adapter[:new])
         from ..jit.decode_step import _commit_tree
 
         self._state = DecodeState(*_commit_tree(self._state.astuple()))
@@ -754,6 +799,7 @@ class InferenceEngine:
             "budget_left": budget_left,
             "block_size": self.block_size, "n_blocks": n_used,
             "quant": self._quant_name(),
+            "adapter": int(getattr(req, "adapter", 0)),
         }, leaves).seal()
         self._metrics.span(
             "kv_extract", trace_id=req.trace_id, rid=rid, slot=slot,
@@ -779,6 +825,10 @@ class InferenceEngine:
                 or budget_left < 1
                 or ctx + budget_left > self.max_length):
             return False
+        aid = int(man.get("adapter", 0))
+        if aid and (self.adapters is None
+                    or not self.adapters.is_loaded(aid)):
+            return False  # this engine can't serve the fine-tune
         n_pool_leaves = sum(
             1 for leaf in jax.tree_util.tree_leaves(
                 self._state.caches,
@@ -839,21 +889,22 @@ class InferenceEngine:
         if self._migrate is None:
             self._migrate = MigrateInsert()
         st = self._state
-        (caches, pos, tok, done, temp, top_k, top_p, eos, budget) = \
-            self._migrate(
-                st.caches, rows, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(row),
-                st.pos, st.tok, st.done, st.temperature, st.top_k,
-                st.top_p, st.eos, st.budget,
-                jnp.asarray(int(man["ctx"]), jnp.int32),
-                jnp.asarray(int(man["last_tok"]), jnp.int32),
-                jnp.asarray(float(man["temperature"]), jnp.float32),
-                jnp.asarray(int(man["top_k"]), jnp.int32),
-                jnp.asarray(float(man["top_p"]), jnp.float32),
-                jnp.asarray(int(man["eos_id"]), jnp.int32),
-                jnp.asarray(int(man["budget_left"]), jnp.int32))
+        (caches, pos, tok, done, temp, top_k, top_p, eos, budget,
+         adapter) = self._migrate(
+            st.caches, rows, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(row),
+            st.pos, st.tok, st.done, st.temperature, st.top_k,
+            st.top_p, st.eos, st.budget, st.adapter,
+            jnp.asarray(int(man["ctx"]), jnp.int32),
+            jnp.asarray(int(man["last_tok"]), jnp.int32),
+            jnp.asarray(float(man["temperature"]), jnp.float32),
+            jnp.asarray(int(man["top_k"]), jnp.int32),
+            jnp.asarray(float(man["top_p"]), jnp.float32),
+            jnp.asarray(int(man["eos_id"]), jnp.int32),
+            jnp.asarray(int(man["budget_left"]), jnp.int32),
+            jnp.asarray(int(man.get("adapter", 0)), jnp.int32))
         self._state = DecodeState(caches, pos, tok, done, st.key, temp,
-                                  top_k, top_p, eos, budget)
+                                  top_k, top_p, eos, budget, adapter)
 
     def _relocate_retiring(self) -> None:
         """Move ACTIVE requests off retiring top slots into free low
@@ -916,6 +967,14 @@ class InferenceEngine:
                 f"request {req.rid} needs {self.needed_blocks(req)} KV "
                 f"blocks but the pool only has {self._pool.total} — it "
                 f"can never be admitted")
+        aid = int(getattr(req, "adapter", 0))
+        if aid and (self.adapters is None
+                    or not self.adapters.is_loaded(aid)):
+            raise ValueError(
+                f"request {req.rid} names adapter {aid} but "
+                + ("no AdapterSet is attached to this engine's model"
+                   if self.adapters is None else
+                   f"only {self.adapters.resident} are resident"))
         req.t_submit = time.perf_counter()
         self._queue.append(req)
 
@@ -986,7 +1045,15 @@ class InferenceEngine:
                           else self._pool.total),
             blocks_freed=(None if self._pool is None
                           else self._pool.freed_total),
-            admit_deferred=self._admit_deferred)
+            admit_deferred=self._admit_deferred,
+            prefix_hits=(None if self._prefix is None
+                         else self._prefix_hits),
+            prefix_blocks_shared=(None if self._prefix is None
+                                  else self._prefix_blocks_shared),
+            cow_copies=(None if self._prefix is None
+                        else self._cow_copies),
+            adapters_resident=(None if self.adapters is None
+                               else len(self.adapters.resident)))
         return bool(self._queue or self._active or self._pending)
 
     # -- internals ---------------------------------------------------------
@@ -1024,7 +1091,8 @@ class InferenceEngine:
                 job.consumed: job.consumed + take]
             last, job.raws, _ = self._prefill(
                 job.raws, chunk, np.asarray([take], np.int32),
-                start=np.asarray([job.consumed], np.int32))
+                start=np.asarray([job.consumed], np.int32),
+                adapter=np.asarray([job.req.adapter], np.int32))
             job.consumed += take
             job.prefill_s += time.perf_counter() - t0
             self._metrics.span(
@@ -1051,8 +1119,22 @@ class InferenceEngine:
                 break
             req = self._queue[0]
             blocks = None
+            share = None
             if self._pool is not None:
-                blocks = self._pool.alloc(self.needed_blocks(req))
+                # prefix-cache admission (ISSUE 18): a matched prefix
+                # is taken by table reference, so the pool is charged
+                # only the UNSHARED block demand; when even that can't
+                # be covered, idle cached entries are evicted before
+                # the request defers
+                if self._prefix is not None:
+                    share = self._prefix.lookup(req.prefill_ids)
+                need = self.needed_blocks(req)
+                fresh_need = need - (0 if share is None
+                                     else len(share.ref_blocks))
+                blocks = self._pool.alloc(fresh_need)
+                if blocks is None and self._prefix is not None:
+                    self._prefix.evict_for(self._pool, fresh_need)
+                    blocks = self._pool.alloc(fresh_need)
                 if blocks is None:
                     # pool can't cover the head request: DEFER admission
                     # (blocks come back when inflight work retires) —
@@ -1067,6 +1149,9 @@ class InferenceEngine:
                 queue_wait_ms=(
                     round((time.perf_counter() - req.t_submit) * 1e3, 3)
                     if req.t_submit is not None else None))
+            if share is not None:
+                self._admit_shared(slot, req, share, blocks, results)
+                continue
             L = req.prefill_ids.size
             if self.prefill_chunk > 0 and L > self.prefill_chunk:
                 self._pending[slot] = _Pending(
@@ -1076,8 +1161,9 @@ class InferenceEngine:
             t0 = time.perf_counter()
             bucket = bucket_for(L, self.max_length)
             ids, lens = _pad_prompts([req.prefill_ids], bucket)
-            last, slot_raws, _ = self._prefill(self._slot_cache(), ids,
-                                               lens)
+            last, slot_raws, _ = self._prefill(
+                self._slot_cache(), ids, lens,
+                adapter=np.asarray([req.adapter], np.int32))
             self._activate(slot, req, slot_raws, last, blocks=blocks,
                            t_enq=t0,
                            prefill_ms=(time.perf_counter() - t0) * 1e3,
@@ -1090,6 +1176,73 @@ class InferenceEngine:
         pool, and either park the request in its slot or (degenerate:
         eos/1-token budget) finish it immediately."""
         first = self._insert(slot, req, slot_raws, last, blocks)
+        if self._prefix is not None and blocks is not None:
+            # index the freshly prefilled prompt's full blocks BEFORE
+            # any degenerate release — the index's own references keep
+            # them resident for the next borrower either way
+            self._prefix.publish(self._pool, req.prefill_ids, blocks)
+        self._park_or_finish(slot, req, first, blocks, t_enq,
+                             prefill_ms, results)
+
+    def _admit_shared(self, slot, req, share, fresh, results) -> None:
+        """Admit a request over a prefix-cache hit (ISSUE 18): take the
+        matched blocks by table reference, materialize them into the
+        batch-1 scratch (``PrefixFetch`` — the tail's attention needs
+        the real prefix K/V), prefill ONLY the unshared tail in one
+        shot, and splice with `paged_kv.paged_splice_tail` — which
+        copies the one colliding shared block copy-on-write first when
+        the match covered the whole prompt."""
+        t0 = time.perf_counter()
+        self._pool.ref(share.ref_blocks)
+        cow = share.cow_src is not None
+        table = list(share.ref_blocks) + list(fresh)
+        cow_src = share.cow_src if cow else 0
+        cow_dst = fresh[0] if cow else 0  # 0,0 = trash self-copy
+        row = np.zeros((self._nmax,), np.int32)
+        row[: len(table)] = table
+        row_j = jnp.asarray(row)
+        # the fetch reads the SOURCE chain (share.src_blocks) — the
+        # slot's table row is NOT it: on a full-prefix match its last
+        # shared logical block points at the private cow_dst, which
+        # holds garbage until the splice runs
+        srow = np.zeros((self._nmax,), np.int32)
+        srow[: len(share.src_blocks)] = share.src_blocks
+        raws = self._prefix_fetch(self._slot_cache(),
+                                  jnp.asarray(srow))
+        L = req.prefill_ids.size
+        tail_start = int(share.tail_start)
+        tail_len = L - tail_start
+        # the tail window writes start..start+W-1 and W must keep the
+        # write INSIDE the cache — dynamic_update_slice would clamp an
+        # overrunning start and silently trash prefix rows the same
+        # call's attention reads. bucket_for against the REMAINING
+        # capacity picks the smallest bucket that fits (or exactly the
+        # remainder), so the tail always prefills in ONE shot.
+        W = bucket_for(tail_len, self.max_length - tail_start)
+        ids = np.zeros((1, W), np.int32)
+        ids[0, :tail_len] = req.prefill_ids[tail_start:]
+        last, raws, _ = self._prefill(
+            raws, ids, np.asarray([tail_len], np.int32),
+            start=np.asarray([tail_start], np.int32),
+            adapter=np.asarray([req.adapter], np.int32))
+        first = self._prefix_insert(slot, req, raws, last, row_j,
+                                    tail_start, L, cow_src, cow_dst)
+        self._prefix_hits += 1
+        self._prefix_blocks_shared += len(share.ref_blocks)
+        if cow:
+            self._cow_copies += 1
+        self._metrics.span(
+            "prefix_hit", trace_id=req.trace_id, rid=req.rid,
+            slot=slot, shared_blocks=len(share.ref_blocks),
+            cow=int(cow), tail_tokens=tail_len)
+        # publishing after the splice touches the already-indexed chain
+        # (LRU) and indexes any extra full blocks the tail introduced
+        self._prefix.publish(self._pool, req.prefill_ids, table)
+        self._park_or_finish(slot, req, first, table, t0,
+                             (time.perf_counter() - t0) * 1e3, results)
+
+    def _park_or_finish(self, slot, req, first, blocks, t_enq,
+                        prefill_ms, results) -> None:
         now = time.perf_counter()
         ttft_ms = ((now - req.t_submit) * 1e3
                    if req.t_submit is not None else prefill_ms)
@@ -1152,22 +1305,90 @@ class InferenceEngine:
             row = np.zeros((self._nmax,), np.int32)
             row[: len(blocks)] = blocks  # trash-padded past allocation
             extra = (jnp.asarray(row),)
-        (caches, pos, tok, done, temp, top_k, top_p, eos, budget) = \
-            self._insert_jitted(
-                st.caches, slot_raws, jnp.asarray(slot, jnp.int32),
-                *extra,
-                st.pos, st.tok, st.done, st.temperature, st.top_k,
-                st.top_p, st.eos, st.budget,
-                jnp.asarray(L, jnp.int32),
-                first[0],
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_k, jnp.int32),
-                jnp.asarray(req.top_p, jnp.float32),
-                jnp.asarray(req.eos_id, jnp.int32),
-                jnp.asarray(req.max_new_tokens - 1, jnp.int32))
+        (caches, pos, tok, done, temp, top_k, top_p, eos, budget,
+         adapter) = self._insert_jitted(
+            st.caches, slot_raws, jnp.asarray(slot, jnp.int32),
+            *extra,
+            st.pos, st.tok, st.done, st.temperature, st.top_k,
+            st.top_p, st.eos, st.budget, st.adapter,
+            jnp.asarray(L, jnp.int32),
+            first[0],
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32),
+            jnp.asarray(req.eos_id, jnp.int32),
+            jnp.asarray(req.max_new_tokens - 1, jnp.int32),
+            jnp.asarray(req.adapter, jnp.int32))
         self._state = DecodeState(caches, pos, tok, done, st.key, temp,
-                                  top_k, top_p, eos, budget)
+                                  top_k, top_p, eos, budget, adapter)
         return int(np.asarray(first)[0])
+
+    def _prefix_fetch(self, scratch, table_row):
+        """Materialize the shared-prefix blocks named by ``table_row``
+        into a contiguous batch-1 scratch (compiled gather, ledger
+        label ``PrefixFetch``). The POOL is never donated — other
+        slots are decoding out of it; only the scratch is consumed."""
+        from ..jit.decode_step import _raw_tree
+
+        raws = _raw_tree(scratch)
+        if self._prefix_fetch_jitted is None:
+            from ..observability import ledger as _ledger
+
+            donate = (1,) if jax.default_backend() != "cpu" else ()
+            self._prefix_fetch_jitted = _ledger.instrument(
+                jax.jit(_prefix_fetch_fn, donate_argnums=donate),
+                label="PrefixFetch", donate=donate)
+        return self._prefix_fetch_jitted(self._state.caches, raws,
+                                         table_row)
+
+    def _prefix_insert(self, slot, req, slot_raws, last, table_row,
+                       start, length, cow_src, cow_dst) -> int:
+        """The shared-prefix CacheInsert: tail-only splice with the
+        in-graph CoW copy (`paged_kv.paged_splice_tail`) — positions
+        below ``start`` stay in the refcounted shared blocks the table
+        row references."""
+        sub = self._next_key()
+        first = sampling.sample(
+            last, sub,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32))
+        if self._prefix_insert_jitted is None:
+            from ..observability import ledger as _ledger
+
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._prefix_insert_jitted = _ledger.instrument(
+                jax.jit(_paged_prefix_insert_fn, donate_argnums=donate),
+                label="CacheInsert", donate=donate)
+        st = self._state
+        (caches, pos, tok, done, temp, top_k, top_p, eos, budget,
+         adapter) = self._prefix_insert_jitted(
+            st.caches, slot_raws, jnp.asarray(slot, jnp.int32),
+            table_row,
+            jnp.asarray(start, jnp.int32),
+            jnp.asarray(length, jnp.int32),
+            jnp.asarray(cow_src, jnp.int32),
+            jnp.asarray(cow_dst, jnp.int32),
+            st.pos, st.tok, st.done, st.temperature, st.top_k,
+            st.top_p, st.eos, st.budget, st.adapter,
+            first[0],
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32),
+            jnp.asarray(req.eos_id, jnp.int32),
+            jnp.asarray(req.max_new_tokens - 1, jnp.int32),
+            jnp.asarray(req.adapter, jnp.int32))
+        self._state = DecodeState(caches, pos, tok, done, st.key, temp,
+                                  top_k, top_p, eos, budget, adapter)
+        return int(np.asarray(first)[0])
+
+    def poison_prefix(self, k: Optional[int] = None) -> bool:
+        """Corrupt the ``k``-th oldest prefix-cache entry's key (the
+        ``serve:prefix_stale`` fault's bite, forwarded by the router) —
+        the next lookup MISSES it and pays a full prefill; wrong-prefix
+        KV is never served. No-op without a prefix cache."""
+        return (False if self._prefix is None
+                else self._prefix.poison(k))
 
     def _collect(self, tok_block, done, results) -> None:
         """Fold one readback window into per-request host state; retire
@@ -1201,8 +1422,8 @@ class InferenceEngine:
 
 
 def _insert_fn(cache_raws, slot_raws, slot, pos, tok, done, temp, top_k,
-               top_p, eos, budget, length, first_tok, t_val, k_val,
-               p_val, e_val, b_val):
+               top_p, eos, budget, adapter, length, first_tok, t_val,
+               k_val, p_val, e_val, b_val, a_val):
     """Compiled slot splice: write the batch-1 prefilled cache into the
     pool at `slot` (batch-dim dynamic_update_slice per leaf) and reset
     that slot's state-vector entries. `slot` rides as a traced scalar so
@@ -1222,12 +1443,14 @@ def _insert_fn(cache_raws, slot_raws, slot, pos, tok, done, temp, top_k,
         top_p.at[slot].set(p_val),
         eos.at[slot].set(e_val),
         budget.at[slot].set(b_val),
+        adapter.at[slot].set(a_val),
     )
 
 
 def _paged_insert_fn(cache_raws, slot_raws, slot, table_row, pos, tok,
-                     done, temp, top_k, top_p, eos, budget, length,
-                     first_tok, t_val, k_val, p_val, e_val, b_val):
+                     done, temp, top_k, top_p, eos, budget, adapter,
+                     length, first_tok, t_val, k_val, p_val, e_val,
+                     b_val, a_val):
     """The paged CacheInsert: scatter the CONTIGUOUS batch-1 prefilled
     cache into the pool blocks named by ``table_row`` and point the
     slot's table at them (`paged_kv.paged_splice` — one scatter per
@@ -1251,4 +1474,53 @@ def _paged_insert_fn(cache_raws, slot_raws, slot, table_row, pos, tok,
         top_p.at[slot].set(p_val),
         eos.at[slot].set(e_val),
         budget.at[slot].set(b_val),
+        adapter.at[slot].set(a_val),
+    )
+
+
+def _prefix_fetch_fn(cache_raws, slot_raws, table_row):
+    """Compiled shared-prefix gather (`paged_kv.paged_fetch` per
+    `PagedKV` leaf): pool blocks named by ``table_row`` land in the
+    contiguous batch-1 scratch so a tail prefill's attention reads the
+    CACHED prefix K/V instead of garbage. The pool rides as a read-only
+    input (never donated)."""
+    def fetch(paged_leaf, slot_subtree):
+        return pk.paged_fetch(paged_leaf, slot_subtree, table_row)
+
+    return jax.tree_util.tree_map(
+        fetch, cache_raws, slot_raws,
+        is_leaf=lambda v: isinstance(v, pk.PagedKV))
+
+
+def _paged_prefix_insert_fn(cache_raws, slot_raws, slot, table_row,
+                            start, length, cow_src, cow_dst, pos, tok,
+                            done, temp, top_k, top_p, eos, budget,
+                            adapter, first_tok, t_val, k_val, p_val,
+                            e_val, b_val, a_val):
+    """CacheInsert, SHARED-PREFIX form: `paged_kv.paged_splice_tail`
+    writes only positions ``start..length-1`` — everything below lives
+    in refcounted blocks other slots also read — and runs the one
+    copy-on-write block copy (``cow_src -> cow_dst``; the trash
+    self-copy when no CoW is due) before the overlay. State resets
+    match the other insert forms; every scalar rides traced so all
+    shared admissions reuse one compile."""
+    def splice(paged_leaf, slot_subtree):
+        return pk.paged_splice_tail(paged_leaf, slot_subtree, slot,
+                                    table_row, start, length, cow_src,
+                                    cow_dst)
+
+    caches = jax.tree_util.tree_map(
+        splice, cache_raws, slot_raws,
+        is_leaf=lambda v: isinstance(v, pk.PagedKV))
+    return (
+        caches,
+        pos.at[slot].set(length),
+        tok.at[slot].set(first_tok),
+        done.at[slot].set(False),
+        temp.at[slot].set(t_val),
+        top_k.at[slot].set(k_val),
+        top_p.at[slot].set(p_val),
+        eos.at[slot].set(e_val),
+        budget.at[slot].set(b_val),
+        adapter.at[slot].set(a_val),
     )

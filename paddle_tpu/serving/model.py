@@ -47,7 +47,7 @@ class TransformerLM(nn.Layer):
         self.ln_f = nn.LayerNorm(d_model)
         self.head = nn.Linear(d_model, vocab_size)
 
-    def forward(self, ids, cache=None, pos=None):
+    def forward(self, ids, cache=None, pos=None, adapter=None):
         T = int(ids.shape[1])
         if cache is None:
             h = self.embed(ids) + self.pos_embed(
@@ -63,7 +63,7 @@ class TransformerLM(nn.Layer):
         h = self.embed(ids) + self.pos_embed(pos_ids)
         new_caches = []
         for blk, c in zip(self.blocks, cache):
-            h, nc = blk(h, cache=c, pos=pos)
+            h, nc = blk(h, cache=c, pos=pos, adapter=adapter)
             new_caches.append(nc)
         return self.head(self.ln_f(h)), new_caches
 

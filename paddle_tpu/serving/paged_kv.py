@@ -46,8 +46,8 @@ from typing import List, Optional
 __all__ = [
     "PagedKV", "block_size_default", "is_paged", "num_blocks",
     "blocks_for", "paged_zero", "paged_write", "paged_gather",
-    "paged_splice", "paged_adopt", "retire_tables", "pool_bytes",
-    "worst_case_bytes",
+    "paged_splice", "paged_splice_tail", "paged_fetch", "paged_adopt",
+    "retire_tables", "pool_bytes", "worst_case_bytes",
     "BlockPool",
 ]
 
@@ -218,6 +218,63 @@ def paged_splice(paged, slot_kv, slot, table_row):
     return PagedKV(new_kv, paged.table.at[slot].set(table_row))
 
 
+def paged_fetch(paged, slot_kv, table_row):
+    """Inverse of :func:`paged_splice` (ISSUE 18 prefix cache):
+    materialize the pool blocks named by ``table_row`` ([nmax] int32,
+    trash-padded) into a CONTIGUOUS batch-1 cache shaped like
+    ``slot_kv`` and return that contiguous tree. The engine runs this
+    once per shared-prefix admission so the tail prefill's attention
+    sees the cached prefix K/V at positions ``0..start-1`` — rows from
+    trash-mapped entries are garbage, which the position mask
+    (``kpos > qpos``) blinds. One gather per leaf; ``table_row`` rides
+    traced so every admission shares one compile."""
+    import jax
+
+    def leaf(pool, contiguous):
+        bs = int(pool.shape[2])
+        H = int(pool.shape[1])
+        nmax = int(contiguous.shape[2]) // bs
+        g = pool[table_row[:nmax]]  # [nmax, H, bs, rest]
+        out = g.transpose(1, 0, 2, 3).reshape(
+            1, H, nmax * bs, g.shape[-1])
+        return out.astype(contiguous.dtype)
+
+    return jax.tree_util.tree_map(leaf, paged.kv, slot_kv)
+
+
+def paged_splice_tail(paged, slot_kv, slot, table_row, start, length,
+                      cow_src, cow_dst):
+    """The CacheInsert splice, SHARED-PREFIX form (ISSUE 18): adopt a
+    prefilled contiguous batch-1 cache into the pool writing ONLY
+    positions ``start <= p < length`` — positions below ``start`` live
+    in refcounted prefix-cache blocks referenced (not copied) by
+    ``table_row``, and writing them would corrupt every other reader.
+    When the tail's first write lands inside a shared block (the
+    full-prefix-match case) the caller passes ``cow_src``/``cow_dst``:
+    the shared block is copied into the request's private ``cow_dst``
+    FIRST, then the tail scatter overlays the new rows — copy-on-write
+    in two fused device ops. ``cow_src = cow_dst = 0`` (trash
+    self-copy) is the no-CoW case. Dead positions collide on the trash
+    block. All scalars ride traced — one compile covers every
+    admission."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(pool, contiguous):
+        bs = int(pool.shape[2])
+        cap = int(contiguous.shape[2])
+        pooled = pool.at[cow_dst].set(pool[cow_src])
+        rows = contiguous[0].transpose(1, 0, 2)  # [cap, H, rest]
+        p = jnp.arange(cap, dtype=jnp.int32)
+        live = (p >= start) & (p < length)
+        phys = jnp.where(live, table_row[p // bs], 0)
+        return pooled.at[phys, :, p % bs, :].set(
+            rows.astype(pool.dtype))
+
+    new_kv = jax.tree_util.tree_map(leaf, paged.kv, slot_kv)
+    return PagedKV(new_kv, paged.table.at[slot].set(table_row))
+
+
 def paged_adopt(paged, rows, slot, table_row):
     """The CacheInsert splice, MIGRATED form (ISSUE 17): adopt a KV
     bundle's gathered block rows into this pool. ``rows`` is the
@@ -274,13 +331,22 @@ class BlockPool:
     (``prompt + max_new_tokens`` is known at submit), so appending
     mid-flight never allocates and admission is a single
     ``free >= needed`` check — the admission-control primitive the
-    router's per-host accounting rides on."""
+    router's per-host accounting rides on.
+
+    ISSUE 18 makes the pool REFCOUNT-aware: a block taken by ``alloc``
+    starts at refcount 1; the prefix cache's :meth:`ref` bumps it for
+    every additional reader (the index itself, each borrowing slot);
+    ``release`` decrements and returns a block to the free list only
+    when the last reference drops — never free-while-referenced. A
+    pool that never calls ``ref`` behaves exactly like the round-13
+    original (alloc at 1, release frees immediately)."""
 
     def __init__(self, total_blocks: int):
         if int(total_blocks) < 2:
             raise ValueError("BlockPool needs >= 2 blocks (incl. trash)")
         self.total = int(total_blocks) - 1  # allocatable (sans trash)
         self._free: List[int] = list(range(1, int(total_blocks)))
+        self._refs: dict = {}
         self.freed_total = 0
 
     @property
@@ -297,11 +363,31 @@ class BlockPool:
         if n > len(self._free):
             return None
         taken, self._free = self._free[:n], self._free[n:]
+        for b in taken:
+            self._refs[b] = 1
         return taken
 
+    def ref(self, blocks: List[int]) -> None:
+        """Add one reference to each block (a prefix-cache publish or a
+        borrowing slot's table reference). Host-side bookkeeping only."""
+        for b in blocks:
+            self._refs[b] = self._refs.get(b, 1) + 1
+
+    def refcount(self, block: int) -> int:
+        """Current references on an allocated block (0 if free)."""
+        return self._refs.get(int(block), 0)
+
     def release(self, blocks: List[int]) -> None:
-        self.freed_total += len(blocks)
-        self._free.extend(blocks)
+        """Drop one reference per block; a block rejoins the free list
+        (and counts toward ``freed_total``) only at refcount zero."""
+        for b in blocks:
+            n = self._refs.get(b, 1) - 1
+            if n <= 0:
+                self._refs.pop(b, None)
+                self.freed_total += 1
+                self._free.append(b)
+            else:
+                self._refs[b] = n
 
     def grow(self, extra: int) -> List[int]:
         """Register ``extra`` NEW physical blocks (ids continue past

@@ -1,0 +1,295 @@
+"""Refcounted copy-on-write prefix cache over the paged KV pool
+(ISSUE 18 tentpole, pillar 1).
+
+Every request used to pay its full prefill even when thousands of
+prompts open with the same system preamble. This module is the
+per-engine index that makes shared prefixes free: once a request's
+prefill lands, each FULL prompt block (``block_size`` tokens wholly
+covered by the prompt) becomes an immutable, content-addressed entry —
+keyed by a token-chain hash, CRC-chained per block exactly like the
+PR-16 bundle CRCs, so block ``j``'s key commits to every token before
+it. A later request whose prompt walks the same chain takes those
+physical blocks *by table reference*: no copy, ``BlockPool.ref`` bumps
+each block's refcount, and the engine prefills only the unshared tail.
+
+Write isolation is copy-on-write, and the paged layout makes it cheap
+to reason about: a slot writes position ``p`` into logical block
+``p // bs``, so a borrower's own writes (tail prefill at
+``>= tail_start``, decode appends at ``>= L``) land in FRESH blocks —
+except exactly one case, the full-prefix match, where re-running the
+final prompt token (the decode loop needs its logits) would write into
+the last shared block. The engine resolves that single collision at
+admission: :func:`paged_kv.paged_splice_tail` copies the shared block
+into a private one first (``cow_src -> cow_dst``), then overlays the
+tail rows. Divergent continuations can never observe each other's KV
+because no shared block is ever written after publication.
+
+Eviction is LRU over idle entries (block refcount 1 — the index is
+the only holder); evicting a parent cascades through its descendants
+so the chain index never strands unreachable children. Admission
+control charges only the UNSHARED block demand — the accounting
+extension the ROADMAP names.
+
+Env knobs (documented in README): ``PADDLE_SERVE_PREFIX_CACHE``
+(``1`` enables the index; default ``0`` keeps the round-17 engine
+bitwise), ``PADDLE_SERVE_PREFIX_BLOCKS`` (max cached entries; ``0`` =
+bounded only by the pool).
+
+Fault hook: a ``serve:prefix_stale:nth[:k]`` rule poisons the k-th
+oldest entry's stored hash at the next lookup — the chain walk then
+misses and the request pays a full prefill. Stale entries are garbage
+the LRU reclaims; wrong-prefix KV is never served.
+"""
+from __future__ import annotations
+
+import os
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set
+
+__all__ = ["PrefixCache", "PrefixShare", "prefix_cache_enabled",
+           "prefix_blocks_default", "chain_hash"]
+
+_ENABLE_ENV = "PADDLE_SERVE_PREFIX_CACHE"
+_BLOCKS_ENV = "PADDLE_SERVE_PREFIX_BLOCKS"
+
+#: hash-space perturbation a ``prefix_stale`` fault applies to an
+#: entry's key — any non-zero constant works, the point is the chain
+#: walk computes the TRUE hash and finds nothing
+_POISON_XOR = 0x5A5A5A5A
+
+_ROOT = 0  # parent hash of block-0 entries
+
+
+def prefix_cache_enabled() -> bool:
+    """``PADDLE_SERVE_PREFIX_CACHE`` — 1 builds the per-engine index;
+    0 (default) keeps round-17 admission bitwise."""
+    return os.environ.get(_ENABLE_ENV, "0").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def prefix_blocks_default() -> int:
+    """``PADDLE_SERVE_PREFIX_BLOCKS`` — max resident entries (0 =
+    bounded only by pool capacity)."""
+    try:
+        return max(int(os.environ.get(_BLOCKS_ENV, "0")), 0)
+    except ValueError:
+        return 0
+
+
+def chain_hash(prev: int, tokens) -> int:
+    """Token-chain hash of one block: CRC32 of the block's int32 token
+    bytes seeded with the previous block's hash — block ``j``'s key
+    commits to tokens ``0 .. (j+1)*bs-1`` (the PR-16 CRC-chain idiom,
+    applied to token content instead of KV bytes)."""
+    import numpy as np
+
+    return zlib.crc32(
+        np.asarray(tokens, np.int32).tobytes(), int(prev)) & 0xFFFFFFFF
+
+
+class PrefixShare:
+    """One lookup's sharing plan, consumed by the engine at admission.
+
+    ``src_blocks`` — matched physical blocks in logical order (what the
+    prefix fetch materializes into the scratch cache);
+    ``ref_blocks`` — the subset taken by table reference (refcount++),
+    placed at the head of the slot's table row;
+    ``cow_src`` — the shared block the tail's first write would land in
+    (full-prefix match only; None = no CoW needed);
+    ``tail_start`` — first prompt position the engine must prefill."""
+
+    __slots__ = ("src_blocks", "ref_blocks", "cow_src", "tail_start")
+
+    def __init__(self, src_blocks, ref_blocks, cow_src, tail_start):
+        self.src_blocks = src_blocks
+        self.ref_blocks = ref_blocks
+        self.cow_src = cow_src
+        self.tail_start = tail_start
+
+
+class _Entry:
+    __slots__ = ("block", "parent")
+
+    def __init__(self, block: int, parent: int):
+        self.block = block
+        self.parent = parent
+
+
+class PrefixCache:
+    """Per-engine chain-hash index over published prompt blocks."""
+
+    def __init__(self, block_size: int, *, capacity: Optional[int] = None):
+        self.block = int(block_size)
+        self.capacity = (prefix_blocks_default() if capacity is None
+                         else int(capacity))
+        self._entries: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._children: Dict[int, Set[int]] = {}
+        self.lookups = 0
+        self.published = 0
+        self.evicted = 0
+        self.poisoned = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # -- lookup -------------------------------------------------------
+
+    def lookup(self, prompt_ids) -> Optional[PrefixShare]:
+        """Walk the chain over the prompt's full blocks; None on a cold
+        miss, else the sharing plan. Touches matched entries (LRU).
+        Fires the ``serve`` fault site so ``serve:prefix_stale`` rules
+        arm on engine-direct lookups too, then consumes any armed
+        poison before walking."""
+        from ..utils import fault_injection as fi
+
+        self.lookups += 1
+        for _, arg in fi.consume_serve_matching(("prefix_stale",),
+                                                fire=True):
+            self.poison(arg)
+        bs = self.block
+        L = int(len(prompt_ids))
+        h = _ROOT
+        matched: List[int] = []
+        for j in range(L // bs):
+            h = chain_hash(h, prompt_ids[j * bs:(j + 1) * bs])
+            e = self._entries.get(h)
+            if e is None:
+                break
+            self._entries.move_to_end(h)
+            matched.append(e.block)
+        if not matched:
+            return None
+        n = len(matched)
+        if n * bs == L:
+            # full match: the decode loop still needs the last prompt
+            # token's logits, and that forward re-writes position L-1
+            # inside the last shared block -> CoW it
+            return PrefixShare(matched, matched[:-1], matched[-1], L - 1)
+        return PrefixShare(matched, list(matched), None, n * bs)
+
+    # -- publish ------------------------------------------------------
+
+    def publish(self, pool, prompt_ids, table_blocks) -> int:
+        """Index the full prompt blocks of a just-prefilled slot.
+        ``table_blocks`` is the slot's table row in logical order. Each
+        newly indexed block gains one pool reference (the index's own);
+        already-indexed hashes are just LRU-touched — including the
+        borrower's CoW'd private block, whose chain hash already maps
+        to the original. Publishing stops (never skips) when the chain
+        hits the capacity bound and nothing is evictable, so every
+        indexed child is reachable from its parent. Returns how many
+        entries were added."""
+        bs = self.block
+        L = int(len(prompt_ids))
+        h = _ROOT
+        added = 0
+        for j in range(L // bs):
+            parent = h
+            h = chain_hash(h, prompt_ids[j * bs:(j + 1) * bs])
+            if h in self._entries:
+                self._entries.move_to_end(h)
+                continue
+            if self.capacity and len(self._entries) >= self.capacity:
+                if not self._evict_lru(pool):
+                    break
+            block = int(table_blocks[j])
+            pool.ref([block])
+            self._entries[h] = _Entry(block, parent)
+            self._children.setdefault(parent, set()).add(h)
+            added += 1
+            self.published += 1
+        return added
+
+    # -- eviction -----------------------------------------------------
+
+    def _subtree_idle(self, pool, h: int) -> bool:
+        e = self._entries.get(h)
+        if e is None:
+            return True
+        if pool.refcount(e.block) > 1:
+            return False
+        return all(self._subtree_idle(pool, c)
+                   for c in self._children.get(h, ()))
+
+    def _evict_entry(self, pool, h: int) -> None:
+        for c in list(self._children.get(h, ())):
+            self._evict_entry(pool, c)
+        e = self._entries.pop(h, None)
+        if e is None:
+            return
+        self._children.pop(h, None)
+        sibs = self._children.get(e.parent)
+        if sibs is not None:
+            sibs.discard(h)
+            if not sibs:
+                self._children.pop(e.parent, None)
+        pool.release([e.block])
+        self.evicted += 1
+
+    def _evict_lru(self, pool) -> bool:
+        """Evict the oldest idle subtree (refcount-1 root — only the
+        index holds it; idle parents imply idle descendants because a
+        borrower references every ancestor block too)."""
+        victim = next((h for h in self._entries
+                       if self._subtree_idle(pool, h)), None)
+        if victim is None:
+            return False
+        self._evict_entry(pool, victim)
+        return True
+
+    def evict_for(self, pool, need: int) -> int:
+        """Free pool blocks until ``pool.free >= need`` (or nothing is
+        evictable) — the admission path's last resort before deferring.
+        Returns entries evicted."""
+        n = 0
+        while pool.free < int(need) and self._evict_lru(pool):
+            n += 1
+        return n
+
+    def evict_above(self, pool, max_id: int) -> int:
+        """Evict idle entries holding block ids above ``max_id`` so a
+        pending pool shrink (fleet-controller reclaim) can withdraw the
+        top of the id space instead of deadlocking on index-held
+        blocks."""
+        n = 0
+        progress = True
+        while progress:
+            progress = False
+            for h, e in list(self._entries.items()):
+                if e.block > int(max_id) and self._subtree_idle(pool, h):
+                    self._evict_entry(pool, h)
+                    n += 1
+                    progress = True
+                    break
+        return n
+
+    def clear(self, pool) -> None:
+        """Drop every entry (releasing the index's references)."""
+        for h in list(self._entries):
+            self._evict_entry(pool, h)
+
+    # -- fault hook ---------------------------------------------------
+
+    def poison(self, k: Optional[int] = None) -> bool:
+        """``serve:prefix_stale`` bite: corrupt the stored hash of the
+        ``k``-th oldest entry (default 0) by re-keying it — the chain
+        walk computes the TRUE hash and misses, so the borrower pays a
+        full prefill instead of adopting stale KV. The orphaned entry
+        (and its now-unreachable descendants) stay refcounted and are
+        reclaimed by the normal LRU eviction."""
+        keys = list(self._entries)
+        if not keys:
+            return False
+        h = keys[min(int(k or 0), len(keys) - 1)]
+        e = self._entries.pop(h)
+        bad = (h ^ _POISON_XOR) & 0xFFFFFFFF
+        self._entries[bad] = e
+        if h in self._children:
+            self._children[bad] = self._children.pop(h)
+        sibs = self._children.get(e.parent)
+        if sibs is not None:
+            sibs.discard(h)
+            sibs.add(bad)
+        self.poisoned += 1
+        return True

@@ -65,6 +65,23 @@ request survives). :meth:`Router.drain_host` prices the move per
 request (`kv_migration.migrate_cost_tokens`) against finishing in
 place, and ``PADDLE_SERVE_MIGRATE=0`` turns the whole plane off.
 
+Round 18 disaggregates PREFILL from DECODE over the same bundle wire:
+a :class:`PrefillHost` / :class:`FilePrefillHost` runs only the
+compute-bound prefill phase (plus the first token — the extract
+contract needs it) and ships the finished context as a
+`kv_migration.KVBundle`; the router places prefills on the
+prefill tier by predicted compute wait and decodes by slot
+availability among the bundle-capable decode hosts, reusing the
+round-17 ladder verbatim — CRC gate, arrival deadline, per-host
+``no_capacity`` refusal — and falling back to ordinary colocated
+admission on ANY broken rung (``disagg_fallbacks`` counts them; zero
+requests are ever dropped by disaggregation). ``PADDLE_SERVE_DISAGG=0``
+(or simply configuring no prefill hosts) restores colocated behavior
+end-to-end. Requests also carry an ``adapter`` id (round-18 adapter
+fleets): admission checks residency per host (`router_admit` reason
+``adapter``), and the ``serve:adapter_missing`` fault rewrites one
+submit to an unloaded id to prove the reject is clean, not a crash.
+
 Pieces:
 
 - :class:`LocalHost` — an in-process engine endpoint (single-host
@@ -105,10 +122,11 @@ import time
 import zlib
 from typing import Dict, List, Optional
 
-__all__ = ["HostStats", "LocalHost", "FileHost", "Router",
-           "admit_queue_default", "admit_ttft_ms_default",
-           "host_timeout_ms_default", "retry_max_default",
-           "retry_backoff_ms_default", "sim_next_token", "worker_main"]
+__all__ = ["HostStats", "LocalHost", "FileHost", "PrefillHost",
+           "FilePrefillHost", "Router", "admit_queue_default",
+           "admit_ttft_ms_default", "host_timeout_ms_default",
+           "retry_max_default", "retry_backoff_ms_default",
+           "disagg_enabled", "sim_next_token", "worker_main"]
 
 #: process-wide trace-id counter: ids are pid-qualified, so the counter
 #: must be shared by every Router in the process or two routers over
@@ -120,6 +138,8 @@ _ADMIT_TTFT_ENV = "PADDLE_SERVE_ADMIT_TTFT_MS"
 _HOST_TIMEOUT_ENV = "PADDLE_SERVE_HOST_TIMEOUT_MS"
 _RETRY_MAX_ENV = "PADDLE_SERVE_RETRY_MAX"
 _RETRY_BACKOFF_ENV = "PADDLE_SERVE_RETRY_BACKOFF_MS"
+_DISAGG_ENV = "PADDLE_SERVE_DISAGG"
+_ROLE_ENV = "PADDLE_SERVE_ROLE"
 
 
 def admit_queue_default() -> int:
@@ -169,6 +189,14 @@ def retry_backoff_ms_default() -> float:
         return max(float(os.environ.get(_RETRY_BACKOFF_ENV, "250")), 1.0)
     except ValueError:
         return 250.0
+
+
+def disagg_enabled() -> bool:
+    """``PADDLE_SERVE_DISAGG`` — 0 disables disaggregated
+    prefill/decode placement even when prefill hosts are configured
+    (default 1: configuring a prefill tier opts in)."""
+    v = os.environ.get(_DISAGG_ENV, "1").strip().lower()
+    return v not in ("0", "false", "off")
 
 
 # ---------------------------------------------------------------------------
@@ -297,6 +325,7 @@ def _req_fields(req) -> dict:
         "eos_id": req.eos_id,
         "trace_id": getattr(req, "trace_id", None),
         "resume_tokens": [int(t) for t in resume],
+        "adapter": int(getattr(req, "adapter", 0) or 0),
     }
 
 
@@ -330,7 +359,8 @@ class LocalHost:
                 eos_id=(None if d.get("eos_id", -1) in (-1, None)
                         else d["eos_id"]),
                 rid=d.get("rid"), trace_id=d.get("trace_id"),
-                resume_tokens=d.get("resume_tokens"))
+                resume_tokens=d.get("resume_tokens"),
+                adapter=d.get("adapter", 0))
         self._reqs[req.rid] = req
         self.engine.submit(req)
         self._submitted += 1
@@ -391,6 +421,25 @@ class LocalHost:
         # "drain" is router-side for an in-process engine: admissions
         # stop and the remaining work is pumped dry
 
+    # -- multi-tenancy (round 18) ------------------------------------------
+    def adapter_ok(self, aid) -> bool:
+        """Can this host serve adapter ``aid``? (0 — the base model —
+        always; otherwise the engine's AdapterSet must hold it.) The
+        router's per-host admission check, so a fleet mixing
+        adapter-capable and base-only hosts routes around the gap
+        instead of crashing a submit."""
+        aid = int(aid or 0)
+        if aid == 0:
+            return True
+        ad = getattr(self.engine, "adapters", None)
+        return ad is not None and ad.is_loaded(aid)
+
+    def poison_prefix(self, k=None) -> bool:
+        """Forward a ``serve:prefix_stale`` bite into the engine's
+        prefix cache (False when the host runs without one)."""
+        fn = getattr(self.engine, "poison_prefix", None)
+        return bool(fn(k)) if fn is not None else False
+
     # -- KV block migration (round 17) -------------------------------------
     def extract_kv(self, rid, timeout_ms=None):
         """Pull ``rid``'s live KV bundle straight off the engine (the
@@ -430,7 +479,7 @@ class LocalHost:
             eos_id=(None if m.get("eos_id", -1) in (-1, None)
                     else int(m["eos_id"])),
             rid=m.get("rid"), trace_id=m.get("trace_id"),
-            resume_tokens=prefix)
+            resume_tokens=prefix, adapter=int(m.get("adapter", 0)))
         try:
             ok = bool(fn(req, bundle))
         except Exception:
@@ -568,9 +617,24 @@ class FileHost:
                        else int(m.get("eos_id", -1))),
             "trace_id": m.get("trace_id"),
             "resume_tokens": prefix,
+            "adapter": int(m.get("adapter", 0)),
             "migrated": True,
         })
         return True
+
+    def adapter_ok(self, aid) -> bool:
+        """Mailbox-tier residency check: the dryrun worker holds no
+        real weights, so the fleet-size knob IS the residency contract
+        — ids ``1..PADDLE_SERVE_ADAPTERS-1`` are servable, everything
+        else is not (0, the base model, always is)."""
+        aid = int(aid or 0)
+        if aid == 0:
+            return True
+        try:
+            n = int(os.environ.get("PADDLE_SERVE_ADAPTERS", "0") or 0)
+        except ValueError:
+            n = 0
+        return 1 <= aid < n
 
     def _stream_path(self) -> str:
         return os.path.join(self.obs_dir,
@@ -641,6 +705,101 @@ class FileHost:
                 "service_t": self._service_t,
                 "progress": dict(self._progress),
                 "results": self.results()}
+
+
+# ---------------------------------------------------------------------------
+# prefill-tier endpoints (round 18 disaggregation)
+# ---------------------------------------------------------------------------
+
+
+class PrefillHost(LocalHost):
+    """In-process PREFILL-ONLY endpoint (round 18): runs the
+    compute-bound prefill phase on its own engine, then ships the
+    finished context out as a `kv_migration.KVBundle` — the SAME
+    sealed wire form the round-17 migration plane moves, so the decode
+    tier's ``insert_kv`` splice, CRC gate, and capacity refusal all
+    apply unchanged. The bundle's manifest carries the first token
+    (the extract contract includes it in ``emitted``) and the
+    decremented budget; the request is CANCELLED here the moment the
+    bundle is sealed — the decode host owns it from then on, exactly
+    the double-spend rule the extract verb enforces."""
+
+    can_fail = False
+    role = "prefill"
+
+    def prefill(self, fields, timeout_ms=None):
+        """Run one request's prefill to completion and return
+        ``("bundle", KVBundle)`` — or ``("done", result_dict)`` when
+        the request finished AT activation (first token hit EOS or a
+        budget of one: there is nothing left to decode, so shipping KV
+        would be waste). None = this host could not produce either
+        (the router's ladder falls back to colocated admission)."""
+        from .engine import Request
+
+        d = _req_fields(fields)
+        req = Request(
+            d.get("prompt_ids", [0]),
+            max_new_tokens=d["max_new_tokens"],
+            temperature=d.get("temperature", 0.0),
+            top_k=d.get("top_k", 0), top_p=d.get("top_p", 1.0),
+            eos_id=(None if d.get("eos_id", -1) in (-1, None)
+                    else d["eos_id"]),
+            rid=d.get("rid"), trace_id=d.get("trace_id"),
+            resume_tokens=d.get("resume_tokens"),
+            adapter=d.get("adapter", 0))
+        try:
+            self.engine.submit(req)
+        except ValueError:
+            return None  # adapter not resident here: fall back
+        self._submitted += 1
+        results: Dict = {}
+        # pump ONLY the prefill half of the engine's turn — advance
+        # chunked prefills and fill free slots (activation computes the
+        # first token) — never a decode window: every token after the
+        # first belongs to the decode tier. A full engine.turn() would
+        # decode a whole readback window here first.
+        for _ in range(1024):
+            self.engine._advance_prefills(results)
+            self.engine._fill_free_slots(results)
+            if req.rid in results:
+                res = results.pop(req.rid)
+                return ("done", {
+                    "rid": req.rid,
+                    "token_ids": [int(t) for t in res.tokens],
+                    "resumed": 0,
+                    "ttft_ms": res.ttft_ms,
+                    "latency_ms": res.total_ms,
+                    "trace_id": d.get("trace_id"),
+                })
+            if self.engine.progress().get(req.rid):
+                break
+        else:
+            self.engine.cancel(req.rid)
+            return None
+        bundle = self.extract_kv(req.rid, timeout_ms)
+        self.cancel(req.rid)
+        if bundle is None:
+            return None
+        return ("bundle", bundle)
+
+
+class FilePrefillHost(FileHost):
+    """Mailbox PREFILL-ONLY endpoint: submits the request to a worker
+    running with ``PADDLE_SERVE_ROLE=prefill``, which answers every
+    request with a PROACTIVE ``outbox/kv_<rid>.json`` bundle blob
+    (one simulated prefill token, no done file) — so no ``extract``
+    verb round-trip sits on the handoff's critical path. The arrival
+    deadline and CRC gate are the round-17 machinery verbatim."""
+
+    role = "prefill"
+
+    def prefill(self, fields, timeout_ms=None):
+        d = _req_fields(fields)
+        self.submit(d)
+        bundle = self.extract_kv(d.get("rid"), timeout_ms, _send=False)
+        if bundle is None:
+            return None
+        return ("bundle", bundle)
 
 
 # ---------------------------------------------------------------------------
@@ -723,10 +882,16 @@ class Router:
                  avg_new_tokens=16, burst_prompt_len=4,
                  burst_new_tokens=None, host_timeout_ms=None,
                  retry_max=None, retry_backoff_ms=None,
-                 drain_inplace_tokens=None, migrate_timeout_ms=None):
+                 drain_inplace_tokens=None, migrate_timeout_ms=None,
+                 prefill_hosts=None):
         self.hosts = list(hosts)
         if not self.hosts:
             raise ValueError("Router needs at least one host")
+        #: round-18 prefill tier: endpoints exposing ``prefill(fields)``
+        #: (PrefillHost / FilePrefillHost). Empty = colocated serving,
+        #: exactly the pre-18 plane; the ``PADDLE_SERVE_DISAGG`` knob
+        #: additionally gates the placement per submit.
+        self.prefill_hosts = list(prefill_hosts or [])
         self.admit_queue = (admit_queue_default()
                             if admit_queue is None else int(admit_queue))
         self.admit_ttft_ms = (admit_ttft_ms_default()
@@ -761,11 +926,16 @@ class Router:
         self.migrate_failed = 0   # ladder falls to re-prefill
         self.migrate_blocks = 0   # blocks moved (bench: report-only)
         self.migrate_bytes = 0    # bytes moved (bench: report-only)
+        self.disagg_prefills = 0  # handoffs that spliced a prefill bundle
+        self.disagg_fallbacks = 0  # broken rungs -> colocated admission
         self._ticks = 0
         self._burst_rid = 0
         #: armed serve:kv_corrupt / serve:kv_lost faults, consumed one
         #: per migration attempt (the router's side of the serve site)
         self._kv_faults: List = []
+        #: armed serve:adapter_missing faults, consumed one per submit
+        #: (each rewrites that submit's adapter id to an unloaded one)
+        self._adapter_faults: List = []
         # submits this router made that the host telemetry cannot have
         # absorbed yet; decays when a fresher stats row shows up
         self._pending_guess = [0] * len(self.hosts)
@@ -849,9 +1019,16 @@ class Router:
     def _live(self, idx: int) -> bool:
         return self._health[idx].state == "healthy"
 
-    def _ineligible_why(self, idx: int, st: HostStats) -> Optional[str]:
+    def _ineligible_why(self, idx: int, st: HostStats,
+                        aid: int = 0) -> Optional[str]:
         if not self._live(idx):
             return "not_live"
+        if aid:
+            ok_fn = getattr(self.hosts[idx], "adapter_ok", None)
+            if ok_fn is not None and not ok_fn(aid):
+                # the host cannot serve this fine-tune: a CLEAN
+                # admission reason (round 18), never a submit crash
+                return "adapter"
         depth = st.queue_depth + self._pending_guess[idx]
         if depth >= self.admit_queue * self.capacity[idx]:
             return "queue_full"
@@ -878,9 +1055,22 @@ class Router:
             # tracking (and idempotent failover) needs a stable id even
             # for anonymous dict requests
             fields["rid"] = rid = f"r{os.getpid():x}-{next(_trace_counter)}"
+        # round-18 fault: an armed serve:adapter_missing rewrites THIS
+        # submit to an unloaded adapter id — admission must reject it
+        # cleanly (reason "adapter"), never crash a compiled step
+        for _, arg in _fault().consume_serve_matching(
+                ("adapter_missing",), fire=True):
+            self._adapter_faults.append(arg)
+        if self._adapter_faults:
+            arg = self._adapter_faults.pop(0)
+            fields["adapter"] = int(arg) if arg else 1_000_000
         now = time.time()
         entry = _Tracked(fields, tid, -1, now)
-        placed = self._route(entry, now)
+        placed = None
+        if self._disagg_eligible(fields):
+            placed = self._submit_disagg(entry, now)
+        if placed is None:
+            placed = self._route(entry, now)
         if placed is None:
             self.rejected += 1
             return None
@@ -898,11 +1088,12 @@ class Router:
         row with the reason the surviving fleet gave."""
         stats = []
         reasons = []
+        aid = int(entry.fields.get("adapter", 0) or 0)
         for i, h in enumerate(self.hosts):
             st = h.stats()
             self._refresh_guess(i, st)
             stats.append(st)
-            reasons.append(self._ineligible_why(i, st))
+            reasons.append(self._ineligible_why(i, st, aid))
         candidates = [i for i, why in enumerate(reasons) if why is None]
         if not candidates:
             if emit_reject:
@@ -927,6 +1118,114 @@ class Router:
         self._last_submit_t[best] = time.time()
         self._emit_span(entry.trace_id, entry.rid, best, predicted)
         return best
+
+    # -- disaggregated prefill/decode (round 18) ----------------------------
+    def _disagg_eligible(self, fields: dict) -> bool:
+        """Disaggregate only FRESH compute-bound work: a configured
+        prefill tier, the knob on, a real decode budget (a one-token
+        request has nothing to hand off), and no resume prefix (a
+        failover/migration re-submit already carries its context — the
+        recovery ladders own those)."""
+        return (bool(self.prefill_hosts) and disagg_enabled()
+                and int(fields.get("max_new_tokens", 16)) > 1
+                and not fields.get("resume_tokens"))
+
+    def _submit_disagg(self, entry: _Tracked, now: float) -> Optional[int]:
+        """Place one request disaggregated: prefill on the tier host
+        with the lowest predicted COMPUTE wait, decode on the eligible
+        decode host with the most free SLOTS (fewest queued+inflight),
+        handing the context across as a CRC-gated KVBundle — the
+        round-17 ladder verbatim. ANY broken rung (no bundle inside
+        the deadline, a block failing CRC, every decode pool refusing
+        the splice) returns None and the caller falls back to ordinary
+        colocated admission: disaggregation changes WHERE the prefill
+        burns compute, never whether a request survives."""
+        t0 = time.perf_counter()
+        order = sorted(
+            range(len(self.prefill_hosts)),
+            key=lambda i: self._predicted_wait_ms(
+                self.prefill_hosts[i].stats(), 0))
+        outcome = None
+        pi = None
+        for i in order:
+            try:
+                outcome = self.prefill_hosts[i].prefill(
+                    dict(entry.fields), self.migrate_timeout_ms)
+            except OSError:
+                outcome = None
+            if outcome is not None:
+                pi = i
+                break
+        if outcome is None:
+            self.disagg_fallbacks += 1
+            return None
+        kind, payload = outcome
+        if kind == "done":
+            # the prefill's first token ended the request (EOS at
+            # activation): the prefill host's result IS the answer
+            self._complete(len(self.hosts) + pi, payload)
+            self._emit_span(entry.trace_id, entry.rid,
+                            len(self.hosts) + pi, 0.0)
+            return len(self.hosts) + pi
+        bundle = payload
+        if bundle.verify():
+            self.disagg_fallbacks += 1
+            return None  # a torn handoff re-prefills colocated
+        m = bundle.manifest
+        prefix = [int(t) for t in (m.get("resume") or [])] + \
+            [int(t) for t in (m.get("emitted") or [])]
+        aid = int(entry.fields.get("adapter", 0) or 0)
+        stats, reasons = [], []
+        for i, h in enumerate(self.hosts):
+            st = h.stats()
+            self._refresh_guess(i, st)
+            stats.append(st)
+            reasons.append(self._ineligible_why(i, st, aid))
+        # decode placement ranks by SLOT availability (occupancy), not
+        # compute wait: the prefill is already paid, what the decode
+        # tier contributes is a free slot's steady token cadence
+        decode_order = sorted(
+            (i for i, why in enumerate(reasons)
+             if why is None and hasattr(self.hosts[i], "insert_kv")),
+            key=lambda i: (stats[i].queue_depth + stats[i].inflight
+                           + self._pending_guess[i]))
+        placed = None
+        for i in decode_order:
+            try:
+                if self.hosts[i].insert_kv(bundle):
+                    placed = i
+                    break
+            except OSError:
+                continue
+        if placed is None:
+            self.disagg_fallbacks += 1
+            return None  # every pool refused: colocated can QUEUE
+        fields = dict(entry.fields)
+        fields["resume_tokens"] = prefix
+        fields["max_new_tokens"] = int(m.get("budget_left", 0))
+        entry.fields = fields
+        entry.host = placed
+        entry.t_submit = now
+        entry.progress = []
+        self._tracked[entry.rid] = entry
+        self._pending_guess[placed] += 1
+        self._last_submit_t[placed] = time.time()
+        self.disagg_prefills += 1
+        bus = _bus()
+        if bus.enabled():
+            bus.emit_span("disagg_prefill", entry.trace_id, {
+                "rid": entry.rid,
+                "prefill_host": pi,
+                "to_host": placed,
+                "blocks": bundle.n_blocks,
+                "bytes": bundle.nbytes,
+                "ctx": int(m.get("ctx", 0)),
+                "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }, step=self._ticks)
+        self._emit_span(entry.trace_id, entry.rid, placed,
+                        self._predicted_wait_ms(
+                            stats[placed], self._pending_guess[placed]))
+        return placed
 
     # -- control loop ------------------------------------------------------
     def tick(self) -> List[Optional[int]]:
@@ -960,13 +1259,22 @@ class Router:
         """Drain armed ``serve`` events on the ROUTER's side of the
         site: ``burst`` pairs are returned for :meth:`tick` to submit;
         ``kv_corrupt`` / ``kv_lost`` are stashed for the next migration
-        attempt (round 17); the worker-side actions (slow_host,
-        straggler, host_crash, hang) are dropped — each worker process
-        drains its own injector."""
+        attempt (round 17); ``prefix_stale`` is forwarded into every
+        host exposing a prefix cache and ``adapter_missing`` is stashed
+        for the next submit (round 18); the worker-side actions
+        (slow_host, straggler, host_crash, hang) are dropped — each
+        worker process drains its own injector."""
         out: List = []
         for action, arg in _fault().consume_serve_events():
             if action in ("kv_corrupt", "kv_lost"):
                 self._kv_faults.append((action, arg))
+            elif action == "prefix_stale":
+                for h in list(self.hosts) + list(self.prefill_hosts):
+                    fn = getattr(h, "poison_prefix", None)
+                    if fn is not None:
+                        fn(arg)
+            elif action == "adapter_missing":
+                self._adapter_faults.append(arg)
             elif action == "burst":
                 out.append((action, arg))
         return out
@@ -1431,6 +1739,10 @@ class Router:
             "migrate_failed": self.migrate_failed,
             "orphans": len(self._orphans),
         }
+        if self.prefill_hosts:
+            payload["prefill_hosts"] = len(self.prefill_hosts)
+            payload["disagg_prefills"] = self.disagg_prefills
+            payload["disagg_fallbacks"] = self.disagg_fallbacks
         total = 0
         for i, h in enumerate(self.hosts):
             st = h.stats()
@@ -1550,6 +1862,7 @@ def _sim_kv_blob(current: dict, rank: int) -> dict:
         "top_p": req.get("top_p", 1.0),
         "eos_id": req.get("eos_id", -1),
         "budget_left": int(req.get("max_new_tokens", 16)) - len(emitted),
+        "adapter": int(req.get("adapter", 0) or 0),
         "block_size": bs,
         "n_blocks": n,
         "quant": None,
@@ -1595,7 +1908,14 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     the process and its ``decode_metrics`` heartbeat ALIVE — the
     detector's harder prey (liveness looks fine; only the service
     deadline sees it). Exits when ``<base>/stop`` appears and the
-    inbox is drained (a hung worker exits on ``stop`` alone)."""
+    inbox is drained (a hung worker exits on ``stop`` alone).
+
+    Round 18: ``PADDLE_SERVE_ROLE=prefill`` (or ``prefill:R1[,R2...]``
+    to target only the named ranks of a mixed launch) turns the worker
+    into a PREFILL-ONLY host — each picked-up request "prefills" (one sim
+    token: the extract contract's first-token rule), PROACTIVELY
+    writes its ``outbox/kv_<rid>.json`` bundle blob, and never writes
+    a done file: the decode tier owns the request from the blob on."""
     argv = list(sys.argv[1:] if argv is None else argv)
     if len(argv) < 2:
         print("usage: router.py <repo_root> <mailbox_base> "
@@ -1607,6 +1927,16 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     bus = _bus()
     fi = _fault()
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    # PADDLE_SERVE_ROLE: "prefill" makes every rank of this launch a
+    # prefill-tier worker; "prefill:R1[,R2...]" only the named ranks —
+    # so ONE launcher invocation can spawn a mixed fleet (decode rank 0,
+    # dedicated prefill rank 1) over one mailbox base
+    role = os.environ.get(_ROLE_ENV, "").strip().lower()
+    prefill_role = False
+    if role.startswith("prefill"):
+        _, _, only = role.partition(":")
+        prefill_role = (not only) or str(rank) in [
+            s.strip() for s in only.split(",")]
     host_dir = os.path.join(base, f"host{rank}")
     inbox = os.path.join(host_dir, "inbox")
     outbox = os.path.join(host_dir, "outbox")
@@ -1718,7 +2048,35 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                     "queue_wait_ms": round(
                         (time.time() - req["t_arrive"]) * 1e3, 3)},
                     step=windows)
-            if current is not None:
+            if current is not None and prefill_role:
+                # round 18: the prefill tier's whole decode is ONE
+                # token (the bundle's first-token contract); the blob
+                # lands proactively and the request is handed off
+                req = current["req"]
+                tok = sim_next_token(current["chain"])
+                current["chain"].append(tok)
+                current["emitted"].append(tok)
+                served_tokens = 1
+                time.sleep(len(current["chain"]) / rate * slow)
+                blob = _sim_kv_blob(current, rank)
+                rid = req.get("rid")
+                path = os.path.join(outbox, f"kv_{rid}.json")
+                with open(path + ".tmp", "w") as f:
+                    json.dump(blob, f)
+                os.replace(path + ".tmp", path)
+                bus.emit("worker_progress", {
+                    "rid": rid,
+                    "trace_id": req.get("trace_id"),
+                    "tokens": list(current["emitted"]),
+                }, step=windows)
+                bus.emit("kv_extract", {
+                    "rid": rid,
+                    "trace_id": req.get("trace_id"),
+                    "blocks": blob["manifest"]["n_blocks"],
+                    "prefill": True,
+                }, step=windows)
+                current = None
+            elif current is not None:
                 req = current["req"]
                 budget = int(req.get("max_new_tokens", 16))
                 take = min(_WORKER_WINDOW, budget - len(current["emitted"]))

@@ -54,7 +54,15 @@
   block 0) so the per-block CRC catches it and that one request falls
   back to re-prefill, and ``serve:kv_lost:1`` makes the next migration
   bundle never arrive (the extract verb is swallowed, the router's
-  bundle wait times out, same per-request fallback — ISSUE 17);
+  bundle wait times out, same per-request fallback — ISSUE 17),
+  ``serve:prefix_stale:1[:k]`` poisons the content hash of one cached
+  prefix-cache entry (the ``k``-th oldest, default 0) so the next
+  shared-prefix lookup MISSES and the request pays a full prefill —
+  never serves wrong-prefix KV (ISSUE 18), and
+  ``serve:adapter_missing:1[:id]`` rewrites the router's next submit to
+  reference an unloaded adapter id (default an id past any fleet) so
+  admission rejects it cleanly with ``router_admit.reason=adapter``
+  instead of crashing a compiled step (ISSUE 18);
   ``arg`` defaults: burst 8 requests,
   slow_host/straggler/host_crash rank 0, kv_corrupt block 0. At the
   ``serve`` site the
@@ -96,13 +104,15 @@ from typing import Dict, List, Optional
 
 __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
            "has_site", "consume_grad_action", "consume_rank_events",
-           "consume_serve_events", "consume_mon_action",
+           "consume_serve_events", "consume_serve_matching",
+           "consume_mon_action",
            "consume_ctl_events", "GRAD_POISONS", "reset"]
 
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
 _ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
             "spike", "depart", "return", "burst", "slow_host",
-            "straggler", "host_crash", "kv_corrupt", "kv_lost", "drop",
+            "straggler", "host_crash", "kv_corrupt", "kv_lost",
+            "prefix_stale", "adapter_missing", "drop",
             "dup", "flap", "die")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
@@ -118,7 +128,8 @@ _RANK_SITES = ("rank",)
 # `hang` doubles as a serve event when a rule targets that site (the
 # worker consumes it as "stop draining the mailbox, stay alive")
 _SERVE_ACTIONS = ("burst", "slow_host", "straggler", "host_crash",
-                  "kv_corrupt", "kv_lost")
+                  "kv_corrupt", "kv_lost", "prefix_stale",
+                  "adapter_missing")
 _SERVE_SITES = ("serve",)
 # bus-line faults only make sense where a bus row is being written
 # (observability/bus.py emit — the fleet monitor's cursor prey)
@@ -367,6 +378,32 @@ def consume_serve_events() -> List:
     if inj is None or not inj.serve_events:
         return []
     out, inj.serve_events = inj.serve_events, []
+    return out
+
+
+def consume_serve_matching(actions, *, fire: bool = False) -> List:
+    """Drain ONLY the armed serve events whose action is in ``actions``
+    (leaving the rest for the router/worker consumers); with ``fire``
+    the serve site is hit first — the prefix cache uses that form so an
+    engine driven WITHOUT a router still arms ``serve:prefix_stale``
+    rules on its own lookups. The fire is suppressed when the spec
+    carries no rule for any of ``actions``: these hooks sit on hot
+    paths (every router submit, every prefix lookup), and a spec that
+    never names them must keep serve-hit arithmetic identical to a
+    build without the hooks (``serve:burst:2`` still means the second
+    router tick). Returns ordered ``(action, arg)`` pairs."""
+    if fire:
+        inj = _injector()
+        if any(r.site == "serve" and r.action in actions
+               for r in inj._rules):
+            fault_point("serve")
+    inj = _active
+    if inj is None or not inj.serve_events:
+        return []
+    out = [e for e in inj.serve_events if e[0] in actions]
+    if out:
+        inj.serve_events = [e for e in inj.serve_events
+                            if e[0] not in actions]
     return out
 
 

@@ -433,6 +433,64 @@ class TestTimeline:
         report0 = [l for l in lines if l.strip().startswith("0")][0]
         assert "1" in report0  # one recompile on rank 0
 
+    def test_multitenant_summary_lines(self, tmp_path):
+        """ISSUE 18: the prefix-cache / disagg / adapter-residency
+        summary renders from the CUMULATIVE decode_metrics counters
+        (last row per stream) plus the disagg_prefill spans."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "timeline", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), "tools", "timeline.py"))
+        timeline = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(timeline)
+
+        d = str(tmp_path / "obs")
+        os.makedirs(d, exist_ok=True)
+        t0 = 1000.0
+
+        def row(rank, kind, step, dt, payload):
+            return {"v": 1, "kind": kind, "step": step, "time": t0 + dt,
+                    "rank": rank, "payload": payload}
+
+        _write_rank_stream(d, 0, [
+            row(0, "decode_metrics", 1, 1.0,
+                {"steps": 4, "tokens": 9, "inflight_slots": 2,
+                 "queue_depth": 0, "prefix_hits": 1,
+                 "prefix_blocks_shared": 2, "cow_copies": 0,
+                 "adapters_resident": 3}),
+            row(0, "decode_metrics", 2, 2.0,
+                {"steps": 4, "tokens": 9, "inflight_slots": 2,
+                 "queue_depth": 0, "prefix_hits": 3,
+                 "prefix_blocks_shared": 6, "cow_copies": 1,
+                 "adapters_resident": 3}),
+            row(0, "decode_request", 2, 2.1,
+                {"rid": "a", "tokens": 8, "latency_ms": 5.0,
+                 "prefill_ms": 1.0, "ms_per_token": 0.6}),
+            row(0, "decode_request", 2, 2.2,
+                {"rid": "b", "tokens": 8, "latency_ms": 5.0,
+                 "prefill_ms": 0.2, "ms_per_token": 0.6}),
+            row(0, "decode_request", 2, 2.3,
+                {"rid": "c", "tokens": 8, "latency_ms": 5.0,
+                 "prefill_ms": 0.2, "ms_per_token": 0.6}),
+            row(0, "decode_request", 2, 2.4,
+                {"rid": "d", "tokens": 8, "latency_ms": 5.0,
+                 "prefill_ms": 0.2, "ms_per_token": 0.6}),
+            row(0, "span", 1, 0.5,
+                {"name": "disagg_prefill", "trace_id": "t1",
+                 "rid": "a", "prefill_host": 0, "to_host": 0,
+                 "blocks": 2, "bytes": 4096, "ctx": 16,
+                 "dur_ms": 3.0}),
+        ])
+        _, _, _, lines = timeline.merge(d)
+        text = "\n".join(lines)
+        # the LAST (cumulative) row counts, not the sum of rows
+        assert ("prefix cache: 3 hit(s) (75% of 4 request(s)), "
+                "6 block prefill(s) saved, 1 CoW cop(ies)") in text
+        assert "disaggregated prefill: 1 handoff(s)" in text
+        assert "adapters resident: rank 0=3" in text
+
     def test_cli_end_to_end(self, tmp_path):
         import subprocess
         import sys

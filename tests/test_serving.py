@@ -592,3 +592,161 @@ class TestDecodeTelemetry:
         rows = [r for r in bus.read_stream(str(tmp_path / "b1.jsonl"))
                 if r["kind"] == "decode_metrics"]
         assert rows
+
+
+# ---------------------------------------------------------------------------
+# refcounted CoW prefix cache — host-side units (ISSUE 18; the engine
+# E2E half lives in test_serving_multitenant.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixCacheUnit:
+    """Pure-host index semantics over a real BlockPool — no jax, no
+    engine: the fast early-sorting half of the round-18 contract."""
+
+    def _cache_pool(self, blocks=16, bs=4, capacity=None):
+        from paddle_tpu.serving.paged_kv import BlockPool
+        from paddle_tpu.serving.prefix_cache import PrefixCache
+
+        return PrefixCache(bs, capacity=capacity), BlockPool(blocks)
+
+    def _publish(self, px, pool, prompt):
+        n = len(prompt) // px.block
+        table = pool.alloc(n + 1)  # +1: the decode tail block
+        px.publish(pool, prompt, table)
+        return table
+
+    def test_chain_hash_commits_to_whole_prefix(self):
+        from paddle_tpu.serving.prefix_cache import chain_hash
+
+        a = chain_hash(0, [1, 2, 3, 4])
+        b = chain_hash(a, [5, 6, 7, 8])
+        # same second block under a different first block: the chained
+        # key differs — block j commits to every token before it
+        a2 = chain_hash(0, [9, 2, 3, 4])
+        assert chain_hash(a2, [5, 6, 7, 8]) != b
+        assert chain_hash(a, [5, 6, 7, 8]) == b  # deterministic
+
+    def test_lookup_partial_and_full_match_plans(self):
+        px, pool = self._cache_pool()
+        prompt = list(range(10, 22))  # 3 full blocks of 4
+        table = self._publish(px, pool, prompt)
+        # cold different prompt: miss
+        assert px.lookup([1, 2, 3, 4, 5]) is None
+        # longer prompt sharing the first 2 blocks: partial match,
+        # no CoW, tail starts at the first unshared position
+        sh = px.lookup(prompt[:8] + [40, 41, 42, 43, 44])
+        assert sh.src_blocks == table[:2]
+        assert sh.ref_blocks == table[:2]
+        assert sh.cow_src is None and sh.tail_start == 8
+        # the exact prompt: full match — last shared block must CoW
+        # (the decode loop re-runs the final prompt token's forward)
+        sh = px.lookup(list(prompt))
+        assert sh.src_blocks == table[:3]
+        assert sh.ref_blocks == table[:2]
+        assert sh.cow_src == table[2] and sh.tail_start == len(prompt) - 1
+        # a prompt diverging INSIDE block 0 misses entirely
+        assert px.lookup([99] + prompt[1:]) is None
+
+    def test_publish_refcounts_and_release_on_evict(self):
+        px, pool = self._cache_pool()
+        prompt = list(range(8))  # 2 full blocks
+        table = self._publish(px, pool, prompt)
+        assert pool.refcount(table[0]) == 2  # slot + index
+        assert pool.refcount(table[1]) == 2
+        assert len(px) == 2
+        # re-publishing the same chain only touches LRU: no new refs
+        px.publish(pool, prompt, table)
+        assert pool.refcount(table[0]) == 2
+        # the slot retires: blocks survive, held by the index alone
+        pool.release(table)
+        assert pool.refcount(table[0]) == 1
+        free0 = pool.free
+        px.clear(pool)
+        assert pool.refcount(table[0]) == 0
+        assert pool.free == free0 + 2  # both cached entries freed
+
+    def test_eviction_is_lru_and_idle_only(self):
+        px, pool = self._cache_pool(blocks=32)
+        a = self._publish(px, pool, list(range(0, 8)))
+        b = self._publish(px, pool, list(range(100, 108)))
+        # `a`'s slot keeps its refs (busy); `b`'s slot retires (idle)
+        pool.release(b)
+        need = pool.free + 1
+        px.evict_for(pool, need)
+        # only b's entries were evictable; a's (refcount 2) survived
+        assert px.lookup(list(range(0, 8))) is not None
+        assert px.lookup(list(range(100, 108))) is None
+
+    def test_capacity_bound_evicts_oldest_subtree(self):
+        px, pool = self._cache_pool(blocks=32, capacity=2)
+        a = self._publish(px, pool, list(range(0, 8)))
+        pool.release(a)  # idle: evictable
+        self._publish(px, pool, list(range(100, 108)))
+        assert len(px) == 2
+        # the oldest (a's) chain was cascaded out root-first: evicting
+        # the parent never strands an unreachable child
+        assert px.lookup(list(range(0, 8))) is None
+        assert px.lookup(list(range(100, 108))) is not None
+
+    def test_poison_forces_miss_never_wrong_kv(self):
+        px, pool = self._cache_pool()
+        prompt = list(range(8))
+        self._publish(px, pool, prompt)
+        assert px.lookup(list(prompt)) is not None
+        assert px.poison(0) is True
+        assert px.poisoned == 1
+        # the chain walk computes the TRUE hash and finds nothing: a
+        # full prefill, not stale KV
+        assert px.lookup(list(prompt)) is None
+
+
+class TestAdapterSetUnit:
+    """Adapter-fleet residency + delta math vs the dense per-slot
+    numpy reference (ISSUE 18 pillar 3 units; E2E mixed-batch parity
+    lives in test_serving_multitenant.py)."""
+
+    def _fleet(self, n=4, rank=3, scale=0.25):
+        from paddle_tpu.serving.adapters import AdapterSet
+
+        m = _tiny_lm()
+        return m, AdapterSet(m, n_adapters=n, rank=rank, scale=scale)
+
+    def test_lifecycle_and_id_checks(self, trivial_mesh):
+        from paddle_tpu.serving.adapters import AdapterSet
+
+        m, ad = self._fleet()
+        assert ad.resident == [0]
+        assert ad.is_loaded(0) and not ad.is_loaded(1)
+        ad.load(1, seed=11)
+        ad.load(3, seed=12)
+        assert ad.resident == [0, 1, 3]
+        with pytest.raises(ValueError, match="out of range"):
+            ad.load(0)  # row 0 is the reserved base row
+        with pytest.raises(ValueError, match="out of range"):
+            ad.load(4)
+        ad.unload(1)
+        assert not ad.is_loaded(1)
+        with pytest.raises(ValueError, match="n_adapters"):
+            AdapterSet(_tiny_lm(), n_adapters=1)
+
+    def test_delta_matches_dense_reference(self, trivial_mesh):
+        m, ad = self._fleet()
+        ad.load(2, seed=5)
+        blk = m.blocks[0]
+        rng = np.random.RandomState(0)
+        x = rng.normal(size=(3, 4, 32)).astype(np.float32)
+        ids = np.array([0, 2, 2], np.int32)
+        out = np.asarray(blk._adapter_delta(
+            paddle.to_tensor(x), paddle.to_tensor(ids))._data)
+        a, b = ad.weights[2][0]
+        want = 0.25 * np.einsum(
+            "btr,fr->btf", np.einsum("btd,rd->btr", x, a), b)
+        assert np.all(out[0] == 0.0)  # id 0 adds EXACT zeros
+        assert np.allclose(out[1:], want[1:], atol=1e-5)
+        # unloading zeroes the resident rows: the compiled step (which
+        # re-reads the same buffers) collapses to the base path
+        ad.unload(2)
+        out2 = np.asarray(blk._adapter_delta(
+            paddle.to_tensor(x), paddle.to_tensor(ids))._data)
+        assert np.all(out2 == 0.0)

@@ -1,0 +1,542 @@
+"""Multi-tenant serving plane (ISSUE 18): the heavy E2E half.
+
+Acceptance contracts tested here (fast units live early, in
+test_serving.py):
+- a second request over a shared preamble re-prefills ONLY the
+  unshared tail (``_n_steps``-counted), token-identical to the
+  prefix-cache-off run — and concurrent full-prefix borrowers CoW the
+  last shared block, so divergent continuations never corrupt the
+  cached entry;
+- admission charges the pool only the UNSHARED block demand;
+- ``retire_slots`` under an ACTIVE shared prefix relocates without
+  corrupting the survivor or leaking refcounts (the round-17 plane
+  meets the round-18 cache);
+- disaggregated prefill/decode hands off over the round-17 bundle
+  ladder token-exactly, and ``PADDLE_SERVE_DISAGG=0`` restores
+  colocated behavior end-to-end;
+- a mixed-adapter batch matches per-adapter sequential runs on ONE
+  compiled step (recompile-ledger), adapter 0 being the base model
+  bit-for-bit;
+- injected ``serve:prefix_stale`` forces a MISS (full re-prefill,
+  never wrong-prefix KV) and ``serve:adapter_missing`` rejects
+  cleanly with ``router_admit.reason=adapter``; wrong-site rules are
+  rejected loudly at parse time;
+- the launcher dryrun runs a DEDICATED prefill worker
+  (``PADDLE_SERVE_ROLE=prefill:1``) feeding a decode worker over the
+  mailbox blob transport.
+
+This file sorts AFTER test_serving_migration.py on purpose: compiled
+engine fleets and subprocess dryruns are the suite's heavy tail.
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.observability import bus
+from paddle_tpu.serving.router import (
+    FileHost, FilePrefillHost, LocalHost, PrefillHost, Router,
+    sim_next_token,
+)
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _restore_mesh():
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+@pytest.fixture()
+def trivial_mesh():
+    from paddle_tpu.distributed import comm
+
+    prev = comm._state.hybrid_mesh
+    comm._state.hybrid_mesh = None
+    comm.init_hybrid_mesh(dp=1, mp=1, pp=1, sp=1)
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+@pytest.fixture()
+def obs_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "obs")
+    os.makedirs(d, exist_ok=True)
+    monkeypatch.setenv("PADDLE_OBS_DIR", d)
+    bus.reset()
+    yield d
+    bus.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PADDLE_FAULT_SPEC", raising=False)
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _tiny_lm(vocab=48, cap=64, layers=2, heads=4, d=32, seed=7):
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import TransformerLM
+
+    paddle.seed(seed)
+    m = TransformerLM(vocab, d_model=d, num_heads=heads,
+                      num_layers=layers, max_position=cap)
+    m.eval()
+    return m
+
+
+def _sim_chain(prompt, n):
+    chain = list(prompt)
+    out = []
+    for _ in range(n):
+        t = sim_next_token(chain)
+        chain.append(t)
+        out.append(t)
+    return out
+
+
+def _fast_router(hosts, **kw):
+    kw.setdefault("host_timeout_ms", 120)
+    kw.setdefault("retry_backoff_ms", 25)
+    kw.setdefault("retry_max", 2)
+    kw.setdefault("avg_new_tokens", 8)
+    return Router(hosts, **kw)
+
+
+def _oracle(model, prompt, budget, adapter=0):
+    """Prefix-cache-OFF single-request reference run."""
+    from paddle_tpu.serving import InferenceEngine, Request
+
+    eng = InferenceEngine(model, slots=2, max_length=64, sync_every=4,
+                          block_size=8, prefix_cache=False)
+    eng.submit(Request(list(prompt), max_new_tokens=budget, rid="u",
+                       adapter=adapter))
+    return eng.run()["u"].tokens
+
+
+def _px_engine(m, **kw):
+    from paddle_tpu.serving import InferenceEngine
+
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_length", 64)
+    kw.setdefault("sync_every", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefix_cache", True)
+    return InferenceEngine(m, **kw)
+
+
+# ---------------------------------------------------------------------------
+# refcounted CoW prefix cache on a REAL engine
+# ---------------------------------------------------------------------------
+
+
+PREAMBLE = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]  # 2 blocks
+
+
+class TestPrefixSharingE2E:
+    def test_shared_preamble_prefills_tail_only(self, trivial_mesh):
+        from paddle_tpu.serving import Request
+
+        m = _tiny_lm()
+        prompt = PREAMBLE + [27]  # 2 shared blocks + a 1-token tail
+        budget = 8
+        oracle = _oracle(m, prompt, budget)
+        # chunked prefill makes the step COUNT observable: a cold
+        # 17-token prompt takes ceil(17/8)=3 chunk invocations, the
+        # warm borrower exactly one single-shot tail window
+        eng = _px_engine(m, prefill_chunk=8)
+        eng.submit(Request(list(prompt), max_new_tokens=budget,
+                           rid="cold"))
+        cold = eng.run()["cold"].tokens
+        assert cold == oracle  # the cache never changes tokens
+        steps_cold = eng._prefill._n_steps
+        assert steps_cold == 3
+        eng.submit(Request(list(prompt), max_new_tokens=budget,
+                           rid="warm"))
+        warm = eng.run()["warm"].tokens
+        assert warm == oracle  # bit-identical to the cold run
+        # THE tentpole pin: zero PrefillStep work for the shared
+        # blocks — one call, for the one-token unshared tail
+        assert eng._prefill._n_steps - steps_cold == 1
+        assert eng._prefix_hits == 1
+        assert eng._prefix_blocks_shared == 2
+
+    def test_cow_isolation_divergent_continuations(self, trivial_mesh):
+        from paddle_tpu.serving import Request
+
+        m = _tiny_lm()
+        o6 = _oracle(m, PREAMBLE, 6)
+        o12 = _oracle(m, PREAMBLE, 12)
+        eng = _px_engine(m, slots=3)
+        eng.submit(Request(list(PREAMBLE), max_new_tokens=6, rid="a"))
+        assert eng.run()["a"].tokens == o6
+        # two CONCURRENT full-prefix borrowers: both CoW the last
+        # shared block and decode divergent lengths side by side
+        eng.submit(Request(list(PREAMBLE), max_new_tokens=6, rid="b"))
+        eng.submit(Request(list(PREAMBLE), max_new_tokens=12, rid="c"))
+        out = eng.run()
+        assert out["b"].tokens == o6
+        assert out["c"].tokens == o12
+        assert eng._prefix_hits == 2
+        assert eng._cow_copies == 2
+        # the writers never touched the CACHED block: a later borrower
+        # still hits and still matches the oracle
+        eng.submit(Request(list(PREAMBLE), max_new_tokens=6, rid="d"))
+        assert eng.run()["d"].tokens == o6
+        assert eng._prefix_hits == 3
+
+    def test_admission_charges_unshared_blocks_only(self, trivial_mesh):
+        from paddle_tpu.serving import Request
+
+        m = _tiny_lm()
+        prompt_b = PREAMBLE + [40]
+        o_b = _oracle(m, prompt_b, 7)
+        # pool of 5 usable blocks; both requests need 3 charged cold
+        eng = _px_engine(m, pool_blocks=6)
+        eng.submit(Request(list(PREAMBLE), max_new_tokens=8, rid="a"))
+        eng.run()
+        assert len(eng._prefix) == 2  # preamble published (2 blocks)
+        # squat on 2 blocks: free=1 < the cold charge of 3 — only the
+        # shared-demand discount can admit the borrower now
+        held = eng._pool.alloc(2)
+        assert held is not None and eng._pool.free == 1
+        eng.submit(Request(list(prompt_b), max_new_tokens=7, rid="b"))
+        out = eng.run()
+        assert out["b"].tokens == o_b
+        assert eng._admit_deferred == 0  # never deferred
+        assert eng._prefix_hits == 1
+        assert len(eng._prefix) == 2    # and nothing was evicted
+        eng._pool.release(held)
+
+    def test_retire_slots_under_active_shared_prefix(self, trivial_mesh):
+        from paddle_tpu.serving import Request
+
+        m = _tiny_lm()
+        tails = {f"r{i}": PREAMBLE + [20 + i] for i in range(4)}
+        eng = _px_engine(m, slots=4, sync_every=2)
+        eng.submit(Request(list(PREAMBLE), max_new_tokens=4, rid="pub"))
+        eng.run()
+        for rid, prompt in tails.items():
+            eng.submit(Request(list(prompt), max_new_tokens=12,
+                               rid=rid))
+        results = {}
+        deadline = time.time() + 30
+        while time.time() < deadline and not all(
+                eng.progress().get(r) for r in tails):
+            eng.turn(results)
+        assert eng._prefix_hits == 4  # every borrower shares 2 blocks
+        top_slot = max(s for s in eng._active)
+        keep = eng._active[top_slot].req.rid
+        for rid in tails:
+            if rid != keep:
+                assert eng.cancel(rid) is True
+        pre_tokens = list(eng.progress()[keep])
+        pre_steps = eng._prefill._n_steps
+        still = eng.retire_slots(2)
+        # the borrower relocated low (extract -> splice, no prefill)
+        # even though its table leads with SHARED refcounted blocks
+        assert still == [] and eng.slots == 2
+        out = eng.run()
+        oracle = _oracle(m, tails[keep], 12)
+        assert out[keep].tokens == oracle
+        assert out[keep].tokens[: len(pre_tokens)] == pre_tokens
+        assert eng._prefill._n_steps == pre_steps
+        # no refcount leak: with every slot idle the pool holds ONLY
+        # the published entries, each at exactly one (index) ref
+        assert not eng._active and not eng._pending
+        share = eng._prefix.lookup(list(PREAMBLE))
+        assert share is not None
+        for b in share.src_blocks:
+            assert eng._pool.refcount(b) == 1
+        # and the survivor cache still serves token-exact borrowers
+        eng.submit(Request(list(PREAMBLE), max_new_tokens=4, rid="z"))
+        assert eng.run()["z"].tokens == _oracle(m, PREAMBLE, 4)
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode
+# ---------------------------------------------------------------------------
+
+
+class TestDisaggregation:
+    def _fleet(self, m):
+        from paddle_tpu.serving import InferenceEngine
+
+        hosts = [LocalHost(InferenceEngine(m, slots=2, max_length=64,
+                                           sync_every=4, block_size=8))
+                 for _ in range(2)]
+        ph = PrefillHost(InferenceEngine(m, slots=2, max_length=64,
+                                         sync_every=4, block_size=8))
+        return hosts, ph
+
+    def _drive(self, router, hosts, rid, deadline_s=30):
+        deadline = time.time() + deadline_s
+        while rid not in router.completed and time.time() < deadline:
+            router.tick()
+            for h in hosts:
+                h.pump()
+            time.sleep(0.01)
+        return router.completed[rid]
+
+    def test_handoff_token_exact_zero_decode_prefill(self,
+                                                     trivial_mesh):
+        m = _tiny_lm()
+        prompt, budget = [4, 5, 6, 7], 10
+        oracle = _oracle(m, prompt, budget)
+        hosts, ph = self._fleet(m)
+        router = _fast_router(hosts, prefill_hosts=[ph])
+        placed = router.submit({"rid": "d", "prompt_ids": list(prompt),
+                                "max_new_tokens": budget})
+        assert placed in (0, 1)  # a DECODE host, not the prefill tier
+        got = self._drive(router, hosts, "d")
+        assert got["tokens"] == oracle
+        assert router.disagg_prefills == 1
+        assert router.disagg_fallbacks == 0
+        # decode tier never prefilled: it resumed from spliced blocks
+        assert hosts[placed].engine._prefill._n_steps == 0
+        # and the prefill tier released the slot after the handoff
+        assert ph.engine.progress() == {}
+        assert ph.engine.inflight() == 0
+
+    def test_off_switch_restores_colocated(self, trivial_mesh,
+                                           monkeypatch):
+        monkeypatch.setenv("PADDLE_SERVE_DISAGG", "0")
+        m = _tiny_lm()
+        prompt, budget = [4, 5, 6, 7], 10
+        oracle = _oracle(m, prompt, budget)
+        hosts, ph = self._fleet(m)
+        router = _fast_router(hosts, prefill_hosts=[ph])
+        router.submit({"rid": "c", "prompt_ids": list(prompt),
+                       "max_new_tokens": budget})
+        got = self._drive(router, hosts, "c")
+        assert got["tokens"] == oracle
+        assert router.disagg_prefills == 0
+        # the prefill tier was configured but never exercised
+        assert ph.engine._prefill._n_steps == 0
+
+    def test_single_token_requests_stay_colocated(self, trivial_mesh):
+        m = _tiny_lm()
+        hosts, ph = self._fleet(m)
+        router = _fast_router(hosts, prefill_hosts=[ph])
+        router.submit({"rid": "one", "prompt_ids": [4, 5, 6],
+                       "max_new_tokens": 1})
+        got = self._drive(router, hosts, "one")
+        assert got["tokens"] == _oracle(m, [4, 5, 6], 1)
+        # nothing to hand off: a 1-token budget ends at activation
+        assert router.disagg_prefills == 0
+        assert ph.engine._prefill._n_steps == 0
+
+
+# ---------------------------------------------------------------------------
+# adapter fleets on the engine
+# ---------------------------------------------------------------------------
+
+
+class TestAdapterFleetE2E:
+    def test_mixed_batch_matches_sequential(self, trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine, Request
+        from paddle_tpu.serving.adapters import AdapterSet
+
+        m = _tiny_lm()
+        ad = AdapterSet(m, n_adapters=4, rank=2, scale=1.0)
+        ad.load(1, seed=21)
+        ad.load(2, seed=22)
+        prompt, budget = [5, 6, 7, 8], 8
+        # attach BEFORE building engines: the compiled steps snapshot
+        # the stacked buffers at construction
+        eng = InferenceEngine(m, slots=3, max_length=64, sync_every=4,
+                              block_size=8)
+        for a in (0, 1, 2):
+            eng.submit(Request(list(prompt), max_new_tokens=budget,
+                               rid=f"a{a}", adapter=a))
+        mixed = eng.run()
+        # ONE compiled step served the whole heterogeneous fleet
+        assert eng._decode.compiles == 1
+        seq = InferenceEngine(m, slots=2, max_length=64, sync_every=4,
+                              block_size=8)
+        for a in (0, 1, 2):
+            seq.submit(Request(list(prompt), max_new_tokens=budget,
+                               rid=f"s{a}", adapter=a))
+            got = seq.run()[f"s{a}"]
+            assert mixed[f"a{a}"].tokens == got.tokens, f"adapter {a}"
+        # adapter 0 IS the base model, bit-for-bit: a fresh same-seed
+        # model without any fleet attached produces the same stream
+        assert mixed["a0"].tokens == _oracle(_tiny_lm(), prompt, budget)
+
+    def test_unloaded_adapter_rejected_at_submit(self, trivial_mesh):
+        from paddle_tpu.serving import InferenceEngine, Request
+        from paddle_tpu.serving.adapters import AdapterSet
+
+        m = _tiny_lm()
+        ad = AdapterSet(m, n_adapters=4, rank=2)
+        ad.load(1)
+        eng = InferenceEngine(m, slots=2, max_length=64, sync_every=4,
+                              block_size=8)
+        with pytest.raises(ValueError, match="adapter 3"):
+            eng.submit(Request([5, 6], max_new_tokens=4, rid="x",
+                               adapter=3))
+        # the reject left the engine serviceable
+        eng.submit(Request([5, 6], max_new_tokens=4, rid="ok",
+                           adapter=1))
+        assert len(eng.run()["ok"].tokens) == 4
+
+
+# ---------------------------------------------------------------------------
+# injected multi-tenant faults
+# ---------------------------------------------------------------------------
+
+
+class TestMultitenantFaults:
+    def test_prefix_stale_misses_never_serves_wrong_kv(self,
+                                                       trivial_mesh,
+                                                       monkeypatch):
+        from paddle_tpu.serving import Request
+
+        # nth=2: the FIRST lookup (cold admission) stays clean so the
+        # preamble publishes; the SECOND (the would-be hit) is bitten
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "serve:prefix_stale:2")
+        fi.reset()
+        m = _tiny_lm()
+        prompt = PREAMBLE + [27]
+        oracle = _oracle(m, prompt, 6)
+        eng = _px_engine(m, prefill_chunk=8)
+        eng.submit(Request(list(prompt), max_new_tokens=6, rid="a"))
+        assert eng.run()["a"].tokens == oracle
+        steps_cold = eng._prefill._n_steps
+        eng.submit(Request(list(prompt), max_new_tokens=6, rid="b"))
+        got = eng.run()["b"].tokens
+        # the poisoned entry MISSED: a full (3-chunk) re-prefill ran
+        # instead of a stale-hash hit serving wrong-prefix KV
+        assert got == oracle
+        assert eng._prefix.poisoned == 1
+        assert eng._prefix_hits == 0
+        assert eng._prefill._n_steps - steps_cold == steps_cold
+
+    def test_adapter_missing_rejects_cleanly(self, trivial_mesh,
+                                             obs_dir, monkeypatch):
+        from paddle_tpu.serving import InferenceEngine
+        from paddle_tpu.serving.adapters import AdapterSet
+
+        monkeypatch.setenv("PADDLE_FAULT_SPEC",
+                           "serve:adapter_missing:1")
+        fi.reset()
+        m = _tiny_lm()
+        ad = AdapterSet(m, n_adapters=4, rank=2)
+        ad.load(1)
+        host = LocalHost(InferenceEngine(m, slots=2, max_length=64,
+                                         sync_every=4, block_size=8))
+        router = _fast_router([host])
+        # the armed fault rewrites THIS submit to an unloaded id: the
+        # fleet has no eligible host, so admission sheds it — a reject,
+        # not a crash
+        assert router.submit({"rid": "bad", "prompt_ids": [3, 4, 5],
+                              "max_new_tokens": 6}) is None
+        assert router.rejected == 1
+        # the NEXT submit is untouched and completes normally
+        assert router.submit({"rid": "ok", "prompt_ids": [3, 4, 5],
+                              "max_new_tokens": 6}) == 0
+        deadline = time.time() + 30
+        while "ok" not in router.completed and time.time() < deadline:
+            router.tick()
+            host.pump()
+            time.sleep(0.01)
+        assert router.completed["ok"]["tokens"] == _oracle(m, [3, 4, 5],
+                                                           6)
+        bus.reset()  # flush rows before reading them back
+        rows = [json.loads(ln) for ln in
+                open(os.path.join(obs_dir, "telemetry.rank0.jsonl"))]
+        rej = [r["payload"] for r in rows
+               if r["kind"] == "router_admit"
+               and r["payload"].get("rid") == "bad"]
+        assert rej and rej[0]["reason"] == "adapter"
+
+    def test_wrong_site_rules_rejected_loudly(self):
+        with pytest.raises(ValueError, match="un-instrumented"):
+            fi.FaultInjector("grad:prefix_stale:1")
+        with pytest.raises(ValueError, match="un-instrumented"):
+            fi.FaultInjector("step:adapter_missing:1")
+
+    def test_multitenant_fault_grammar_and_arming(self):
+        inj = fi.FaultInjector(
+            "serve:prefix_stale:1:3,serve:adapter_missing:2:9")
+        inj.fire("serve")
+        assert ("prefix_stale", 3) in inj.serve_events
+        inj.fire("serve")
+        assert ("adapter_missing", 9) in inj.serve_events
+
+
+# ---------------------------------------------------------------------------
+# the launcher dryrun: a dedicated prefill worker feeds the decode tier
+# ---------------------------------------------------------------------------
+
+
+class TestMultitenantDryrun:
+    def test_dedicated_prefill_worker_hands_off(self, tmp_path,
+                                                monkeypatch):
+        from paddle_tpu.distributed.launch import launch
+
+        base = str(tmp_path / "mail")
+        logs = str(tmp_path / "logs")
+        rc_box = {}
+        # ONE launch, a MIXED fleet: rank 0 decodes, rank 1 serves
+        # prefill-only (the env is inherited by both workers; only the
+        # named rank takes the role)
+        monkeypatch.setenv("PADDLE_SERVE_ROLE", "prefill:1")
+
+        def run():
+            rc_box["rc"] = launch(
+                os.path.join(REPO, "paddle_tpu", "serving",
+                             "router.py"),
+                [REPO, base, "800", "0.02"],
+                nproc_per_node=2, backend="cpu", log_dir=logs)
+
+        t = threading.Thread(target=run)
+        t.start()
+        monkeypatch.setenv("PADDLE_OBS_DIR", logs)
+        bus.reset()
+        decode = FileHost(os.path.join(base, "host0"), 0, obs_dir=logs)
+        pre = FilePrefillHost(os.path.join(base, "host1"), 1,
+                              obs_dir=logs)
+        router = Router([decode], prefill_hosts=[pre], admit_queue=32,
+                        avg_new_tokens=24)
+        prompts = {}
+        for i in range(2):
+            rid = f"d{i}"
+            prompts[rid] = [i + 3, i + 4]
+            router.submit({"rid": rid, "prompt_ids": prompts[rid],
+                           "max_new_tokens": 24})
+        deadline = time.time() + 45
+        while len(router.completed) < 2 and time.time() < deadline:
+            router.tick()
+            time.sleep(0.02)
+        open(os.path.join(base, "stop"), "w").close()
+        t.join(timeout=60)
+        bus.reset()
+        assert rc_box.get("rc") == 0
+        assert len(router.completed) == 2
+        assert router.disagg_prefills == 2
+        for rid, prompt in prompts.items():
+            assert router.completed[rid]["tokens"] == _sim_chain(
+                prompt, 24), rid
+        assert router.duplicates == 0
+        # the prefill worker's telemetry names every proactive handoff
+        rows = [json.loads(ln) for ln in
+                open(os.path.join(logs, "telemetry.rank1.jsonl"))]
+        extracts = [r for r in rows if r["kind"] == "kv_extract"]
+        assert len(extracts) == 2
+        assert all(r["payload"].get("prefill") for r in extracts)
+        # no orphaned bundle blob left behind on either side
+        for hd in ("host0", "host1"):
+            outbox = os.path.join(base, hd, "outbox")
+            if os.path.isdir(outbox):
+                assert not [n for n in os.listdir(outbox)
+                            if n.startswith("kv_")]

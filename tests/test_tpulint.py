@@ -465,6 +465,86 @@ class TestSpeculativePagedContract:
         assert not [f for f in findings if not f.suppressed]
 
 
+class TestPrefixHashContract:
+    """ISSUE 18 satellite: the prefix cache's content hashing is HOST
+    work by design — chained per-block CRCs over prompt ints at the
+    TURN BOUNDARY (publish/lookup). The failure mode is hashing inside
+    the compiled step: a per-block host loop reading tracers to feed
+    `zlib.crc32`, one device sync per block per admission. The same
+    `*Step` compiled-by-contract list polices it — no new rule."""
+
+    # the tempting-but-wrong shape: hash the prompt blocks inside the
+    # prefill step body, reading each traced block back to the host
+    PRE_FIX = """
+        import zlib
+        import numpy as np
+
+        class PrefillStep:
+            def _step_fn(self, p_raws, cache_raws, ids, lens):
+                h = 0
+                for b in range(ids.shape[1] // 8):
+                    row = np.asarray(ids[0, b * 8:(b + 1) * 8])
+                    h = zlib.crc32(row.tobytes(), h)  # host, per block
+                logits = (p_raws[0] * ids).sum(-1)
+                return logits.argmax(-1), cache_raws, h
+    """
+    # the shipped shape: the step stays pure; the ENGINE hashes the
+    # already-host prompt ints at its scheduling turn, then publishes
+    FIXED = """
+        import zlib
+        import numpy as np
+
+        class PrefillStep:
+            def _step_fn(self, p_raws, cache_raws, ids, lens):
+                logits = (p_raws[0] * ids).sum(-1)
+                return logits.argmax(-1), cache_raws, lens
+
+        class Engine:
+            def publish_turn(self, cache, pool, prompt_ids, table):
+                h = 0
+                for b in range(len(prompt_ids) // 8):   # host ints
+                    row = np.asarray(prompt_ids[b * 8:(b + 1) * 8],
+                                     np.int64)
+                    h = zlib.crc32(row.tobytes(), h)
+                cache.publish(pool, prompt_ids, table)
+                return h
+    """
+
+    def test_step_fn_compiled_by_contract(self):
+        import ast
+
+        from tools.tpulint import astutil
+
+        graph = astutil.ModuleGraph(
+            ast.parse(textwrap.dedent(self.PRE_FIX)))
+        assert ("PrefillStep", "_step_fn") in graph.compiled
+
+    def test_in_step_hash_loop_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX},
+                      rule="host-sync-in-step")
+        msgs = "\n".join(f.message for f in names(fs,
+                                                  "host-sync-in-step"))
+        assert "np.asarray" in msgs, msgs
+
+    def test_turn_boundary_hashing_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED},
+                      rule="host-sync-in-step")
+        assert not names(fs, "host-sync-in-step")
+
+    def test_real_multitenant_modules_quiet(self):
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "serving",
+                          "prefix_cache.py"),
+             os.path.join(REPO, "paddle_tpu", "serving",
+                          "adapters.py"),
+             os.path.join(REPO, "paddle_tpu", "serving", "engine.py"),
+             os.path.join(REPO, "paddle_tpu", "serving", "router.py")],
+            root=REPO,
+        )
+        assert not errors
+        assert not [f for f in findings if not f.suppressed]
+
+
 class TestDonationAlias:
     # PR-5 pre-fix: the guard carry donated alongside params/opt state
     PRE_FIX_CARRY = """

@@ -99,7 +99,9 @@ _COUNTERS = ("loss", "step_ms", "tokens_per_sec", "examples_per_sec",
 
 #: counter tracks extracted from decode_metrics payloads (ISSUE 13)
 _DECODE_COUNTERS = ("tokens_per_sec", "queue_depth", "inflight_slots",
-                    "ttft_ms", "blocks_in_use", "block_occupancy")
+                    "ttft_ms", "blocks_in_use", "block_occupancy",
+                    "prefix_hits", "prefix_blocks_shared", "cow_copies",
+                    "adapters_resident")
 
 
 def chrome_trace(streams: Dict[int, List[dict]],
@@ -519,6 +521,47 @@ def summarize(streams: Dict[int, List[dict]],
                             sorted(mig_fail.items()))
             line += f"; fell back to re-prefill: {why}"
         lines.append(line)
+    # multi-tenant serving (ISSUE 18): the prefix-cache counters ride
+    # the decode_metrics cadence as CUMULATIVE host ints — the last row
+    # per stream is the story; requests completed give the hit rate's
+    # denominator. Adapter residency renders per host.
+    px_hits, px_blocks, px_cow, px_reqs = 0, 0, 0, 0
+    adapters: Dict[int, int] = {}
+    disagg_n = 0
+    for rank, rows in streams.items():
+        last_px = None
+        for r in rows:
+            p = r.get("payload")
+            if not isinstance(p, dict):
+                continue
+            k = r.get("kind")
+            if k == "decode_metrics":
+                if "prefix_hits" in p:
+                    last_px = p
+                if "adapters_resident" in p:
+                    adapters[rank] = int(p["adapters_resident"])
+            elif k == "decode_request":
+                px_reqs += 1
+            elif k == "span" and p.get("name") == "disagg_prefill":
+                disagg_n += 1
+        if last_px is not None:
+            px_hits += int(last_px.get("prefix_hits") or 0)
+            px_blocks += int(last_px.get("prefix_blocks_shared") or 0)
+            px_cow += int(last_px.get("cow_copies") or 0)
+    if px_hits or px_blocks:
+        line = f"prefix cache: {px_hits} hit(s)"
+        if px_reqs:
+            line += f" ({px_hits / px_reqs * 100.0:.0f}% of " \
+                    f"{px_reqs} request(s))"
+        line += f", {px_blocks} block prefill(s) saved, " \
+                f"{px_cow} CoW cop(ies)"
+        lines.append(line)
+    if disagg_n:
+        lines.append(f"disaggregated prefill: {disagg_n} handoff(s) "
+                     f"to the decode tier")
+    if adapters:
+        lines.append("adapters resident: " + ", ".join(
+            f"rank {r}={n}" for r, n in sorted(adapters.items())))
     # co-tenancy controller (ISSUE 16): the lend/reclaim trajectory —
     # committed transitions, aborts, recoveries, and what each cost
     ctl = {"lend": 0, "reclaim": 0, "abort": 0, "recover": 0}
