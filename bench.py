@@ -498,6 +498,148 @@ def _bench_gpt_dp_q8(steps=10, seq=1024, quant=True):
     return out
 
 
+def _bench_gpt_q8m(steps=10, batch=4, seq=1024, quant=True):
+    """GPT-medium training step with int8 Adam moments (ISSUE 19):
+    `strategy.quantized_moments = "int8"` stores moment1/moment2 as
+    int8 payload + per-block f32 scales (moment2 in sqrt domain) and
+    the compiled apply dequantizes/requantizes around the unchanged
+    AdamW rule. The `gpt_medium_bf16_q8m_*` / `*_q8m_off_*` pair lands
+    under the tools/bench_continuity.py >10% gate; the static
+    moment-byte estimate rides report-only."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.jit import TrainStep
+
+    try:
+        paddle.seed(0)
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        if quant:
+            strategy.quantized_moments = "int8"
+        fleet.init(is_collective=True, strategy=strategy)
+        model = _gpt_medium()
+        opt = fleet.distributed_optimizer(
+            optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                            parameters=model.parameters()),
+            strategy=strategy,
+        )
+
+        def lm_loss(h, labels):
+            d = h.shape[-1]
+            return nn.functional.fused_linear_cross_entropy(
+                h.reshape([-1, d]), model.head.weight, model.head.bias,
+                labels.reshape([-1]),
+            )
+
+        step = TrainStep(model, lm_loss, opt)
+        ids = jax.device_put(jnp.asarray(
+            (np.arange(batch * seq) % 31000).reshape(batch, seq)
+            .astype(np.int32)
+        ))
+        labels = jax.device_put(jnp.asarray(
+            ((np.arange(batch * seq) + 1) % 31000).reshape(batch, seq)
+            .astype(np.int32)
+        ))
+        _ = np.asarray(ids.ravel()[:1])
+
+        t0 = time.perf_counter()
+        loss = step(ids, labels)
+        _ = np.asarray(loss._data)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(ids, labels)
+        _ = np.asarray(loss._data)
+        dt = time.perf_counter() - t0
+        tok_s = steps * batch * seq / dt
+        minfo = step._moment_bytes_info
+    finally:
+        from paddle_tpu.distributed import comm as _comm
+
+        _comm._state.hybrid_mesh = None
+    tag = "" if quant else "_off"
+    out = {
+        f"gpt_medium_bf16_q8m{tag}_step_ms": round(dt / steps * 1e3, 2),
+        f"gpt_medium_bf16_q8m{tag}_tokens_per_sec": round(tok_s, 0),
+        f"gpt_medium_bf16_q8m{tag}_compile_s": round(compile_s, 1),
+    }
+    if quant and minfo:
+        # report-only: resident optimizer-state bytes, payload + scales
+        out["gpt_medium_bf16_q8m_moment_mb"] = round(
+            minfo["bytes_resident"] / 1e6, 1)
+        out["gpt_medium_bf16_q8m_moment_reduction_x"] = \
+            minfo["reduction_x"]
+    return out
+
+
+def _bench_decode_q8w(batch_sizes=(1, 8), prompt_len=128,
+                      new_tokens=64):
+    """Serving bench over an int8 CHECKPOINT (ISSUE 19): the
+    GPT-medium-shaped TransformerLM is block-quantized once via
+    `jit.save_quantized`, reloaded narrow (`load_quantized`: int8
+    payload becomes the resident weight, scales attach as buffers, the
+    compiled decode streams the narrow bytes + scales from HBM every
+    token), and generate() prices decode at batch 1/8 next to the
+    full-width `serve_gpt_medium_tokens_per_sec_bN` keys. The
+    checkpoint load itself is timed (`q_ckpt_load_ms`, gated) and the
+    on-disk payload/scale bytes ride report-only."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import DecodeStep, PrefillStep, save_quantized
+    from paddle_tpu.serving import generate
+    from paddle_tpu.serving.model import TransformerLM
+
+    paddle.seed(0)
+    cap = prompt_len + new_tokens
+    model = TransformerLM(32000, d_model=1024, num_heads=16,
+                          num_layers=24, max_position=cap)
+    model.eval()
+    tmp = tempfile.mkdtemp(prefix="q8w_ckpt_")
+    out = {}
+    try:
+        path = os.path.join(tmp, "gpt_medium")
+        info = save_quantized(model, path, dtype="int8")
+        paddle.seed(0)
+        qmodel = TransformerLM(32000, d_model=1024, num_heads=16,
+                               num_layers=24, max_position=cap)
+        qmodel.eval()
+        meta = qmodel.load_quantized(path)
+        out["q_ckpt_load_ms"] = round(meta["load_ms"], 1)
+        # report-only (no _ms/per_sec suffix -> never gated): narrow
+        # checkpoint bytes vs the full-width form it replaces
+        out["q_ckpt_payload_mb"] = round(
+            (info["bytes_payload"] + info["bytes_scales"]) / 1e6, 1)
+        # int8 payload is 1 byte/elem, so the f32 form it replaces is
+        # exactly 4x the payload bytes
+        out["q_ckpt_reduction_x"] = round(
+            4.0 * info["bytes_payload"]
+            / (info["bytes_payload"] + info["bytes_scales"]), 2)
+        pre = PrefillStep(qmodel)
+        dec = DecodeStep(qmodel)
+        for B in batch_sizes:
+            prompts = (np.arange(B * prompt_len) % 31000).reshape(
+                B, prompt_len).astype(np.int32)
+            _ = generate(qmodel, prompts, 2, max_length=cap,
+                         prefill=pre, decode=dec)
+            t0 = time.perf_counter()
+            toks = generate(qmodel, prompts, new_tokens,
+                            max_length=cap, prefill=pre, decode=dec)
+            assert toks.shape == (B, new_tokens)
+            dt = time.perf_counter() - t0
+            out[f"serve_gpt_medium_tokens_per_sec_b{B}_q8w"] = round(
+                B * new_tokens / dt, 1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _bench_decode(batch_sizes=(1, 8, 64), prompt_len=128, new_tokens=64):
     """Serving bench (ISSUE 9): the compiled prefill/decode pair over
     the GPT-medium-shaped TransformerLM (same decoder the training
@@ -1283,6 +1425,24 @@ def main():
         extra.update(q8o_d)
         extra["gpt_medium_bf16_dp_q8_off_tokens_per_sec_spread"] = q8o_sp
 
+    # int8-moment pair (ISSUE 19): AdamW with quantized moment state vs
+    # wide f32 moments, single-mesh — the dequant/requant overhead and
+    # the resident-byte win land under the gate / report-only split
+    _, q8m_d, q8m_sp = _repeat(
+        lambda: (lambda d: (
+            d["gpt_medium_bf16_q8m_tokens_per_sec"], d))(
+            _bench_gpt_q8m(quant=True))
+    )
+    extra.update(q8m_d)
+    extra["gpt_medium_bf16_q8m_tokens_per_sec_spread"] = q8m_sp
+    _, q8mo_d, q8mo_sp = _repeat(
+        lambda: (lambda d: (
+            d["gpt_medium_bf16_q8m_off_tokens_per_sec"], d))(
+            _bench_gpt_q8m(quant=False))
+    )
+    extra.update(q8mo_d)
+    extra["gpt_medium_bf16_q8m_off_tokens_per_sec_spread"] = q8mo_sp
+
     if jax.default_backend() == "tpu":  # compiled pallas is TPU-only
         # single-shot by design: 500 iterations already run inside ONE
         # dispatched lax.scan, so the device time is self-averaged
@@ -1355,6 +1515,18 @@ def main():
         )
         extra.update(mt_bd)
         extra["serve_gpt_medium_ttft_ms_prefix_warm_spread"] = mt_sp
+        # int8-checkpoint decode (ISSUE 19): weights load narrow from a
+        # save_quantized checkpoint and the compiled decode streams
+        # int8 bytes + scales from HBM — b1/b8 tokens/sec next to the
+        # full-width serve keys, checkpoint load time gated, on-disk
+        # byte accounting report-only
+        qw_tok, qw_bd, qw_sp = _repeat(
+            lambda: (lambda d: (
+                d["serve_gpt_medium_tokens_per_sec_b8_q8w"], d))(
+                _bench_decode_q8w())
+        )
+        extra.update(qw_bd)
+        extra["serve_gpt_medium_tokens_per_sec_b8_q8w_spread"] = qw_sp
     # r04 measured the same model/optimizer at batch 64 with two-pass
     # f32-blacklisted batch norm: 41.78 ms / 64 imgs = 1531.7 imgs/sec
     extra["vs_r04_resnet50_bf16"] = round(r50_bf16_ips / 1531.7, 2)

@@ -327,6 +327,10 @@ class Parameter(Tensor):
     __slots__ = (
         "trainable", "optimize_attr", "regularizer", "need_clip", "_tp_spec",
         "_zero_pad",  # (axis, logical_extent) of padded ZeRO storage
+        # per-block f32 scale buffer of a pre-quantized (int8/fp8) matmul
+        # weight — set by distributed/quantized_compute.attach_quantized;
+        # unset (AttributeError -> getattr default) on wide params
+        "_q_scale",
     )
 
     def __init__(self, data, dtype=None, name=None, trainable=True):

@@ -795,6 +795,13 @@ class Fleet:
                 "built; state shards over the FULL dp axis here "
                 "(equivalent to sharding_degree == dp_degree)"
             )
+        if s.quantized_matmul:
+            # compute-width twin of the wire knob (ISSUE 19): resolving
+            # here is the loud typo/fp8 gate; the policy itself reaches
+            # the F.linear seam through TrainStep's matmul_scope
+            from .. import quantized_compute as _qcp
+
+            _qcp.resolve_matmul(s.quantized_matmul)
         from ...optimizer import Adam, AdamW, Lamb, Lars, Momentum
 
         if s.lamb:
@@ -838,6 +845,26 @@ class Fleet:
                     cfg["exclude_from_weight_decay"]
                 ),
             )
+        if s.quantized_moments:
+            # AFTER the lamb/lars swaps so a Lamb-swapped inner fails the
+            # family check loudly instead of silently training wide
+            if s.fp16_allreduce:
+                raise ValueError(
+                    "quantized_moments cannot combine with "
+                    "fp16_allreduce: the grad would pass two lossy width "
+                    "policies back to back on the grad->moment path "
+                    "(bf16 comm round trip, then the int8 moment "
+                    "round trip), compounding beyond the documented "
+                    "single-pass quantize_dequantize error bound — use "
+                    "quantized_allreduce for narrow comm instead"
+                )
+            if not isinstance(optimizer, (Adam, AdamW)):
+                raise ValueError(
+                    "strategy.quantized_moments stores Adam-family "
+                    "moment1/moment2 state narrow; got "
+                    f"{type(optimizer).__name__}"
+                )
+            optimizer.quantize_moments(s.quantized_moments)
         return _DistributedOptimizer(optimizer, self._strategy)
 
 

@@ -102,6 +102,20 @@ _DEFAULTS = {
     # width policy at a time: combining with fp16_allreduce raises.
     "quantized_allreduce": None,
     "quantized_allreduce_block": 128,
+    # quantization plane round 2 (ISSUE 19) — COMPUTE-side widths on the
+    # same block-scaled primitives. quantized_matmul = "int8" | "fp8"
+    # arms the fake-quant matmul route at the F.linear seam for the
+    # compiled TrainStep's forward (QAT: forward sees the block-quantized
+    # weight, backward is straight-through to the wide master —
+    # distributed/quantized_compute.py); PADDLE_Q_MATMUL is the ambient
+    # env twin for eager/serving. quantized_moments = "int8" | "fp8"
+    # stores Adam/AdamW moments as narrow payload + per-block f32 scales
+    # (dequant-update-requant inside the compiled apply; Adam-family
+    # only, raises with fp16_allreduce — two lossy width policies on the
+    # same grad->moment path compound). Both default off; with both off
+    # every step is bitwise identical to pre-round-19 behavior.
+    "quantized_matmul": None,
+    "quantized_moments": None,
     # dgc (top-k sparsified allreduce) is DEPRECATED on TPU: setting it
     # routes to quantized_allreduce="int8" with a warning — the
     # TPU-native bandwidth-reduction analog (SURVEY §5; VERDICT row 33)

@@ -43,14 +43,24 @@ def _shard_param(p, mesh, spec):
     return p
 
 
-def _overlap_plan(mesh, x):
+def _overlap_plan(mesh, x, weight=None):
     """(mp, row_spec_elem) when PADDLE_TP_OVERLAP routes this layer's
     matmul through the collective-matmul ring (distributed/overlap.py),
-    else None (the GSPMD sharding-propagation form)."""
+    else None (the GSPMD sharding-propagation form). Declines when the
+    weight takes a quantized-matmul route (ISSUE 19: pre-quantized
+    payload or armed PADDLE_Q_MATMUL/strategy policy) — the narrow form
+    goes through the F.linear seam; hand-fusing the dequant into the
+    ring chunks is future work."""
     from . import overlap as _ov
 
     if not _ov.tp_overlap_enabled():
         return None
+    if weight is not None:
+        from . import quantized_compute as _qcp
+
+        if (getattr(weight, "_q_scale", None) is not None
+                or _qcp.matmul_policy() is not None):
+            return None
     rows = 1
     for s in x.shape[:-1]:
         rows *= int(s)
@@ -94,7 +104,7 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         if self.gather_output:
-            plan = _overlap_plan(self.mesh, x)
+            plan = _overlap_plan(self.mesh, x, self.weight)
             if plan is not None:
                 # pipelined output gather: per-row-chunk local matmuls,
                 # each chunk's all-gather issued while the next computes
@@ -157,7 +167,7 @@ class RowParallelLinear(Layer):
             x = _constrain(
                 x, self.mesh, P(*([None] * (x.ndim - 1) + ["mp"]))
             )
-        plan = _overlap_plan(self.mesh, x)
+        plan = _overlap_plan(self.mesh, x, self.weight)
         if plan is not None:
             # the contraction's psum decomposed into per-chunk ppermute
             # ring steps interleaved with the matmul chunks (collective

@@ -61,29 +61,31 @@ def fp8_dtype():
     return getattr(jnp, "float8_e4m3fn", None)
 
 
-def resolve_policy(value, block=128):
+def resolve_policy(value, block=128, *, knob="quantized_allreduce"):
     """Validate a strategy (quantized_allreduce, quantized_allreduce_block)
     pair -> ("int8"|"fp8", block) or None. Loud on unknown dtypes and on
     fp8 without the dtype in this jax (silently training at a different
     width than asked is the one failure mode a comm policy must not
-    have)."""
+    have). ``knob`` names the strategy field / env var in the raise, so
+    the round-19 compute knobs (quantized_matmul, quantized_moments,
+    PADDLE_Q_MATMUL) share this resolver verbatim."""
     if value is None or value is False or value == "":
         return None
     v = str(value).strip().lower()
     if v not in SUPPORTED:
         raise ValueError(
-            f"quantized_allreduce={value!r}: supported policies are "
+            f"{knob}={value!r}: supported policies are "
             f"{SUPPORTED} (or None to disable)"
         )
     if v == "fp8" and fp8_dtype() is None:
         raise NotImplementedError(
-            "quantized_allreduce='fp8' needs jnp.float8_e4m3fn, which "
+            f"{knob}='fp8' needs jnp.float8_e4m3fn, which "
             "this jax does not provide; use 'int8'"
         )
     b = int(block)
     if b <= 0:
         raise ValueError(
-            f"quantized_allreduce_block={block} must be a positive "
+            f"{knob}_block={block} must be a positive "
             "block width"
         )
     return v, b

@@ -1,0 +1,353 @@
+"""Quantization plane round 2 (ISSUE 19): block-scaled COMPUTE on the
+PR-10 wire primitives — quantized matmuls for the TP linears, int8
+optimizer moments, and the pre-quantized weight form serving loads
+straight from an int8 checkpoint.
+
+Three legs, all built on ``distributed/quantized_comm.py``'s symmetric
+per-block quantizer (same dtypes — int8 / fp8-e4m3 — same error model):
+
+* **Quantized matmul.** Weights carry an int8/fp8 payload at the full
+  [in, out] shape plus f32 per-block scales along the CONTRACTION axis
+  (one scale per 128-row block per output column — the layout a
+  row-streamed MXU pass wants). :func:`quantized_matmul` dequantizes
+  in-graph and lets XLA fuse the widen into the matmul operand load: HBM
+  traffic is the narrow payload + the 1/block scale side channel, the
+  accumulate stays f32/bf16. Two routes arm it at the ``F.linear`` seam
+  (`nn/functional/common.py` — the single chokepoint every Linear /
+  ColumnParallelLinear / RowParallelLinear / ParallelMHA projection
+  funnels through):
+
+  - a weight that was LOADED narrow (``_q_scale`` set by
+    :func:`quantize_layer` or an int8 checkpoint) always routes — the
+    serving path, no wide copy ever exists; and
+  - a wide weight under an armed policy (``strategy.quantized_matmul``
+    via :func:`matmul_scope`, or the ``PADDLE_Q_MATMUL`` env default)
+    routes through :func:`qat_matmul` — a fake-quant forward with a
+    custom VJP (straight-through estimator to the wide master weight),
+    so TrainStep's value_and_grad trains THROUGH the quantizer.
+
+  With the policy unset and no narrow weights the seam falls through to
+  the exact pre-PR ``jnp.matmul`` lines — off-switch bitwise identical.
+
+* **Quantized moments** (:func:`moment_narrow` / :func:`moment_wide`):
+  the last-axis block layout from the KV cache reused for Adam/AdamW
+  moment accumulators — `optimizer/optimizer.py` dequantizes, updates in
+  f32, and requantizes inside the compiled apply, so the moments never
+  live wide in HBM (the round-trip error per step is exactly one pass
+  through ``quantize_dequantize`` — the PR-10 error model).
+
+* **Byte attribution** (:func:`q_matmul_info`, :func:`moment_bytes_info`)
+  — static-shape arithmetic for the observability plane, zero device
+  reads, same shape as ``grad_comm_info``.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from . import quantized_comm as qc
+
+__all__ = [
+    "resolve_matmul", "matmul_policy", "matmul_scope",
+    "quantize_weight", "dequantize_weight", "quantized_matmul",
+    "qat_matmul", "moment_narrow", "moment_wide",
+    "quantize_layer", "iter_quantizable",
+    "q_matmul_info", "moment_bytes_info",
+]
+
+#: default contraction-axis block width (documented in README; matches
+#: the wire plane's quantized_allreduce_block default)
+DEFAULT_BLOCK = 128
+
+
+def resolve_matmul(value, block=DEFAULT_BLOCK):
+    """strategy.quantized_matmul -> ("int8"|"fp8", block) or None, loud
+    on typos and on fp8 without float8_e4m3fn (same contract as the wire
+    knob — silently computing at a different width than asked is the
+    failure mode a compute policy must not have)."""
+    return qc.resolve_policy(value, block, knob="quantized_matmul")
+
+
+# -- scope/env policy (what F.linear consults) ------------------------------
+
+#: innermost wins: TrainStep pushes the strategy policy around its traced
+#: forward; the env var is the ambient default (eager + decode tracing)
+_SCOPE = []
+
+
+@contextlib.contextmanager
+def matmul_scope(policy):
+    """Arm (or force off, with None) the quantized-matmul route for the
+    dynamic extent — ``policy`` is a resolved (dtype, block) pair."""
+    _SCOPE.append(policy)
+    try:
+        yield
+    finally:
+        _SCOPE.pop()
+
+
+def matmul_policy():
+    """The policy F.linear consults per call: innermost scope override,
+    else PADDLE_Q_MATMUL (loud on typos), else None."""
+    if _SCOPE:
+        return _SCOPE[-1]
+    env = os.environ.get("PADDLE_Q_MATMUL", "").strip().lower()
+    if not env or env in ("0", "off", "false", "none"):
+        return None
+    return qc.resolve_policy(env, knob="PADDLE_Q_MATMUL")
+
+
+# -- the weight block layout ------------------------------------------------
+
+
+def quantize_weight(w, dtype: str = "int8", block: int = DEFAULT_BLOCK):
+    """w [in, out] -> (payload [in, out] narrow, scales [in/bs, out] f32)
+    with symmetric per-block scales along the CONTRACTION axis (axis 0).
+    A block spans `bs` input rows of ONE output column, so each output
+    element's accumulation crosses scale groups only at block
+    boundaries; `bs` falls back to the whole axis when ``block`` does
+    not tile it (per-column scales — same degradation rule as the KV
+    layout)."""
+    qdtype, qmax = qc._qparams(dtype)
+    i, o = int(w.shape[0]), int(w.shape[1])
+    bs = qc._lastaxis_block(i, block)
+    wr = w.astype(jnp.float32).reshape(i // bs, bs, o)
+    scales = jnp.max(jnp.abs(wr), axis=1) / qmax          # [nb, o]
+    payload = qc._encode(wr, scales[:, None, :], qdtype, qmax)
+    return payload.reshape(i, o), scales.astype(jnp.float32)
+
+
+def dequantize_weight(payload, scales, out_dtype=jnp.float32):
+    """Inverse of :func:`quantize_weight` (payload [in, out] narrow,
+    scales [nb, out] f32) -> wide [in, out] at ``out_dtype``."""
+    i, o = int(payload.shape[0]), int(payload.shape[1])
+    nb = int(scales.shape[0])
+    pr = payload.astype(jnp.float32).reshape(nb, i // nb, o)
+    out = pr * scales[:, None, :].astype(jnp.float32)
+    return out.reshape(i, o).astype(out_dtype)
+
+
+def quantized_matmul(x, w_q, scales):
+    """x [..., in] @ dequant(w_q, scales) — the serving-path matmul over
+    a pre-quantized weight. The dequant is IN-GRAPH so XLA fuses the
+    widen into the matmul's operand load: what streams from HBM is the
+    narrow payload + f32 scales, the accumulate runs at x's width."""
+    out_dtype = (x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                 else jnp.float32)
+    return jnp.matmul(x, dequantize_weight(w_q, scales, out_dtype))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def qat_matmul(x, w, dtype: str = "int8", block: int = DEFAULT_BLOCK):
+    """Fake-quant matmul over a WIDE master weight: the forward computes
+    against the block-quantized weight (exactly what a narrow deployment
+    will run), the backward is a straight-through estimator — dx uses
+    the same quantized weight the forward saw (consistent
+    linearization), dw flows full-width to the wide master so the
+    optimizer keeps accumulating fine updates smaller than one
+    quantization step."""
+    wq, ws = quantize_weight(w, dtype, block)
+    return jnp.matmul(x, dequantize_weight(wq, ws, w.dtype))
+
+
+def _qat_fwd(x, w, dtype, block):
+    wq, ws = quantize_weight(w, dtype, block)
+    wdq = dequantize_weight(wq, ws, w.dtype)
+    return jnp.matmul(x, wdq), (x, wdq)
+
+
+def _qat_bwd(dtype, block, res, g):
+    x, wdq = res
+    dx = jnp.matmul(g, wdq.T).astype(x.dtype)
+    xf = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    gf = g.reshape(-1, g.shape[-1]).astype(jnp.float32)
+    dw = jnp.matmul(xf.T, gf).astype(wdq.dtype)
+    return dx, dw
+
+
+qat_matmul.defvjp(_qat_fwd, _qat_bwd)
+
+
+# -- optimizer-moment layout ------------------------------------------------
+
+
+def moment_narrow(m, dtype: str = "int8", block: int = DEFAULT_BLOCK):
+    """f32 moment -> (payload, scales) in the last-axis block layout.
+    0-d moments stay wide (a scalar has no axis to block over): the
+    payload IS the f32 value and the scale is a 0-d zero sentinel
+    :func:`moment_wide` recognizes."""
+    if m.ndim == 0:
+        return m.astype(jnp.float32), jnp.zeros((), jnp.float32)
+    return qc.quantize_lastaxis(m, dtype, block)
+
+
+def moment_wide(payload, scales, out_dtype=jnp.float32):
+    """Inverse of :func:`moment_narrow`."""
+    if payload.ndim == 0 or scales.ndim == 0:
+        return payload.astype(out_dtype)
+    return qc.dequantize_lastaxis(payload, scales, out_dtype)
+
+
+def moment2_narrow(v, dtype: str = "int8", block: int = DEFAULT_BLOCK):
+    """Second-moment narrow form: quantize sqrt(v), not v. Linear int8
+    on v itself is structurally broken for Adam — v scales as g**2, so
+    an element 16x below its block max already rounds to ZERO payload
+    while the matching first-moment element (scaling as g) survives,
+    and m / (sqrt(0) + eps) explodes the update by ~1/eps. In the sqrt
+    domain both moments scale as g and cross the rounding threshold at
+    the same relative magnitude."""
+    return moment_narrow(jnp.sqrt(jnp.maximum(v, 0.0)), dtype, block)
+
+
+def moment2_wide(payload, scales, out_dtype=jnp.float32):
+    """Inverse of :func:`moment2_narrow`, with a half-step denominator
+    floor: an element whose sqrt(v) rounded to zero payload had a true
+    value somewhere in [0, scale/2), so reconstructing it as scale/2
+    (instead of 0) keeps the update's denominator within HALF ONE
+    QUANTIZATION STEP of the truth — the same per-element bound as the
+    quantize_dequantize error model — while removing the 1/eps blowup
+    for elements the narrow form cannot resolve. Zero-scale blocks
+    (moments never touched) stay exactly zero."""
+    if payload.ndim == 0 or scales.ndim == 0:
+        u = payload.astype(jnp.float32)
+        return (u * u).astype(out_dtype)
+    d = int(payload.shape[-1])
+    nb = int(scales.shape[-1])
+    sc = scales[..., None].astype(jnp.float32)
+    ur = payload.astype(jnp.float32).reshape(
+        payload.shape[:-1] + (nb, d // nb)) * sc
+    ur = jnp.maximum(ur, 0.5 * sc)
+    u = ur.reshape(payload.shape)
+    return (u * u).astype(out_dtype)
+
+
+# -- the pre-quantized layer form (what int8 checkpoints load into) ---------
+
+#: buffer name the per-weight scale table registers under on the OWNING
+#: layer (non-persistable: it rides named_buffers into the compiled
+#: decode step but never shadows the wide weight in a state_dict)
+SCALE_BUFFER = "weight_q_scale"
+
+
+def _linear_classes():
+    from .. import nn
+    from .meta_parallel import ColumnParallelLinear, RowParallelLinear
+
+    return (nn.Linear, ColumnParallelLinear, RowParallelLinear)
+
+
+def iter_quantizable(layer):
+    """Yield (param_name, sublayer, weight) for every matmul weight the
+    narrow form covers: 2-D floating `weight` params owned by
+    Linear/ColumnParallelLinear/RowParallelLinear. Embedding tables and
+    norm params stay wide (their access pattern is gather/elementwise,
+    not an MXU contraction)."""
+    classes = _linear_classes()
+    for lname, sub in layer.named_sublayers(include_self=True):
+        if not isinstance(sub, classes):
+            continue
+        w = sub._parameters.get("weight")
+        if w is None or w.ndim != 2:
+            continue
+        if (not jnp.issubdtype(w.dtype, jnp.floating)
+                and getattr(w, "_q_scale", None) is None):
+            # int8 payloads fail the floating check but ARE eligible
+            # when already narrow (re-save / reload of a quantized model)
+            continue
+        yield (f"{lname}.weight" if lname else "weight"), sub, w
+
+
+def attach_quantized(sub, w, payload, scales):
+    """Install a narrow (payload, scales) pair onto ``sub``'s weight
+    in place: the param's raw becomes the payload (same shape, narrow
+    dtype) and the scales ride a non-persistable buffer — so the
+    compiled decode step threads both from HBM automatically (params +
+    named_buffers are its donated inputs) and `F.linear` routes through
+    :func:`quantized_matmul` on sight of ``_q_scale``."""
+    from ..core.tensor import Tensor
+
+    sc = Tensor._wrap(scales, stop_gradient=True)
+    sub.register_buffer(SCALE_BUFFER, sc, persistable=False)
+    w._data = payload
+    w._q_scale = sc
+    return sc
+
+
+def quantize_layer(layer, dtype: str = "int8", block: int = DEFAULT_BLOCK):
+    """Narrow every eligible linear weight of ``layer`` IN PLACE (the
+    serving form: int8/fp8 payload resident, f32 scales alongside) and
+    return the byte ledger::
+
+        {"dtype", "block", "quantized": [param names],
+         "bytes_payload", "bytes_scales", "bytes_wide_f32"}
+
+    Already-narrow weights are skipped (idempotent), so a checkpoint
+    load followed by an engine expand re-accounts without re-encoding.
+    """
+    pol = qc.resolve_policy(dtype, block, knob="quantized_matmul")
+    if pol is None:
+        raise ValueError("quantize_layer needs an explicit 'int8'/'fp8'")
+    dt, bs = pol
+    names, b_payload, b_scales, b_wide = [], 0, 0, 0
+    for pname, sub, w in iter_quantizable(layer):
+        if getattr(w, "_q_scale", None) is not None:
+            continue
+        payload, scales = quantize_weight(w._data, dt, bs)
+        attach_quantized(sub, w, payload, scales)
+        names.append(pname)
+        b_payload += payload.size
+        b_scales += 4 * scales.size
+        b_wide += 4 * payload.size
+    return {
+        "dtype": dt, "block": bs, "quantized": names,
+        "bytes_payload": int(b_payload), "bytes_scales": int(b_scales),
+        "bytes_wide_f32": int(b_wide),
+    }
+
+
+# -- byte attribution (static ints, ledger/metrics shape) -------------------
+
+
+def q_matmul_info(n_elems: int, policy) -> dict:
+    """The static ``q_matmul`` telemetry record: resident matmul-weight
+    bytes under the policy (payload + scale side channel, the
+    ``wire_bytes`` arithmetic) next to the bf16 deployment baseline.
+    ``policy`` is a resolve_matmul() pair or None."""
+    n = int(n_elems)
+    if policy is not None:
+        dtype, block = policy
+        resident = qc.wire_bytes(n, dtype, block)
+    else:
+        dtype, block = "bfloat16", 0
+        resident = 2 * n
+    bf16 = 2 * n
+    return {
+        "dtype": dtype, "block": int(block), "weight_elems": n,
+        "bytes_resident": int(resident), "bytes_bf16": int(bf16),
+        "reduction_x": round(bf16 / resident, 2) if resident else 1.0,
+    }
+
+
+def moment_bytes_info(n_elems: int, policy) -> dict:
+    """The static ``moment_bytes`` record: HBM resident bytes for the
+    TWO Adam moments under quantized_moments vs the f32 baseline (the
+    flat-count block estimate — per-row blocking rounds each trailing
+    axis up, a <1% correction the telemetry ignores)."""
+    n = int(n_elems)
+    if policy is not None:
+        dtype, block = policy
+        per_moment = qc.wire_bytes(n, dtype, block)
+    else:
+        dtype, block = "float32", 0
+        per_moment = 4 * n
+    f32 = 8 * n
+    resident = 2 * per_moment
+    return {
+        "dtype": dtype, "block": int(block), "moment_elems": n,
+        "bytes_resident": int(resident), "bytes_f32": int(f32),
+        "reduction_x": round(f32 / resident, 2) if resident else 1.0,
+    }

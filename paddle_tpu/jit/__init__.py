@@ -8,7 +8,9 @@ from .decode_step import (  # noqa: F401
     SpecDecodeState, SpeculativeDecodeStep,
 )
 from .recompute import recompute  # noqa: F401
-from .save_load import TranslatedLayer, load, save  # noqa: F401
+from .save_load import (  # noqa: F401
+    TranslatedLayer, load, load_quantized, save, save_quantized,
+)
 from .train_step import TrainStep  # noqa: F401
 
 

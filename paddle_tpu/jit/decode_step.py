@@ -183,6 +183,11 @@ class _CompiledDecodeBase:
 
     def __init__(self, model, *, donate: bool = True):
         self.model = model
+        # params + ALL buffers thread into the jitted program as inputs —
+        # for an int8-checkpointed model (ISSUE 19) that is the narrow
+        # weight payloads (the params' raws) plus their non-persistable
+        # `weight_q_scale` buffers, so the compiled decode streams
+        # int8 + scales from HBM with no wiring beyond this collection
         self._p_objs = list(model.parameters())
         self._b_objs = list(dict(model.named_buffers()).values())
         from jax.sharding import NamedSharding, PartitionSpec as _P

@@ -32,6 +32,12 @@ from .program import InputSpec, StaticFunction, _CompiledProgram, _collect_layer
 
 MODEL_SUFFIX = ".pdmodel"
 PARAMS_SUFFIX = ".pdiparams"
+#: quantized weight checkpoint (ISSUE 19): one npz holding `{name}::q`
+#: int8/fp8 payloads + `{name}::scale` f32 per-block scales for every
+#: linear weight, plain `{name}` entries for the wide remainder
+#: (embeddings, norms, biases), plus a `.pdqmeta` JSON sidecar
+QPARAMS_SUFFIX = ".pdqparams"
+QMETA_SUFFIX = ".pdqmeta"
 
 
 def save(layer, path, input_spec=None, **configs):
@@ -160,3 +166,154 @@ def load(path, **configs) -> TranslatedLayer:
     buffers = [data[f"buffer_{i}"] for i in range(meta["n_buffers"])]
     out_treedef = pickle.loads(bytes.fromhex(meta["out_treedef"]))
     return TranslatedLayer(exported, params, buffers, out_treedef)
+
+
+# ---------------------------------------------------------------------------
+# quantized weight checkpoints (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _emit_q_checkpoint(event: str, info: dict):
+    from ..observability import bus as _bus
+
+    if _bus.enabled():
+        _bus.emit("q_checkpoint", dict(info, event=event), step=0)
+
+
+def save_quantized(layer, path, dtype: str = "int8", block: int = 128):
+    """Write ``layer``'s weights as an int8/fp8 checkpoint: every
+    eligible linear weight (``quantized_compute.iter_quantizable``)
+    lands as narrow payload + per-block f32 scales, everything else
+    (embeddings, norms, biases, persistable buffers) stays wide. An
+    already-narrow layer's payloads are written as-is; wide weights are
+    quantized ONE AT A TIME — no full-model wide copy is ever built.
+
+    Returns the byte ledger (also emitted as a ``q_checkpoint`` bus
+    record): payload/scale/wide bytes and the quantized param names.
+    """
+    from ..distributed import quantized_comm as _qc
+    from ..distributed import quantized_compute as _qcp
+
+    pol = _qc.resolve_policy(dtype, block, knob="save_quantized")
+    if pol is None:
+        raise ValueError("save_quantized needs an explicit 'int8'/'fp8'")
+    dt, bs = pol
+    state, qnames = {}, []
+    b_payload = b_scales = 0
+    for pname, sub, w in _qcp.iter_quantizable(layer):
+        sc = getattr(w, "_q_scale", None)
+        if sc is not None:
+            payload, scales = np.asarray(w._data), np.asarray(sc._data)
+        else:
+            p_j, s_j = _qcp.quantize_weight(w._data, dt, bs)
+            payload, scales = np.asarray(p_j), np.asarray(s_j)
+        if dt == "fp8":
+            # npz has no float8 descr — store the raw byte view, the
+            # loader views it back through the meta dtype
+            payload = payload.view(np.uint8)
+        state[f"{pname}::q"] = payload
+        state[f"{pname}::scale"] = scales
+        qnames.append(pname)
+        b_payload += payload.size
+        b_scales += scales.nbytes
+    b_wide = 0
+    for name, t in layer.state_dict().items():
+        if name in qnames:
+            continue
+        arr = np.asarray(t._data)
+        state[name] = arr
+        b_wide += arr.nbytes
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + QPARAMS_SUFFIX, "wb") as f:
+        np.savez(f, **state)  # file handle: savez must not append ".npz"
+    info = {
+        "format": "pdq1", "dtype": dt, "block": bs, "quantized": qnames,
+        "bytes_payload": int(b_payload), "bytes_scales": int(b_scales),
+        "bytes_wide": int(b_wide),
+    }
+    with open(path + QMETA_SUFFIX, "w") as f:
+        json.dump(info, f)
+    _emit_q_checkpoint("save", info)
+    return dict(info)
+
+
+def load_quantized(layer, path):
+    """Load a :func:`save_quantized` checkpoint INTO ``layer`` without
+    ever materializing wide weights: each linear weight's raw becomes
+    the int8/fp8 payload directly off the npz (the narrow serving form —
+    ``F.linear`` routes it through ``quantized_matmul`` from then on)
+    and its scales ride the non-persistable ``weight_q_scale`` buffer,
+    so the compiled decode step streams exactly what the file held.
+
+    Loud on architecture mismatch: quantized names with no matching
+    linear, wide entries with no matching state, and state left
+    uncovered all raise. Returns the meta ledger + ``load_ms``.
+    """
+    import time as _time
+
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from ..distributed import quantized_compute as _qcp
+
+    t0 = _time.perf_counter()
+    with open(path + QMETA_SUFFIX) as f:
+        meta = json.load(f)
+    data = np.load(path + QPARAMS_SUFFIX)
+    qnames = list(meta["quantized"])
+    qmap = {pname: (sub, w)
+            for pname, sub, w in _qcp.iter_quantizable(layer)}
+    missing_q = [n for n in qnames if n not in qmap]
+    if missing_q:
+        raise ValueError(
+            f"quantized checkpoint entries {missing_q} have no matching "
+            "linear weight in this layer (architecture mismatch)"
+        )
+    if meta["dtype"] == "fp8":
+        from ..distributed import quantized_comm as _qc
+
+        f8 = _qc.fp8_dtype()
+        if f8 is None:
+            raise NotImplementedError(
+                "this checkpoint holds fp8 payloads but this jax has no "
+                "float8_e4m3fn; re-save as 'int8'"
+            )
+    for pname in qnames:
+        sub, w = qmap[pname]
+        raw = data[f"{pname}::q"]
+        if meta["dtype"] == "fp8":
+            raw = raw.view(np.dtype(f8))
+        payload = jnp.asarray(raw)          # narrow in, narrow resident
+        scales = jnp.asarray(data[f"{pname}::scale"])
+        sh = getattr(w._data, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            payload = jax.device_put(payload, sh)
+            scales = jax.device_put(
+                scales, NamedSharding(sh.mesh, _P()))
+        _qcp.attach_quantized(sub, w, payload, scales)
+    qset = set(qnames)
+    own = layer.state_dict()
+    covered, unexpected = [], []
+    for name in data.files:
+        base = name.split("::", 1)[0]
+        if base in qset:
+            continue
+        if name not in own:
+            unexpected.append(name)
+            continue
+        target = own[name]
+        target.set_value(
+            np.asarray(data[name]).astype(np.dtype(target.dtype)))
+        covered.append(name)
+    left = [n for n in own
+            if n not in covered and n not in qset]
+    if unexpected or left:
+        raise ValueError(
+            f"quantized checkpoint does not match this layer: "
+            f"unexpected entries {unexpected}, uncovered state {left}"
+        )
+    info = dict(meta)
+    info["load_ms"] = round((_time.perf_counter() - t0) * 1e3, 2)
+    _emit_q_checkpoint("load", info)
+    return info

@@ -104,6 +104,7 @@ class TrainStep:
         self._inject_enabled = False
         self._dcn_quant = None        # quantized dcn-hop exchange policy
         self._quant_info = None       # resolved width policy (telemetry)
+        self._q_matmul = None         # quantized-matmul compute policy
         strategy = getattr(optimizer, "user_defined_strategy", None)
         if strategy is not None:
             if strategy.quantized_allreduce:
@@ -113,6 +114,15 @@ class TrainStep:
                     strategy.quantized_allreduce,
                     strategy.quantized_allreduce_block,
                 )
+            if strategy.quantized_matmul:
+                # QAT matmul route (ISSUE 19): armed around the traced
+                # forward via matmul_scope so F.linear sees the policy
+                # exactly where this strategy's program traces — eager
+                # code outside the step stays governed by PADDLE_Q_MATMUL
+                from ..distributed import quantized_compute as _qcp
+
+                self._q_matmul = _qcp.resolve_matmul(
+                    strategy.quantized_matmul)
             if strategy.localsgd:
                 if strategy.amp or strategy.recompute:
                     raise NotImplementedError(
@@ -311,9 +321,29 @@ class TrainStep:
         self._flops = None
         from ..observability import bus as _bus, ledger as _ledger
 
+        # quantized-compute byte attribution (ISSUE 19): resident matmul-
+        # weight bytes under the armed QAT policy and the Adam-moment
+        # bytes under quantized_moments — static shapes like grad_comm,
+        # zero device reads, one bus record each at construction
+        from ..distributed import quantized_compute as _qcp
+
+        self._q_matmul_info = _qcp.q_matmul_info(
+            sum(int(p._data.size) for p in self._p_objs
+                if p._data.ndim == 2),
+            self._q_matmul,
+        )
+        self._moment_bytes_info = _qcp.moment_bytes_info(
+            sum(int(p._data.size) for p in self._p_objs),
+            getattr(self.opt, "_q_moments", None),
+        )
+        if self._guard is not None:
+            self._guard._sampler.set_quant_bytes(
+                self._q_matmul_info, self._moment_bytes_info)
         if _bus.enabled():
             _ledger.install_backend_listener()
             _bus.emit("grad_comm", self._grad_comm_info, step=0)
+            _bus.emit("q_matmul", self._q_matmul_info, step=0)
+            _bus.emit("moment_bytes", self._moment_bytes_info, step=0)
 
     def _refresh_zero_pads(self):
         """Index the params whose storage is padded to the ZeRO shard
@@ -344,6 +374,13 @@ class TrainStep:
 
         return amp.auto_cast(**self._amp_ctx)
 
+    def _q_guard(self):
+        if self._q_matmul is None:
+            return contextlib.nullcontext()
+        from ..distributed import quantized_compute as _qcp
+
+        return _qcp.matmul_scope(self._q_matmul)
+
     def _fwd_segment(self, p_tuple, b_raws, key, in_raws):
         """Model forward as a pure pytree function — the jax.checkpoint
         (remat) boundary when strategy.recompute is on (RecomputeOptimizer
@@ -352,6 +389,7 @@ class TrainStep:
 
         p_objs, b_objs = self._p_objs, self._b_objs
         with AG.trace_mode(), _trace_rng(key), self._amp_guard(), \
+                self._q_guard(), \
                 _prof.device_annotation("TrainStep::forward"), \
                 _swapped(p_objs + b_objs, list(p_tuple) + list(b_raws)):
             outs = self.model(*[Tensor._wrap(r) for r in in_raws])
@@ -378,6 +416,7 @@ class TrainStep:
         # loss_fn sees the TRACED params/post-forward buffers (it may read
         # model.parameters() for a penalty term) and its own RNG stream
         with AG.trace_mode(), _trace_rng(loss_key), self._amp_guard(), \
+                self._q_guard(), \
                 _swapped(self._p_objs + self._b_objs,
                          list(p_tuple) + list(new_b)):
             labels = [Tensor._wrap(r) for r in label_raws]

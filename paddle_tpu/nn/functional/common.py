@@ -23,7 +23,43 @@ __all__ = [
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b; W shape (in, out) — paddle convention (matmul lowers to
-    the MXU; keep batch dims folded)."""
+    the MXU; keep batch dims folded).
+
+    The one seam of the quantized-compute plane (ISSUE 19): every
+    Linear / ColumnParallelLinear / RowParallelLinear / ParallelMHA
+    projection funnels through here, so two checks route the narrow
+    forms — a pre-quantized weight (``_q_scale`` set: int8 checkpoint /
+    quantize_layer, the serving path) always takes ``quantized_matmul``;
+    a wide 2-D float weight under an armed policy (strategy scope or
+    PADDLE_Q_MATMUL) takes the fake-quant ``qat_matmul`` (custom VJP,
+    straight-through to the wide master). Both off -> the exact pre-PR
+    lines below, bitwise identical."""
+    qsc = getattr(weight, "_q_scale", None)
+    if qsc is not None:
+        from ...distributed import quantized_compute as Q
+
+        if bias is None:
+            return AG.apply(Q.quantized_matmul, (x, weight, qsc),
+                            name="linear")
+        return AG.apply(
+            lambda a, w, s, b: Q.quantized_matmul(a, w, s) + b,
+            (x, weight, qsc, bias), name="linear",
+        )
+    w_raw = weight._data if isinstance(weight, Tensor) else weight
+    if (getattr(w_raw, "ndim", 0) == 2
+            and jnp.issubdtype(w_raw.dtype, jnp.floating)):
+        from ...distributed import quantized_compute as Q
+
+        pol = Q.matmul_policy()
+        if pol is not None:
+            dt, bs = pol
+            if bias is None:
+                return AG.apply(lambda a, w: Q.qat_matmul(a, w, dt, bs),
+                                (x, weight), name="linear")
+            return AG.apply(
+                lambda a, w, b: Q.qat_matmul(a, w, dt, bs) + b,
+                (x, weight, bias), name="linear",
+            )
     if bias is None:
         return AG.apply(jnp.matmul, (x, weight), name="linear")
     return AG.apply(

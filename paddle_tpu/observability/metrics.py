@@ -88,6 +88,8 @@ class StepMetricsSampler:
         self._examples = 0
         self._tokens = 0
         self._grad_comm: Optional[dict] = None
+        self._q_matmul: Optional[dict] = None
+        self._moment_bytes: Optional[dict] = None
 
     def set_grad_comm(self, info: Optional[dict]) -> None:
         """Static grad-comm accounting (dtype + bytes-on-wire of one
@@ -95,6 +97,25 @@ class StepMetricsSampler:
         once by TrainStep from static shapes; riding every row costs
         zero device reads."""
         self._grad_comm = dict(info) if info else None
+
+    def set_quant_bytes(self, q_matmul: Optional[dict],
+                        moment_bytes: Optional[dict]) -> None:
+        """Static quantized-compute accounting (ISSUE 19): resident
+        matmul-weight bytes under the QAT policy and Adam-moment bytes
+        under quantized_moments — same once-at-construction, static-
+        shape contract as set_grad_comm. Rows only grow when a policy is
+        armed (reduction_x > 1), keeping the all-knobs-off row
+        byte-identical."""
+        self._q_matmul = (
+            dict(q_matmul)
+            if q_matmul and q_matmul.get("reduction_x", 1.0) != 1.0
+            else None
+        )
+        self._moment_bytes = (
+            dict(moment_bytes)
+            if moment_bytes and moment_bytes.get("reduction_x", 1.0) != 1.0
+            else None
+        )
 
     def tick(self, inputs) -> None:
         """Per-step accounting from static input shapes (host ints)."""
@@ -142,6 +163,10 @@ class StepMetricsSampler:
                 payload["tokens_per_sec"] = round(tokens / dt, 1)
         if self._grad_comm:
             payload["grad_comm"] = self._grad_comm
+        if self._q_matmul:
+            payload["q_matmul"] = self._q_matmul
+        if self._moment_bytes:
+            payload["moment_bytes"] = self._moment_bytes
         mem = device_memory()
         if mem:
             payload["device_memory"] = mem

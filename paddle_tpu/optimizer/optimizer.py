@@ -69,8 +69,12 @@ class Optimizer:
     def _acc(self, name: str, p: Parameter, init=None):
         store = self._accumulators.setdefault(name, {})
         if id(p) not in store:
+            # default seed routes through _acc_init so optimizers with a
+            # non-zeros_like accumulator layout (quantized moments:
+            # int8 payload + f32 scale leaves) seed the eager path and
+            # the functional path identically
             store[id(p)] = (
-                jnp.zeros_like(p._data) if init is None else init
+                self._acc_init(name, p) if init is None else init
             )
         return store[id(p)]
 
@@ -414,16 +418,116 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
 
+    # -- quantized moments (ISSUE 19) -----------------------------------
+    # strategy.quantized_moments stores both moments as int8/fp8 payload
+    # + per-block f32 scales (distributed/quantized_compute.py last-axis
+    # layout): the compiled apply dequantizes to the update width, runs
+    # the unchanged Adam rule, and requantizes — so moments never live
+    # wide in HBM and the per-step state error is exactly ONE
+    # quantize_dequantize round trip (the PR-10 error model). The scale
+    # leaves ride the SAME accumulator machinery as extra acc names, so
+    # gradient_merge's boundary select, ZeRO's pad/constrain, and
+    # state_dict round trips all compose without special cases.
+    _q_moments = None
+    _Q_MOMENT_NAMES = ("moment1", "moment2")
+
+    def quantize_moments(self, policy, block=128):
+        """Arm narrow moment storage. Must run BEFORE any state is
+        seeded (re-encoding live wide moments would silently change the
+        trajectory mid-run — resume from a checkpoint instead)."""
+        from ..distributed import quantized_comm as _qc
+
+        pol = _qc.resolve_policy(policy, block, knob="quantized_moments")
+        if pol is None:
+            return None
+        for nm in self._Q_MOMENT_NAMES:
+            if self._accumulators.get(nm):
+                raise RuntimeError(
+                    "quantized_moments must be armed before the first "
+                    "step: this optimizer already holds wide moment "
+                    "state (arm at construction, or resume via "
+                    "set_state_dict after arming)"
+                )
+        self._q_moments = pol
+        self._acc_tree_names = (
+            "moment1", "moment2", "moment1_scale", "moment2_scale"
+        )
+        return pol
+
+    def _acc_init(self, name: str, p: Parameter):
+        if self._q_moments is None:
+            return super()._acc_init(name, p)
+        from ..distributed import quantized_comm as _qc
+
+        dt, bs = self._q_moments
+        shp = p._data.shape
+        if len(shp) == 0:
+            # scalars have no axis to block over: wide payload + the 0-d
+            # zero-scale sentinel moment_wide recognizes
+            if name.endswith("_scale"):
+                return jnp.zeros((), jnp.float32)
+            return super()._acc_init(name, p)
+        qdtype, _ = _qc._qparams(dt)
+        d = int(shp[-1])
+        eb = _qc._lastaxis_block(d, bs)
+        if name.endswith("_scale"):
+            arr = jnp.zeros(tuple(shp[:-1]) + (d // eb,), jnp.float32)
+        else:
+            arr = jnp.zeros(shp, qdtype)
+        sh = getattr(p._data, "sharding", None)
+        if sh is not None:
+            if arr.shape == tuple(shp):
+                arr = jax.device_put(arr, sh)
+            else:
+                # scale leaves are 1/block the bytes: replicate on the
+                # param's mesh (same retrace-avoidance rationale as the
+                # base seeding)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                if isinstance(sh, NamedSharding):
+                    arr = jax.device_put(
+                        arr, NamedSharding(sh.mesh, PartitionSpec())
+                    )
+        return arr
+
+    def _q_wide(self, accs, d):
+        from ..distributed import quantized_compute as _Q
+
+        m = _Q.moment_wide(accs["moment1"], accs["moment1_scale"], d)
+        # moment2 is stored in sqrt domain (see moment2_narrow): linear
+        # int8 on v itself zero-rounds elements whose grad is ~16x below
+        # the block max while moment1 still resolves them, and the
+        # m / (sqrt(0) + eps) update then explodes by ~1/eps
+        v = _Q.moment2_wide(accs["moment2"], accs["moment2_scale"], d)
+        return m, v
+
+    def _q_narrow(self, m_new, v_new):
+        from ..distributed import quantized_compute as _Q
+
+        dt, bs = self._q_moments
+        mp, ms = _Q.moment_narrow(m_new, dt, bs)
+        vp, vs = _Q.moment2_narrow(v_new, dt, bs)
+        return {"moment1": mp, "moment2": vp,
+                "moment1_scale": ms, "moment2_scale": vs}
+
     def _apply_one(self, p, g, lr):
-        m = self._acc("moment1", p)
-        v = self._acc("moment2", p)
         d = p._data.dtype
+        if self._q_moments is not None:
+            accs = {n: self._acc(n, p) for n in self._acc_tree_names}
+            m, v = self._q_wide(accs, d)
+        else:
+            m = self._acc("moment1", p)
+            v = self._acc("moment2", p)
         p._data, m_new, v_new = _adam_rule(
             p._data, g, m, v,
             jnp.asarray(lr, d), jnp.asarray(self._beta1, d),
             jnp.asarray(self._beta2, d), jnp.asarray(self._epsilon, d),
             jnp.asarray(self._step_count, d),
         )
+        if self._q_moments is not None:
+            for n, val in self._q_narrow(m_new, v_new).items():
+                self._set_acc(n, p, val)
+            return
         self._set_acc("moment1", p, m_new)
         self._set_acc("moment2", p, v_new)
 
@@ -431,11 +535,17 @@ class Adam(Optimizer):
 
     def _pure_one(self, p, p_raw, g_raw, accs, lr, t):
         d = p_raw.dtype
+        if self._q_moments is not None:
+            m, v = self._q_wide(accs, d)
+        else:
+            m, v = accs["moment1"], accs["moment2"]
         new_p, m_new, v_new = _adam_rule(
-            p_raw, g_raw, accs["moment1"], accs["moment2"],
+            p_raw, g_raw, m, v,
             lr, jnp.asarray(self._beta1, d), jnp.asarray(self._beta2, d),
             jnp.asarray(self._epsilon, d), t,
         )
+        if self._q_moments is not None:
+            return new_p, self._q_narrow(m_new, v_new)
         return new_p, {"moment1": m_new, "moment2": v_new}
 
 
@@ -458,15 +568,23 @@ class AdamW(Adam):
         wd = self._wd
         if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
             wd = 0.0
-        m = self._acc("moment1", p)
-        v = self._acc("moment2", p)
         d = p._data.dtype
+        if self._q_moments is not None:
+            accs = {n: self._acc(n, p) for n in self._acc_tree_names}
+            m, v = self._q_wide(accs, d)
+        else:
+            m = self._acc("moment1", p)
+            v = self._acc("moment2", p)
         p._data, m_new, v_new = _adamw_rule(
             p._data, g, m, v,
             jnp.asarray(lr, d), jnp.asarray(self._beta1, d),
             jnp.asarray(self._beta2, d), jnp.asarray(self._epsilon, d),
             jnp.asarray(self._step_count, d), jnp.asarray(wd, d),
         )
+        if self._q_moments is not None:
+            for n, val in self._q_narrow(m_new, v_new).items():
+                self._set_acc(n, p, val)
+            return
         self._set_acc("moment1", p, m_new)
         self._set_acc("moment2", p, v_new)
 
@@ -476,11 +594,17 @@ class AdamW(Adam):
                 and not self._apply_decay_param_fun(p.name)):
             wd = 0.0
         d = p_raw.dtype
+        if self._q_moments is not None:
+            m, v = self._q_wide(accs, d)
+        else:
+            m, v = accs["moment1"], accs["moment2"]
         new_p, m_new, v_new = _adamw_rule(
-            p_raw, g_raw, accs["moment1"], accs["moment2"],
+            p_raw, g_raw, m, v,
             lr, jnp.asarray(self._beta1, d), jnp.asarray(self._beta2, d),
             jnp.asarray(self._epsilon, d), t, jnp.asarray(wd, d),
         )
+        if self._q_moments is not None:
+            return new_p, self._q_narrow(m_new, v_new)
         return new_p, {"moment1": m_new, "moment2": v_new}
 
 

@@ -618,10 +618,24 @@ class InferenceEngine:
         self._state = DecodeState(*_commit_tree(self._state.astuple()))
         from ..observability import bus as _bus
 
+        # what the lend path keeps resident for the wider batch — with an
+        # int8 checkpoint loaded the narrow payload + scale buffers ARE
+        # the weights (ISSUE 19), so the record prices exactly what a
+        # lent chip receives; static shapes, zero device reads
+        w_bytes = sum(
+            int(o._data.size) * o._data.dtype.itemsize
+            for o in list(self.model.parameters())
+            + list(self.model.buffers())
+        )
+        w_quant = sum(
+            1 for p in self.model.parameters()
+            if getattr(p, "_q_scale", None) is not None
+        )
         _bus.emit("engine_expand", {
             "slots_before": old, "slots_after": self.slots,
             "blocks_total": (None if self._pool is None
                              else self._pool.total),
+            "weights_bytes": w_bytes, "weights_quantized": w_quant,
             "dur_ms": round((time.perf_counter() - t0) * 1e3, 3)})
         return self.slots
 

@@ -67,6 +67,17 @@ class TransformerLM(nn.Layer):
             new_caches.append(nc)
         return self.head(self.ln_f(h)), new_caches
 
+    def load_quantized(self, path):
+        """Load an int8/fp8 ``jit.save_quantized`` checkpoint directly
+        into this model (ISSUE 19): linear weights arrive as narrow
+        payload + per-block scales and STAY narrow — no wide copy is
+        materialized, ``F.linear`` routes them through the quantized
+        matmul, and the compiled decode step streams the narrow bytes
+        from HBM. Returns the checkpoint ledger (+ ``load_ms``)."""
+        from ..jit.save_load import load_quantized as _loadq
+
+        return _loadq(self, path)
+
     def gen_cache(self, batch_size, max_length, dtype=None,
                   block_size=None, pool_blocks=None):
         if int(max_length) > self.max_position:

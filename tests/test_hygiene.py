@@ -658,6 +658,27 @@ class TestBenchContinuity:
         rc, lines = bc.check(str(tmp_path))
         assert rc == 0, "\n".join(lines)
 
+    def test_quant_byte_keys_are_gated(self, tmp_path):
+        """Round-19 checkpoint/moment byte keys are static arithmetic
+        (zero noise): a >10% payload growth means a layer silently fell
+        off the narrow path and must fail the gate, unlike the timed
+        report-only byte keys of round 11."""
+        bc = self._tool()
+        assert bc.metric_direction("q_ckpt_payload_mb") == -1
+        assert bc.metric_direction("q_ckpt_reduction_x") == 1
+        assert bc.metric_direction(
+            "gpt_medium_bf16_dp_q8_comm_mb") is None  # r11: report-only
+        self._write_pair(
+            tmp_path,
+            {"q_ckpt_payload_mb": 100.0},
+            {"q_ckpt_payload_mb": 130.0,
+             "serve_gpt_medium_tokens_per_sec_b8_q8w_spread":
+                 {"n": 3, "median": 900.0}},
+        )
+        rc, lines = bc.check(str(tmp_path))
+        assert rc != 0
+        assert any("q_ckpt_payload_mb" in ln for ln in lines)
+
     # -- MULTICHIP compile-time drift: report-only -> GATED (ISSUE 14
     # satellite, the ROADMAP item-2 carry-over) -------------------------
     def _write_multichip_pair(self, tmp_path, prev_phases, cur_phases,

@@ -1,0 +1,469 @@
+"""Quantized compute plane (ISSUE 19): int8/fp8 matmul weights, int8
+optimizer moments, and quantized checkpoints serving loads directly.
+
+Parity gates on the 8-device CPU mesh:
+  - block-quantized weight round-trip and quantized-matmul error
+    bounds vs the dense product,
+  - bf16-vs-int8 matmul forward/backward through the TP linears
+    (documented tolerance; dw flows full-width to the master copy),
+  - int8-moment Adam trajectory vs f32 moments within a small multiple
+    of the ``quantize_dequantize`` round-trip error,
+  - int8 checkpoint save -> load -> greedy decode token-exact vs the
+    full-width baseline, with the payload RESIDENT narrow (no wide
+    copy materialized),
+  - all-knobs-off train/decode bitwise identical to the unquantized
+    path (the off-switch guarantee),
+  - loud-raise strategy validation for every rejected combination.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import comm, fleet
+from paddle_tpu.distributed import meta_parallel as dist
+from paddle_tpu.distributed import quantized_comm as qc
+from paddle_tpu.distributed import quantized_compute as qcp
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.jit import TrainStep, save_quantized
+from paddle_tpu.nn import functional as F
+from paddle_tpu.serving.model import TransformerLM
+
+_HAS_FP8 = qc.fp8_dtype() is not None
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh():
+    prev = comm._state.hybrid_mesh
+    comm._state.hybrid_mesh = None
+    yield
+    comm._state.hybrid_mesh = prev
+
+
+def _init_hybrid(dp=2, mp=4):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+class TestPrimitives:
+    def test_weight_round_trip_error_bound(self):
+        """|w - dq(q(w))| <= scale/2 per contraction block."""
+        w = jnp.asarray(
+            np.random.RandomState(0).randn(256, 32).astype(np.float32))
+        p, s = qcp.quantize_weight(w, "int8", 128)
+        assert p.dtype == jnp.int8 and p.shape == (256, 32)
+        assert s.shape == (2, 32) and s.dtype == jnp.float32
+        dq = np.asarray(qcp.dequantize_weight(p, s, jnp.float32))
+        wn, sn = np.asarray(w), np.asarray(s)
+        for b in range(2):
+            blk = slice(b * 128, (b + 1) * 128)
+            assert np.max(np.abs(dq[blk] - wn[blk]) - sn[b] / 2) <= 1e-7
+
+    def test_quantized_matmul_vs_dense(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(8, 256).astype(np.float32))
+        w = jnp.asarray(rng.randn(256, 64).astype(np.float32) * 0.1)
+        p, s = qcp.quantize_weight(w, "int8", 128)
+        out = np.asarray(qcp.quantized_matmul(x, p, s))
+        ref = np.asarray(x @ w)
+        rel = np.max(np.abs(out - ref)) / np.max(np.abs(ref))
+        # int8 per-128-block symmetric: ~0.4% weight error, the matmul
+        # averages it down; 2% is the documented tolerance
+        assert rel < 0.02
+
+    def test_qat_backward_is_straight_through(self):
+        """dx uses the dequantized weight; dw is FULL width (exactly
+        the dense x^T g, no quantization in the master-grad path)."""
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(4, 256).astype(np.float32))
+        w = jnp.asarray(rng.randn(256, 16).astype(np.float32) * 0.1)
+
+        def f(xx, ww):
+            return jnp.sum(qcp.qat_matmul(xx, ww, "int8", 128) ** 2)
+
+        gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
+        wdq = qcp.dequantize_weight(*qcp.quantize_weight(w, "int8", 128),
+                                    jnp.float32)
+        out = qcp.qat_matmul(x, w, "int8", 128)
+        np.testing.assert_allclose(
+            np.asarray(gx), np.asarray(2 * out @ wdq.T), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(gw), np.asarray(x.T @ (2 * out)), rtol=1e-5)
+
+    def test_moment2_sqrt_domain_no_eps_blowup(self):
+        """moment2's narrow form stores sqrt(v): an element 100x below
+        its block max survives (linear int8 on v would zero it and the
+        Adam denominator would collapse to eps)."""
+        v = jnp.full((128,), 1e-4, jnp.float32).at[0].set(1.0)
+        p, s = qcp.moment2_narrow(v, "int8", 128)
+        back = np.asarray(qcp.moment2_wide(p, s))
+        assert back[1] > 0                      # resolved, not zeroed
+        assert abs(np.sqrt(back[1]) - 1e-2) <= np.asarray(s)[0] / 2 + 1e-9
+        # the half-step floor: even a TRUE zero reconstructs no lower
+        # than (scale/2)^2 — bounded denominator, bounded bias
+        vz = jnp.zeros((128,), jnp.float32).at[0].set(1.0)
+        pz, sz = qcp.moment2_narrow(vz, "int8", 128)
+        backz = np.asarray(qcp.moment2_wide(pz, sz))
+        assert backz[1] == pytest.approx((np.asarray(sz)[0] / 2) ** 2)
+
+    @pytest.mark.skipif(not _HAS_FP8, reason="no float8_e4m3fn")
+    def test_fp8_weight_round_trip(self):
+        w = jnp.asarray(
+            np.random.RandomState(10).randn(128, 16).astype(np.float32))
+        p, s = qcp.quantize_weight(w, "fp8", 128)
+        assert p.dtype == qc.fp8_dtype()
+        dq = np.asarray(qcp.dequantize_weight(p, s, jnp.float32))
+        rel = np.max(np.abs(dq - np.asarray(w))) / np.max(np.abs(w))
+        assert rel < 0.07                       # e4m3: ~2^-3 mantissa
+
+    def test_policy_resolution_env_and_scope(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_Q_MATMUL", raising=False)
+        assert qcp.matmul_policy() is None
+        monkeypatch.setenv("PADDLE_Q_MATMUL", "off")
+        assert qcp.matmul_policy() is None
+        monkeypatch.setenv("PADDLE_Q_MATMUL", "int8")
+        assert qcp.matmul_policy() == ("int8", qcp.DEFAULT_BLOCK)
+        with qcp.matmul_scope(None):            # scope wins over env
+            assert qcp.matmul_policy() is None
+        monkeypatch.setenv("PADDLE_Q_MATMUL", "int9")
+        with pytest.raises(ValueError, match="PADDLE_Q_MATMUL"):
+            qcp.matmul_policy()
+
+
+# ---------------------------------------------------------------------------
+# strategy validation: every rejection is loud
+# ---------------------------------------------------------------------------
+
+
+class TestStrategyValidation:
+    def _opt(self, strategy, opt=None):
+        fleet.init(is_collective=True, strategy=strategy)
+        net = nn.Linear(8, 4)
+        if opt is None:
+            opt = optimizer.Adam(learning_rate=1e-3,
+                                 parameters=net.parameters())
+        return fleet.distributed_optimizer(opt, strategy=strategy)
+
+    def test_matmul_typo_raises(self):
+        s = DistributedStrategy()
+        s.quantized_matmul = "int9"
+        with pytest.raises(ValueError, match="quantized_matmul"):
+            self._opt(s)
+
+    @pytest.mark.skipif(_HAS_FP8, reason="platform has fp8")
+    def test_matmul_fp8_without_dtype_raises(self):
+        s = DistributedStrategy()
+        s.quantized_matmul = "fp8"
+        with pytest.raises(NotImplementedError, match="float8_e4m3fn"):
+            self._opt(s)
+
+    def test_moments_typo_raises(self):
+        s = DistributedStrategy()
+        s.quantized_moments = "int9"
+        with pytest.raises(ValueError, match="quantized_moments"):
+            self._opt(s)
+
+    def test_moments_fp16_allreduce_conflict_raises(self):
+        s = DistributedStrategy()
+        s.quantized_moments = "int8"
+        s.fp16_allreduce = True
+        with pytest.raises(ValueError, match="fp16_allreduce"):
+            self._opt(s)
+
+    def test_moments_non_adam_family_raises(self):
+        s = DistributedStrategy()
+        s.quantized_moments = "int8"
+        fleet.init(is_collective=True, strategy=s)
+        net = nn.Linear(8, 4)
+        sgd = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        with pytest.raises(ValueError, match="Adam-family"):
+            fleet.distributed_optimizer(sgd, strategy=s)
+
+    def test_moments_lamb_swap_raises(self):
+        """use_lamb swaps Adam OUT before the family check — the
+        swapped-in Lamb must fail loudly, not silently train wide."""
+        s = DistributedStrategy()
+        s.quantized_moments = "int8"
+        s.lamb = True
+        with pytest.raises(ValueError, match="Adam-family"):
+            self._opt(s)
+
+    def test_late_arm_raises(self):
+        net = nn.Linear(8, 4)
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        (net(x) ** 2).mean().backward()
+        opt.step()
+        with pytest.raises(RuntimeError, match="before the first step"):
+            opt.quantize_moments("int8")
+
+
+# ---------------------------------------------------------------------------
+# matmul parity through the TP linears (8-device mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestMatmulParity:
+    def test_tp_forward_parity(self):
+        """Col->Row megatron pair under int8 weights tracks the dense
+        full-width pair within the weight-quantization tolerance."""
+        _init_hybrid(dp=2, mp=4)
+        paddle.seed(11)
+        col = dist.ColumnParallelLinear(128, 32, gather_output=False)
+        row = dist.RowParallelLinear(32, 16, input_is_parallel=True)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).rand(4, 128).astype(np.float32))
+        ref = row(F.relu(col(x))).numpy()
+        with qcp.matmul_scope(("int8", 128)):
+            out = row(F.relu(col(x))).numpy()
+        rel = np.max(np.abs(out - ref)) / np.max(np.abs(ref))
+        assert 0 < rel < 0.05                  # quantized, and close
+
+    def test_backward_parity_and_full_width_master_grad(self):
+        paddle.seed(12)
+        fc = nn.Linear(256, 8)
+        x = paddle.to_tensor(
+            np.random.RandomState(4).rand(4, 256).astype(np.float32))
+
+        def grads(quant):
+            fc.clear_gradients()
+            if quant:
+                with qcp.matmul_scope(("int8", 128)):
+                    loss = (fc(x) ** 2).mean()
+            else:
+                loss = (fc(x) ** 2).mean()
+            loss.backward()
+            return fc.weight.grad.numpy().copy()
+
+        gq, gf = grads(True), grads(False)
+        assert gq.dtype == np.float32           # full-width master grad
+        rel = np.max(np.abs(gq - gf)) / np.max(np.abs(gf))
+        assert 0 < rel < 0.05
+
+    def test_off_switch_is_bitwise_dense(self, monkeypatch):
+        """No scope, no env: F.linear output is BIT-identical to the
+        plain jnp.matmul reference — the round-19 off-switch."""
+        monkeypatch.delenv("PADDLE_Q_MATMUL", raising=False)
+        paddle.seed(13)
+        fc = nn.Linear(64, 16)
+        x = paddle.to_tensor(
+            np.random.RandomState(5).rand(8, 64).astype(np.float32))
+        ref = np.asarray(
+            x._data @ fc.weight._data + fc.bias._data)
+        assert np.array_equal(fc(x).numpy(), ref)
+
+
+# ---------------------------------------------------------------------------
+# int8 optimizer moments
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedMoments:
+    def _traj(self, quant, steps=20):
+        rng = np.random.RandomState(6)
+        paddle.seed(14)
+        net = nn.Linear(64, 16)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=net.parameters())
+        if quant:
+            opt.quantize_moments(quant)
+        x = paddle.to_tensor(rng.rand(8, 64).astype(np.float32))
+        for _ in range(steps):
+            (net(x) ** 2).mean().backward()
+            opt.step()
+            opt.clear_grad()
+        return net.weight.numpy().copy(), opt
+
+    def test_trajectory_within_qdq_bound(self):
+        wq, optq = self._traj("int8")
+        wf, _ = self._traj(None)
+        rel = np.max(np.abs(wq - wf)) / np.max(np.abs(wf))
+        # per-step moment error is one quantize_dequantize round trip
+        # (~0.4% rel for int8/128); 20 steps compound to a few percent
+        assert rel < 0.05
+        # state is RESIDENT narrow: int8 payloads + f32 scales
+        for nm in ("moment1", "moment2"):
+            for arr in optq._accumulators[nm].values():
+                assert arr.dtype == jnp.int8
+            for arr in optq._accumulators[nm + "_scale"].values():
+                assert arr.dtype == jnp.float32
+
+    def test_composes_with_gradient_merge(self):
+        s = DistributedStrategy()
+        s.quantized_moments = "int8"
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 2}
+        fleet.init(is_collective=True, strategy=s)
+        paddle.seed(15)
+        net = nn.Linear(16, 4)
+        opt = fleet.distributed_optimizer(
+            optimizer.Adam(learning_rate=1e-2,
+                           parameters=net.parameters()),
+            strategy=s)
+        step = TrainStep(net, lambda out, y: (out ** 2).mean(), opt)
+        x = paddle.to_tensor(
+            np.random.RandomState(7).rand(8, 16).astype(np.float32))
+        y = paddle.to_tensor(np.zeros((8, 4), np.float32))
+        first = float(step(x, y).numpy())
+        for _ in range(5):
+            last = float(step(x, y).numpy())
+        assert np.isfinite(last) and last < first
+
+
+# ---------------------------------------------------------------------------
+# quantized checkpoints -> serving
+# ---------------------------------------------------------------------------
+
+
+def _tiny_lm():
+    paddle.seed(102)
+    np.random.seed(102)
+    return TransformerLM(64, d_model=32, num_heads=4, num_layers=2,
+                         max_position=64)
+
+
+def _greedy(model, prompt, n=8):
+    toks = list(prompt)
+    for _ in range(n):
+        x = paddle.to_tensor(np.asarray(toks, np.int64)[None, :])
+        toks.append(int(np.asarray(model(x)._data)[0, -1].argmax()))
+    return toks[len(prompt):]
+
+
+class TestQuantizedCheckpoint:
+    def test_save_load_decode_token_exact(self, tmp_path):
+        base = _tiny_lm()
+        prompt = list(np.random.RandomState(8).randint(0, 64, size=4))
+        ref_toks = _greedy(base, prompt)
+
+        path = str(tmp_path / "m")
+        info = save_quantized(base, path, dtype="int8")
+        assert info["bytes_payload"] > 0 and info["bytes_scales"] > 0
+        # payload on disk IS int8 — never a widened copy
+        with np.load(path + ".pdqparams") as z:
+            qnames = [k for k in z.files if k.endswith("::q")]
+            assert qnames and all(z[k].dtype == np.int8 for k in qnames)
+
+        fresh = _tiny_lm()
+        meta = fresh.load_quantized(path)
+        assert meta["load_ms"] >= 0 and meta["dtype"] == "int8"
+        # resident narrow: every quantized weight is int8 + scale buf
+        n_narrow = 0
+        for _, sub, w in qcp.iter_quantizable(fresh):
+            if getattr(w, "_q_scale", None) is not None:
+                assert w._data.dtype == jnp.int8
+                assert sub._buffers[qcp.SCALE_BUFFER] is w._q_scale
+                n_narrow += 1
+        assert n_narrow == len(meta["quantized"]) > 0
+        assert _greedy(fresh, prompt) == ref_toks
+
+    def test_mismatched_architecture_raises(self, tmp_path):
+        path = str(tmp_path / "m")
+        save_quantized(_tiny_lm(), path, dtype="int8")
+        paddle.seed(102)
+        np.random.seed(102)
+        other = TransformerLM(64, d_model=32, num_heads=4, num_layers=3,
+                              max_position=64)
+        with pytest.raises(ValueError):
+            other.load_quantized(path)
+
+    def test_expand_slots_attributes_quantized_bytes(
+            self, tmp_path, monkeypatch):
+        from paddle_tpu.serving.engine import InferenceEngine, Request
+
+        obs = tmp_path / "obs"
+        obs.mkdir()
+        monkeypatch.setenv("PADDLE_OBS_DIR", str(obs))
+        path = str(tmp_path / "m")
+        save_quantized(_tiny_lm(), path, dtype="int8")
+        m = _tiny_lm()
+        m.load_quantized(path)
+        eng = InferenceEngine(m, slots=2, max_length=16, sync_every=4)
+        eng.submit(Request(np.arange(4), max_new_tokens=2))
+        eng.run()
+        eng.expand_slots(2)
+        recs = [json.loads(line) for line in
+                open(obs / "telemetry.rank0.jsonl")]
+        ex = [r for r in recs if r.get("kind") == "engine_expand"]
+        pl = ex[-1].get("payload", ex[-1])
+        assert pl["weights_quantized"] > 0
+        assert pl["weights_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the off-switch guarantee + telemetry, end to end
+# ---------------------------------------------------------------------------
+
+
+class TestOffSwitchAndTelemetry:
+    def _run_steps(self, strategy, steps=3):
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(16)
+        net = nn.Linear(32, 8)
+        opt = fleet.distributed_optimizer(
+            optimizer.Adam(learning_rate=1e-3,
+                           parameters=net.parameters()),
+            strategy=strategy)
+        step = TrainStep(net, lambda out, y: (out ** 2).mean(), opt)
+        x = paddle.to_tensor(
+            np.random.RandomState(9).rand(8, 32).astype(np.float32))
+        y = paddle.to_tensor(np.zeros((8, 8), np.float32))
+        losses = [float(step(x, y).numpy()) for _ in range(steps)]
+        return losses, net.weight.numpy().copy(), step
+
+    def test_all_knobs_off_bitwise_identical(self, monkeypatch):
+        """Defaults vs explicit-off env: bit-for-bit the same train."""
+        monkeypatch.delenv("PADDLE_Q_MATMUL", raising=False)
+        l1, w1, s1 = self._run_steps(DistributedStrategy())
+        assert s1._q_matmul is None
+        comm._state.hybrid_mesh = None
+        monkeypatch.setenv("PADDLE_Q_MATMUL", "off")
+        l2, w2, _ = self._run_steps(DistributedStrategy())
+        assert l1 == l2
+        assert np.array_equal(w1, w2)
+
+    def test_armed_step_emits_quant_records(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_OBS_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_GUARD_SYNC_EVERY", "2")
+        s = DistributedStrategy()
+        s.quantized_matmul = "int8"
+        s.quantized_moments = "int8"
+        losses, _, step = self._run_steps(s, steps=8)
+        assert all(np.isfinite(losses))
+        assert step._q_matmul == ("int8", 128)
+        assert step._q_matmul_info["reduction_x"] > 1
+        assert step._moment_bytes_info["reduction_x"] > 1
+        recs = [json.loads(line) for line in
+                open(tmp_path / "telemetry.rank0.jsonl")]
+        kinds = {r.get("kind") for r in recs}
+        assert "q_matmul" in kinds and "moment_bytes" in kinds
+        sm = [r for r in recs if r.get("kind") == "step_metrics"]
+        pl = sm[-1].get("payload", sm[-1])
+        assert "q_matmul" in pl and "moment_bytes" in pl
+
+    def test_off_step_metrics_rows_carry_no_quant_keys(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_OBS_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_GUARD_SYNC_EVERY", "2")
+        monkeypatch.delenv("PADDLE_Q_MATMUL", raising=False)
+        self._run_steps(DistributedStrategy(), steps=8)
+        recs = [json.loads(line) for line in
+                open(tmp_path / "telemetry.rank0.jsonl")]
+        sm = [r for r in recs if r.get("kind") == "step_metrics"]
+        pl = sm[-1].get("payload", sm[-1])
+        assert "q_matmul" not in pl and "moment_bytes" not in pl

@@ -1050,6 +1050,76 @@ class TestEnvKnobRule:
         assert not names(findings, "env-knob-docs")
 
 
+class TestUnscaledInt8:
+    """ISSUE 19: a narrow int8 cast with no per-block scale anywhere in
+    the function silently truncates float payloads to integer steps —
+    the quantization plane always pairs payload with f32 scales."""
+
+    PRE_FIX = """
+        import jax.numpy as jnp
+
+        def narrow_moments(m):
+            # looks like compression, actually truncation to [-128,127]
+            return m.astype(jnp.int8)
+    """
+
+    FIXED = """
+        import jax.numpy as jnp
+
+        def narrow_moments(m, block=128):
+            qmax = 127.0
+            scale = jnp.max(jnp.abs(m), axis=-1, keepdims=True) / qmax
+            payload = jnp.round(m / scale).astype(jnp.int8)
+            return payload, scale
+    """
+
+    def test_pre_fix_flags(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.PRE_FIX},
+                      rule="unscaled-int8")
+        hits = names(fs, "unscaled-int8")
+        assert len(hits) == 1
+        assert "scale" in hits[0].message
+
+    def test_shipped_fix_quiet(self, tmp_path):
+        fs = run_lint(tmp_path, {"mod.py": self.FIXED},
+                      rule="unscaled-int8")
+        assert not names(fs, "unscaled-int8")
+
+    def test_asarray_dtype_form_flags(self, tmp_path):
+        src = """
+            import numpy as np
+
+            def pack(x):
+                return np.asarray(x, dtype="int8")
+        """
+        fs = run_lint(tmp_path, {"mod.py": src}, rule="unscaled-int8")
+        assert len(names(fs, "unscaled-int8")) == 1
+
+    def test_allocation_forms_quiet(self, tmp_path):
+        """zeros/full int8 buffers are allocation, not truncation."""
+        src = """
+            import jax.numpy as jnp
+
+            def seed_payload(shape):
+                return jnp.zeros(shape, dtype=jnp.int8)
+        """
+        fs = run_lint(tmp_path, {"mod.py": src}, rule="unscaled-int8")
+        assert not names(fs, "unscaled-int8")
+
+    def test_shipped_quantization_plane_quiet(self):
+        """quantized_comm/quantized_compute ARE the shipped fix: every
+        narrow cast sits next to its per-block scale."""
+        findings, errors = lint_core.run(
+            [os.path.join(REPO, "paddle_tpu", "distributed",
+                          "quantized_comm.py"),
+             os.path.join(REPO, "paddle_tpu", "distributed",
+                          "quantized_compute.py")],
+            rules={"unscaled-int8"}, root=REPO,
+        )
+        assert not errors
+        assert not names(findings, "unscaled-int8")
+
+
 class TestCli:
     def _run(self, *args, env_extra=None):
         env = dict(os.environ)
@@ -1116,7 +1186,7 @@ class TestCli:
         for rule in ("pallas-in-gspmd", "host-sync-in-step",
                      "donation-alias", "divergent-collective",
                      "numpy-on-tracer", "psum-in-shard-vjp",
-                     "env-knob-docs", "alias-parity"):
+                     "env-knob-docs", "alias-parity", "unscaled-int8"):
             assert rule in r.stdout
 
     def test_write_baseline_refuses_filtered_runs(self, tmp_path):

@@ -85,6 +85,16 @@ def metric_direction(name: str):
         return -1  # round-17 migrate twin of the gated _ms key
     if name.endswith("_ms") or name.endswith("_s"):
         return -1
+    # round-19 quantization byte accounting: static shape arithmetic,
+    # not a timed sample — zero noise, so a >10% move is a structural
+    # change (a layer silently falling off the narrow path) and IS
+    # gated. The round-11 comm_mb key predates this and stays
+    # report-only as documented.
+    if name in ("q_ckpt_payload_mb", "gpt_medium_bf16_q8m_moment_mb"):
+        return -1
+    if name in ("q_ckpt_reduction_x",
+                "gpt_medium_bf16_q8m_moment_reduction_x"):
+        return 1
     return None
 
 

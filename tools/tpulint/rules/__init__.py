@@ -12,4 +12,5 @@ from . import (  # noqa: F401  (import-for-registration)
     shard_vjp,
     env_knobs,
     alias_parity,
+    unscaled_int8,
 )

@@ -1,0 +1,115 @@
+"""unscaled-int8: a bare narrow-integer cast with no per-block scale in
+sight.
+
+PR-history exemplar: the quantization plane (quantized_comm /
+quantized_compute, PRs 10 and 19) never casts to int8 naked — every
+narrow payload is `round(x / scale)` clipped to the qmax and paired
+with an f32 per-block scale tensor, or the dequantized values are off
+by the (arbitrary) magnitude of the block.  A raw ``x.astype(jnp.int8)``
+on float data silently truncates to [-128, 127] integer steps: unit
+tests on toy ranges near ±1 pass (everything rounds to 0 or ±1 and the
+loss barely moves), while real weights/moments lose all mantissa.
+
+Statically: flag ``<expr>.astype(int8/uint8)`` and
+``jnp/np.asarray(x, dtype=int8)``-family casts inside functions that
+neither bind nor read any identifier containing ``scale`` (or ``qmax``)
+— the quantization helpers all do, so the real encode paths stay
+quiet.  Integer *data* casts (token ids, masks) are the other
+legitimate user; those live in functions without float math on the
+cast operand, but statically we cannot see dtypes, so the rule keeps
+the heuristic one-sided: any scale-free function doing a narrow cast
+is worth a human look, and a false positive is silenced by the usual
+``# tpulint: disable=unscaled-int8`` or by threading the scale through
+the same function (which is the fix anyway).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted
+from ..core import Rule, register
+
+_NARROW = {"int8", "uint8"}
+# identifiers whose presence marks a function as scale-aware
+_SCALE_MARKERS = ("scale", "qmax")
+
+
+def _is_narrow_dtype(node) -> bool:
+    """`jnp.int8` / `np.int8` / `"int8"` / bare `int8`."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _NARROW
+    d = dotted(node)
+    return d.split(".")[-1] in _NARROW
+
+
+def _func_idents(func) -> set:
+    out = set()
+    for n in ast.walk(func):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.arg):
+            out.add(n.arg)
+        elif isinstance(n, ast.keyword) and n.arg:
+            out.add(n.arg)
+    return out
+
+
+def _scale_aware(func) -> bool:
+    idents = _func_idents(func)
+    return any(m in name.lower() for name in idents
+               for m in _SCALE_MARKERS)
+
+
+@register
+class UnscaledInt8Rule(Rule):
+    name = "unscaled-int8"
+    summary = ("narrow int8/uint8 cast in a function with no per-block "
+               "scale anywhere in sight")
+
+    def check(self, mod):
+        if "int8" not in mod.text:
+            return
+        graph = mod.graph()
+        tree = mod.graph().tree
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            narrow = None
+            d = dotted(node.func)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype" and node.args
+                    and _is_narrow_dtype(node.args[0])):
+                narrow = dotted(node.args[0]) or "int8"
+            elif d.split(".")[-1] in ("asarray", "array", "full",
+                                      "zeros", "ones", "empty"):
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and _is_narrow_dtype(kw.value):
+                        narrow = dotted(kw.value) or "int8"
+                # zeros/full-style buffers are allocation, not value
+                # truncation — only the value-converting forms count
+                if d.split(".")[-1] not in ("asarray", "array"):
+                    narrow = None
+            if narrow is None:
+                continue
+            func = graph.owner_func(node)
+            if func is None:
+                # module level: scan the whole module for scale markers
+                if any(m in mod.text.lower() for m in _SCALE_MARKERS):
+                    continue
+                where = "module level"
+            else:
+                if _scale_aware(func):
+                    continue
+                where = f"`{func.name}`"
+            yield self.finding(
+                mod, node,
+                f"bare cast to {narrow} at {where} with no scale "
+                "bound anywhere in the function — a narrow integer "
+                "payload without a paired per-block scale truncates "
+                "float data to [-128, 127] steps; quantize via "
+                "quantize_blockwise/quantize_lastaxis (payload + f32 "
+                "scales) or silence with a tpulint disable if this is "
+                "genuinely integer data",
+            )
