@@ -1134,6 +1134,108 @@ def _bench_ctl(waves=8, per_wave=6, budget=8, rate=4000.0):
     return out
 
 
+def _bench_ctl_live(steps=30, hot=12):
+    """Live lend plane (ISSUE 20): the serving-capacity latency a live
+    migration actually delivers. Runs one 2-rank launcher cycle over
+    the jax-free ``tiny_rank`` live protocol (``PADDLE_CTL=live``),
+    watches the journal for the ``ctl_lend`` commit, drops a probe
+    request into the lent rank's mailbox THAT instant, and prices
+
+    - ``ctl_live_lend_ms``: lend commit -> the probe request's done
+      file (first served tokens). This is the number the whole phase
+      ladder exists to minimize — weight delivery via ``.pdqparams``
+      (the 4x-narrower int8 load from round 19) is its dominant term —
+      and it lands under the continuity gate's lower-better ``_ms``
+      rule;
+    - ``ctl_live_reclaim_ms``: the reclaim ladder's begin->commit wall
+      time from the journal (drain + leave + rejoin). Report-only: it
+      scales with whatever queue depth drain happens to find, so
+      gating it would flake.
+    """
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="pdtpu_ctl_live_")
+    obs = os.path.join(tmp, "obs")
+    serve = os.path.join(tmp, "serve")
+    ckpt = os.path.join(tmp, "w.pdqparams")
+    os.makedirs(obs)
+    with open(ckpt, "wb") as f:
+        f.write(b"\0" * 1_000_000)
+    env = dict(os.environ)
+    for k in ("PADDLE_FAULT_SPEC", "PADDLE_OBS_BUS_FILE"):
+        env.pop(k, None)
+    env.update({
+        "PADDLE_OBS_DIR": obs, "PADDLE_CTL": "live",
+        "PADDLE_RESHARD_MODE": "shrink", "PADDLE_MON_POLL": "0.05",
+        "PADDLE_CTL_WINDOW_S": "0.15", "PADDLE_CTL_SUSTAIN_N": "2",
+        "PADDLE_CTL_COOLDOWN_N": "2",
+        "PADDLE_CTL_SERVE_CKPT": ckpt, "PADDLE_CTL_SERVE_DIR": serve,
+        "TINY_MODE": "live", "TINY_TRAIN_STEPS": str(steps),
+        "TINY_TRAIN_DT": "0.05", "TINY_SERVE_HOT": str(hot),
+        "JAX_PLATFORMS": "cpu",
+    })
+    journal = os.path.join(obs, "telemetry.launcher.jsonl")
+    done = os.path.join(serve, "host1", "outbox", "done_bench.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2",
+         os.path.join(repo, "tests", "helpers", "tiny_rank.py")],
+        env=env, cwd=repo, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    try:
+        # watch for the lend commit, then stage the probe request
+        t_commit = None
+        deadline = time.time() + 60
+        while time.time() < deadline and t_commit is None:
+            if proc.poll() is not None:
+                break
+            if os.path.exists(journal):
+                for line in open(journal):
+                    try:
+                        r = json.loads(line)
+                    except ValueError:
+                        continue
+                    if r.get("kind") == "ctl_lend" and \
+                            r["payload"].get("phase") == "commit":
+                        t_commit = float(r["time"])
+                        break
+            time.sleep(0.002)
+        assert t_commit is not None, "ctl live bench: lend never committed"
+        inbox = os.path.join(serve, "host1", "inbox")
+        os.makedirs(inbox, exist_ok=True)
+        with open(os.path.join(inbox, "req_bench.json"), "w") as f:
+            json.dump({"rid": "bench", "token_ids": [5, 7],
+                       "max_new_tokens": 4}, f)
+        while time.time() < deadline and not os.path.exists(done):
+            if proc.poll() is not None:
+                break
+            time.sleep(0.002)
+        assert os.path.exists(done), "ctl live bench: request never served"
+        lend_ms = (os.stat(done).st_mtime - t_commit) * 1e3
+        rc = proc.wait(timeout=60)
+        assert rc == 0, f"ctl live bench: launcher rc {rc}"
+        reclaim_ms = None
+        for line in open(journal):
+            r = json.loads(line)
+            if r.get("kind") == "ctl_reclaim" and \
+                    r["payload"].get("phase") == "commit" and \
+                    not r["payload"].get("forced"):
+                reclaim_ms = float(r["payload"].get("dur_ms") or 0.0)
+                break
+        assert reclaim_ms is not None, "ctl live bench: never reclaimed"
+        return {"ctl_live_lend_ms": round(max(lend_ms, 0.0), 1),
+                "ctl_live_reclaim_ms": round(reclaim_ms, 1)}
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_serve_multitenant(prompt_len=128, new_tokens=32, block=16):
     """Multi-tenant serving plane (ISSUE 18): the submit->first-token
     time of a borrower whose preamble is already PUBLISHED in the
@@ -1504,6 +1606,15 @@ def main():
         )
         extra.update(ctl_bd)
         extra["ctl_lend_ms_spread"] = ctl_sp
+        # live lend plane (ISSUE 20): lend-commit -> first served token
+        # over a real launcher cycle (gated _ms key); the reclaim
+        # ladder's wall time rides report-only (drain depth varies)
+        cl_ms, cl_bd, cl_sp = _repeat(
+            lambda: (lambda d: (d["ctl_live_lend_ms"], d))(
+                _bench_ctl_live())
+        )
+        extra.update(cl_bd)
+        extra["ctl_live_lend_ms_spread"] = cl_sp
         # multi-tenant serving plane (ISSUE 18): warm-prefix TTFT and
         # the disaggregated decode-tier throughput land under the gate
         # (_ms lower-better / per_sec higher-better); the prefix hit

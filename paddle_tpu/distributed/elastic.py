@@ -55,6 +55,23 @@ TPU-native additions over the reference watch loop:
   actuation callbacks are not wired (training steps and serving
   engines live in the children; in-process co-tenants construct
   ``FleetController`` themselves with lend/reclaim callbacks).
+- **live lend plane** (ISSUE 20): ``PADDLE_CTL=live`` wires the
+  controller's :class:`~.fleet_controller.PhaseActuators` to a file
+  protocol against the children (:class:`_LiveLendPlane`): a committed
+  ``ctl_lend`` drives the lent dp row through depart (a role-carrying
+  "lend" reshard notice — survivors shrink in place, the named rank
+  reads its new job), deliver (the child loads the
+  ``PADDLE_CTL_SERVE_CKPT`` quantized checkpoint, ack deadline
+  ``PADDLE_CTL_PHASE_TIMEOUT_S``), and join (the child's serving
+  mailbox comes up under ``PADDLE_CTL_SERVE_DIR``); ``ctl_reclaim``
+  reverses it (drain marker → drained ack → leave → a "reclaim"
+  notice rejoins the row, one ledger-attributed recompile). Every
+  phase is its own fsync'd journal pair; a crash at any point recovers
+  probe-or-rollback from the journal alone. A LENT rank dying while
+  serving (the ``serve:lent_worker_crash`` fault) is a serving-plane
+  event, not a training failure: the launcher journals a FORCED
+  reclaim — ownership returns to the training plane, where the dead
+  process then takes the standard rank-loss path.
 """
 from __future__ import annotations
 
@@ -179,6 +196,168 @@ class RankProc:
         self.notice_path = notice_path
 
 
+class _LiveLendPlane:
+    """The launcher side of the live lend plane (ISSUE 20): phase
+    actuators driving CHILD processes over a file protocol, no shared
+    memory with them.
+
+    The contract per phase (all acks land in the lend dir the notice
+    row names as ``ack_dir``; the launcher waits at most
+    ``PADDLE_CTL_PHASE_TIMEOUT_S`` per phase, default 30 s):
+
+    - **depart**: a role-carrying ``lend`` reshard notice goes to every
+      live rank. Survivors fold it like a departure at their next step
+      boundary (PR 11 — no relaunch); the NAMED rank stops training
+      and acks ``rank<r>.departed``.
+    - **deliver**: the lent rank loads the serving checkpoint the
+      notice named (``PADDLE_CTL_SERVE_CKPT``, the PR-18
+      ``load_quantized`` resident path) and acks ``rank<r>.delivered``
+      (payload: its ``load_ms``). The deadline bounds a wedged load.
+    - **join**: the rank's serving mailbox worker comes up under the
+      notice's ``serve_dir`` (``PADDLE_CTL_SERVE_DIR``) and acks
+      ``rank<r>.serving`` — the marker a router-side co-tenant polls
+      before ``add_host``/``register_capacity`` admits traffic into
+      the new worker.
+    - **drain**: the launcher writes ``rank<r>.drain``; the worker
+      stops taking new mailbox work, finishes what it holds (the PR-14
+      zero-drop drain; PR-16 migrates what cannot finish) and acks
+      ``rank<r>.drained``.
+    - **leave**: serving teardown — the worker retires its mailbox and
+      acks ``rank<r>.left``.
+    - **rejoin**: a ``reclaim`` notice returns the row to the training
+      mesh (survivors expand at a step boundary — the one
+      ledger-attributed recompile); the rank acks ``rank<r>.rejoined``
+      and the lend-dir state for it is cleared.
+
+    ``probe``/``rollback`` close the crash loop: probe answers "is the
+    rank alive AND past its serving ack" from the markers + the
+    process table; rollback converges a half-done ladder to what the
+    journal says — a failed lend re-sends the ``reclaim`` notice (a
+    survivor that never consumed the lend nets the two rows out), a
+    failed reclaim cancels the drain marker so the row stays serving.
+    """
+
+    __slots__ = ("mgr", "timeout", "ckpt", "serve_dir")
+
+    def __init__(self, mgr: "ElasticManager"):
+        self.mgr = mgr
+        raw = os.environ.get("PADDLE_CTL_PHASE_TIMEOUT_S", "")
+        try:
+            self.timeout = float(raw) if raw.strip() else 30.0
+        except ValueError:
+            self.timeout = 30.0
+        self.ckpt = os.environ.get("PADDLE_CTL_SERVE_CKPT") or None
+        self.serve_dir = os.environ.get("PADDLE_CTL_SERVE_DIR") or None
+
+    # -- file protocol ----------------------------------------------------
+    def lend_dir(self) -> str:
+        d = os.path.join(self.mgr._run_dir, "lend")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _marker(self, rank: int, state: str) -> str:
+        return os.path.join(self.lend_dir(), f"rank{rank}.{state}")
+
+    def clear(self, rank: int) -> None:
+        for state in ("departed", "delivered", "serving", "drain",
+                      "drained", "left", "rejoined"):
+            try:
+                os.unlink(self._marker(rank, state))
+            except OSError:
+                pass
+
+    def _live(self) -> List[RankProc]:
+        return [rp for rp in self.mgr._procs if rp.proc.poll() is None]
+
+    def _wait_ack(self, rank: int, state: str, phase: str) -> None:
+        path = self._marker(rank, state)
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                return
+            rp = self.mgr._rank_proc(rank)
+            if rp is None or rp.proc.poll() is not None:
+                raise RuntimeError(
+                    f"live lend {phase}: rank {rank} died before its "
+                    f"{state} ack")
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"live lend {phase}: rank {rank} gave no {state} ack "
+            f"within {self.timeout}s")
+
+    def _notice_extra(self) -> dict:
+        return {"ack_dir": self.lend_dir(), "ckpt": self.ckpt,
+                "serve_dir": self.serve_dir}
+
+    # -- the lend ladder --------------------------------------------------
+    def depart(self, rank: int, samp) -> None:
+        self.clear(rank)  # stale acks from a prior cycle must not
+        # satisfy this ladder's waits
+        self.mgr._notify_reshard("lend", [rank], self._live(),
+                                 extra=self._notice_extra())
+        self._wait_ack(rank, "departed", "depart")
+
+    def deliver(self, rank: int, samp) -> None:
+        # the load itself runs in the child (PR-18 load_quantized off
+        # the resident .pdqparams); this side holds the DEADLINE — a
+        # wedged weight load aborts the transition instead of leaving
+        # the row neither training nor serving
+        self._wait_ack(rank, "delivered", "deliver")
+
+    def join(self, rank: int, samp) -> None:
+        self._wait_ack(rank, "serving", "join")
+
+    # -- the reclaim ladder -----------------------------------------------
+    def drain(self, rank: int, samp) -> None:
+        with open(self._marker(rank, "drain"), "w"):
+            pass
+        self._wait_ack(rank, "drained", "drain")
+
+    def leave(self, rank: int, samp) -> None:
+        self._wait_ack(rank, "left", "leave")
+
+    def rejoin(self, rank: int, samp) -> None:
+        self.mgr._notify_reshard("reclaim", [rank], self._live(),
+                                 extra=self._notice_extra())
+        self._wait_ack(rank, "rejoined", "rejoin")
+        self.clear(rank)
+
+    # -- crash loop -------------------------------------------------------
+    def probe(self, rank: int) -> bool:
+        rp = self.mgr._rank_proc(rank)
+        return (rp is not None and rp.proc.poll() is None
+                and os.path.exists(self._marker(rank, "serving"))
+                and not os.path.exists(self._marker(rank, "left")))
+
+    def rollback(self, verb: str, stage, completed, ranks) -> None:
+        for rank in ranks:
+            if verb == "lend":
+                # converge to training ownership: the reclaim notice
+                # undoes the lend for everyone — a survivor that never
+                # consumed the lend row nets the pair out in order
+                # (resharding folds events sequentially), the named
+                # rank drops its serve role
+                self.mgr._notify_reshard(
+                    "reclaim", [rank], self._live(),
+                    extra=self._notice_extra())
+                self.clear(rank)
+            else:
+                # reclaim failed mid-ladder: the journal still says
+                # LENT — cancel the drain so the row keeps serving
+                try:
+                    os.unlink(self._marker(rank, "drain"))
+                except OSError:
+                    pass
+
+    def actuators(self):
+        from .fleet_controller import PhaseActuators
+
+        return PhaseActuators(
+            depart=self.depart, deliver=self.deliver, join=self.join,
+            drain=self.drain, leave=self.leave, rejoin=self.rejoin,
+            probe=self.probe, rollback=self.rollback)
+
+
 class ElasticManager:
     """Spawn this node's ranks and keep the job alive across failures.
 
@@ -245,20 +424,24 @@ class ElasticManager:
         if controller is None:
             controller = os.environ.get(_CTL_ENV, "off")
         self.controller_mode = (controller or "off").strip().lower() or "off"
-        if self.controller_mode not in ("off", "dryrun"):
+        if self.controller_mode not in ("off", "dryrun", "live"):
             raise ValueError(
-                f"controller={self.controller_mode!r}: want off|dryrun "
-                f"(live actuation wires callbacks in-process, not here)")
+                f"controller={self.controller_mode!r}: want "
+                f"off|dryrun|live")
+        if self.controller_mode == "live" and self.reshard == "off":
+            raise ValueError(
+                "controller='live' needs reshard='shrink'/"
+                "'shrink_expand': the depart/rejoin phases ride the "
+                "reshard notice channel")
         #: the embedded co-tenancy controller (ISSUE 16): rides next to
         #: the monitor at rank -1, consuming its serving aggregates.
-        #: The launcher embeds it DRYRUN-only — decisions and the
-        #: journal are real, actuation callbacks are not wired (the
-        #: training step and the serving engine live in the children;
-        #: in-process co-tenants construct FleetController themselves
-        #: with lend/reclaim callbacks)
+        #: ``dryrun`` journals decisions without actuating; ``live``
+        #: (ISSUE 20) wires the _LiveLendPlane phase actuators so a
+        #: committed decision really migrates the rank between jobs
         self.controller = None
         self._ctl_thread: Optional[threading.Thread] = None
         self._ctl_stop = threading.Event()
+        self._lend_plane = None
         self._run_dir = None          # heartbeat-file home, made lazily
         self._procs: List[RankProc] = []
         self._retired: List[RankProc] = []  # resharded-away ranks
@@ -395,21 +578,32 @@ class ElasticManager:
     # -- embedded co-tenancy controller (ISSUE 16) ------------------------
     def _start_controller(self, obs_dir: Optional[str]) -> None:
         """Run the lend/reclaim state machine at rank -1, next to the
-        monitor it feeds from. Launcher embedding is dryrun-only: every
-        window samples the monitor's serving aggregates, the hysteresis
-        policy decides, decisions journal to the launcher bus stream
-        (crash-recoverable) -- but no actuation callbacks are wired, so
-        ownership changes are declared, not executed. One controller
-        per job; relaunch attempts keep the journal, so recovery
-        re-derives lent state instead of guessing."""
+        monitor it feeds from. Every window samples the monitor's
+        serving aggregates, the hysteresis policy decides, decisions
+        journal to the launcher bus stream (crash-recoverable). In
+        ``dryrun`` no actuation is wired — ownership changes are
+        declared, not executed; in ``live`` (ISSUE 20) the
+        _LiveLendPlane phase actuators drive the children through the
+        depart/deliver/join (and drain/leave/rejoin) ladders for real.
+        One controller per job; relaunch attempts keep the journal, so
+        recovery re-derives lent state — and rolls half-done ladders
+        back — instead of guessing."""
         if (self.controller is not None or self.controller_mode == "off"
                 or not obs_dir or _fleet_ctl is None
                 or self.monitor is None):
             return
         donors = sorted(rp.rank for rp in self._procs)
+        actuators = None
+        if self.controller_mode == "live":
+            # ISSUE 20: wire the real phase ladder — a committed
+            # decision now MOVES the rank between jobs, and the
+            # controller's recovery can probe/rollback the children
+            self._lend_plane = _LiveLendPlane(self)
+            actuators = self._lend_plane.actuators()
         try:
             self.controller = _fleet_ctl.FleetController(
-                obs_dir, monitor=self.monitor, donor_ranks=donors)
+                obs_dir, monitor=self.monitor, donor_ranks=donors,
+                actuators=actuators)
         except Exception:  # noqa: BLE001 — the controller never blocks spawn
             self.controller = None
             return
@@ -529,15 +723,26 @@ class ElasticManager:
         self._procs.remove(rp)
         self._retired.append(rp)
 
+    def _rank_proc(self, rank: int) -> Optional[RankProc]:
+        for rp in self._procs:
+            if rp.rank == rank:
+                return rp
+        return None
+
     def _notify_reshard(self, event: str, ranks: List[int],
-                        survivors: List[RankProc]) -> None:
+                        survivors: List[RankProc],
+                        extra: Optional[dict] = None) -> None:
         """Append one notice row to every survivor's notice file and
         poke it with SIGUSR1 (resharding.install_reshard_notice) — the
-        step-boundary poller does the rest in-process."""
+        step-boundary poller does the rest in-process. ``extra`` rides
+        extra row fields (the live lend plane's ack_dir/ckpt/serve_dir
+        — ISSUE 20)."""
         import json
 
         row = {"event": event, "ranks": ranks, "time": time.time(),
                "survivors": [s.rank for s in survivors]}
+        if extra:
+            row.update(extra)
         for rp in survivors:
             if rp.notice_path:
                 try:
@@ -578,6 +783,24 @@ class ElasticManager:
                 elif code != 0:
                     failed.append((rp, code))
             for rp, code in failed:
+                # a LENT rank dying is a serving-plane event (ISSUE 20,
+                # the serve:lent_worker_crash fault): the row already
+                # left the training mesh at depart, so survivors need
+                # no new notice — journal the FORCED reclaim (ownership
+                # back to the training plane, never half-lent) and let
+                # the router's failover re-home its in-flight requests
+                if (self.controller is not None
+                        and rp.rank in self.controller.lent):
+                    self._attribute(rp, f"lent worker death (rc={code})")
+                    self._retire(rp)
+                    if self._lend_plane is not None:
+                        self._lend_plane.clear(rp.rank)
+                    try:
+                        self.controller.force_reclaim(
+                            rp.rank, f"lent_worker_crash rc={code}")
+                    except Exception:  # noqa: BLE001 — journal-only path
+                        pass
+                    continue
                 # rank lost: an in-job event when the quorum holds and
                 # resharding is on; a job failure otherwise
                 if self._quorum_holds(len(alive)):

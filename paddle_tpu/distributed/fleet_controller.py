@@ -59,6 +59,31 @@ starts it at rank −1 next to the monitor thread when
 
     python -m paddle_tpu.distributed.fleet_controller --obs_dir <dir>
 
+The LIVE lend plane (ISSUE 20) replaces the single lend/reclaim
+callback pair with a journaled PHASE LADDER driven per transition::
+
+    lend:    depart → deliver → join
+    reclaim: drain  → leave   → rejoin
+
+Each phase is its own fsync'd ``ctl_phase`` begin→commit pair nested
+inside the outer ``ctl_lend``/``ctl_reclaim`` begin→commit envelope:
+**depart** retires the dp row from the training mesh (the PR-11
+reshard notice — survivors continue without relaunch), **deliver**
+loads serving weights onto the lent rank via the PR-18
+``load_quantized`` resident-checkpoint path (deadline-bounded),
+**join** registers the rank as a serving worker and admits traffic
+into it (``Router.register_capacity``); the reclaim ladder is the
+reverse (PR-14 live drain / PR-16 KV migration, then the PR-11 return
+notice — one ledger-attributed recompile). Recovery is
+probe-or-rollback per the journal: a SIGKILL between ANY begin/commit
+pair (the ``ctl:lend_crash:nth[:phase]`` fault) restarts into
+``_recover``, which probes the planes for the half-journaled
+transition and either writes the commit it proves or rolls the
+completed phases back — never a half-lent chip. Actuation stays
+injected (:class:`PhaseActuators`); with none wired the controller is
+the same journal-only dryrun as before, emitting no ``ctl_phase``
+rows at all.
+
 Stdlib-pure and standalone-loadable (no jax, no package imports) like
 ``observability/monitor.py`` — safe on a login node.
 """
@@ -73,23 +98,36 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set
 
-__all__ = ["CtlConfig", "LendPolicy", "FleetController",
+__all__ = ["CtlConfig", "LendPolicy", "FleetController", "PhaseActuators",
+           "LEND_PHASES", "RECLAIM_PHASES",
            "pressure_default", "sustain_n_default", "release_default",
            "cooldown_n_default", "lend_budget_default",
-           "window_s_default", "main"]
+           "window_s_default", "predict_default", "predict_n_default",
+           "main"]
 
 SCHEMA_VERSION = 1  # mirrors bus.SCHEMA_VERSION (stdlib-pure on purpose)
 
 _PRESSURE_ENV = "PADDLE_CTL_PRESSURE"
 _SUSTAIN_ENV = "PADDLE_CTL_SUSTAIN_N"
 _RELEASE_ENV = "PADDLE_CTL_RELEASE"
-_COOLDOWN_ENV = "PADDLE_CTL_COOLDOWN_N"
 _BUDGET_ENV = "PADDLE_CTL_LEND_BUDGET"
 _WINDOW_S_ENV = "PADDLE_CTL_WINDOW_S"
+_COOLDOWN_ENV = "PADDLE_CTL_COOLDOWN_N"
+_PREDICT_ENV = "PADDLE_CTL_PREDICT"
+_PREDICT_N_ENV = "PADDLE_CTL_PREDICT_N"
 
 #: journal kinds this module writes (tools/timeline.py renders the
-#: begin→commit pairs as duration slices on the controller track)
-_JOURNAL_KINDS = ("ctl_lend", "ctl_reclaim", "ctl_abort", "ctl_recover")
+#: begin→commit pairs as duration slices on the controller track);
+#: ``ctl_phase`` rows (ISSUE 20) appear only when live PhaseActuators
+#: are wired — a dryrun journal is byte-compatible with round 16
+_JOURNAL_KINDS = ("ctl_lend", "ctl_reclaim", "ctl_abort", "ctl_recover",
+                  "ctl_phase")
+
+#: the live-lend phase ladders (ISSUE 20) — mirror
+#: utils/fault_injection.LEND_PHASES/RECLAIM_PHASES (both modules must
+#: stay standalone-loadable, so neither imports the other's copy)
+LEND_PHASES = ("depart", "deliver", "join")
+RECLAIM_PHASES = ("drain", "leave", "rejoin")
 
 _FALLBACK_WRITE_LOCK = threading.Lock()
 
@@ -141,6 +179,21 @@ def window_s_default() -> float:
     """``PADDLE_CTL_WINDOW_S`` — seconds per control window
     (default 1)."""
     return max(_envf(_WINDOW_S_ENV, 1.0), 0.01)
+
+
+def predict_default() -> bool:
+    """``PADDLE_CTL_PREDICT`` — fold the TTFT digest TREND into the
+    pressure signal so the controller lends *before* the SLO burns
+    (default off; ``1``/``on``/``true`` enables)."""
+    return os.environ.get(_PREDICT_ENV, "").strip().lower() in \
+        ("1", "on", "true", "yes")
+
+
+def predict_n_default() -> int:
+    """``PADDLE_CTL_PREDICT_N`` — trailing control windows the TTFT
+    p50/p99 slope is fit over, and the horizon it is projected forward
+    (default 4; minimum 2 — a slope needs two points)."""
+    return max(int(_envf(_PREDICT_N_ENV, 4)), 2)
 
 
 def _consume_ctl_events() -> List:
@@ -199,14 +252,16 @@ class CtlConfig:
     """Resolved controller knobs (env defaults, ctor overrides)."""
 
     __slots__ = ("pressure", "sustain_n", "release", "cooldown_n",
-                 "lend_budget", "window_s")
+                 "lend_budget", "window_s", "predict", "predict_n")
 
     def __init__(self, pressure: Optional[float] = None,
                  sustain_n: Optional[int] = None,
                  release: Optional[float] = None,
                  cooldown_n: Optional[int] = None,
                  lend_budget: Optional[int] = None,
-                 window_s: Optional[float] = None):
+                 window_s: Optional[float] = None,
+                 predict: Optional[bool] = None,
+                 predict_n: Optional[int] = None):
         self.pressure = (pressure_default() if pressure is None
                          else float(pressure))
         self.sustain_n = (sustain_n_default() if sustain_n is None
@@ -219,6 +274,10 @@ class CtlConfig:
                             else max(int(lend_budget), 1))
         self.window_s = (window_s_default() if window_s is None
                          else max(float(window_s), 0.01))
+        self.predict = (predict_default() if predict is None
+                        else bool(predict))
+        self.predict_n = (predict_n_default() if predict_n is None
+                          else max(int(predict_n), 2))
         if self.release >= self.pressure:
             raise ValueError(
                 f"hysteresis requires release < pressure, got "
@@ -275,6 +334,48 @@ class LendPolicy:
         return None
 
 
+class PhaseActuators:
+    """The live lend plane's verbs (ISSUE 20), injected per phase.
+
+    Each phase callable has signature ``fn(rank, sample)`` and runs
+    between that phase's journal ``begin`` and ``commit`` rows —
+    raising aborts the whole transition (completed phases are rolled
+    back). ``probe(rank) -> bool`` answers "is this rank currently
+    serving on loan?" against the planes themselves — the restart
+    reconciliation oracle AND the per-row budget gate (a second lend
+    fires only while every already-lent row probes as serving).
+    ``rollback(verb, stage, completed, ranks)`` undoes the named
+    completed phases (in reverse) after a mid-ladder failure or a
+    recovered crash; exceptions from it are swallowed — rollback is
+    best-effort convergence, the journal is the authority. A phase
+    left None is a committed no-op (tests wire subsets)."""
+
+    __slots__ = ("depart", "deliver", "join", "drain", "leave",
+                 "rejoin", "probe", "rollback")
+
+    def __init__(self, depart: Optional[Callable] = None,
+                 deliver: Optional[Callable] = None,
+                 join: Optional[Callable] = None,
+                 drain: Optional[Callable] = None,
+                 leave: Optional[Callable] = None,
+                 rejoin: Optional[Callable] = None,
+                 probe: Optional[Callable] = None,
+                 rollback: Optional[Callable] = None):
+        self.depart = depart
+        self.deliver = deliver
+        self.join = join
+        self.drain = drain
+        self.leave = leave
+        self.rejoin = rejoin
+        self.probe = probe
+        self.rollback = rollback
+
+    def stage_fn(self, stage: str) -> Optional[Callable]:
+        if stage not in LEND_PHASES + RECLAIM_PHASES:
+            raise ValueError(f"unknown lend phase {stage!r}")
+        return getattr(self, stage)
+
+
 class FleetController:
     """Consume the monitor's serving aggregates, decide, journal,
     actuate.
@@ -283,11 +384,16 @@ class FleetController:
     launcher mode — the manager already tails the streams); pass None
     with ``own_monitor_factory`` (or use the CLI) to tail standalone.
     ``lend`` / ``reclaim`` are ``fn(ranks, sample)`` actuation
-    callbacks; both None = dryrun. ``probe`` is the restart
-    reconciliation callback: ``probe(pending) -> bool`` asks the planes
-    whether a journaled ``begin`` without its ``commit`` actually
-    happened. ``die_hook`` exists for tests — the default really does
-    ``os.kill(os.getpid(), sig)`` when a ``ctl:die`` fault fires."""
+    callbacks; both None = dryrun. ``actuators`` (ISSUE 20) supersedes
+    them with the live :class:`PhaseActuators` ladder — each transition
+    then runs depart→deliver→join (lend) or drain→leave→rejoin
+    (reclaim) as journaled ``ctl_phase`` begin→commit pairs. ``probe``
+    is the restart reconciliation callback: ``probe(pending) -> bool``
+    asks the planes whether a journaled ``begin`` without its
+    ``commit`` actually happened (default: derived from
+    ``actuators.probe`` when present). ``die_hook`` exists for tests —
+    the default really does ``os.kill(os.getpid(), sig)`` when a
+    ``ctl:die`` / ``ctl:lend_crash`` fault fires."""
 
     def __init__(self, obs_dir: str, *,
                  monitor=None,
@@ -295,6 +401,7 @@ class FleetController:
                  donor_ranks: Optional[List[int]] = None,
                  lend: Optional[Callable] = None,
                  reclaim: Optional[Callable] = None,
+                 actuators: Optional[PhaseActuators] = None,
                  probe: Optional[Callable] = None,
                  emit: bool = True,
                  die_hook: Optional[Callable] = None):
@@ -305,19 +412,32 @@ class FleetController:
         self.donor_ranks = sorted(donor_ranks or [])
         self.lend_fn = lend
         self.reclaim_fn = reclaim
+        self.actuators = actuators
+        if actuators is not None and (lend is not None
+                                      or reclaim is not None):
+            raise ValueError(
+                "wire either the legacy lend/reclaim callbacks or the "
+                "live PhaseActuators ladder, not both")
         self.emit = bool(emit)
         self.die_hook = die_hook or (
             lambda sig: os.kill(os.getpid(), sig))
         self._write_lock = _launcher_write_lock()
         self.lent: Set[int] = set()
+        #: lend commit order — reclaim pops the LAST lent row (LIFO),
+        #: reconstructed from the journal on restart
+        self.lent_order: List[int] = []
         self.seq = 0
         self.windows = 0
         self.transitions: List[dict] = []
+        self.deferred_lends = 0  # budget said yes, probe said not yet
         self._base: Optional[tuple] = None
+        self._ttft_trail: List[tuple] = []  # (p50, p99) per window
         self._flap_left = 0
         self._flap_tick = 0
         self._die_armed = False
         self._die_sig = signal.SIGKILL
+        self._crash_armed = False
+        self._crash_phase: Optional[str] = None
         self._recover(probe)
 
     # -- journal ----------------------------------------------------------
@@ -341,18 +461,22 @@ class FleetController:
 
     def _recover(self, probe: Optional[Callable]) -> None:
         """Re-derive ownership by replaying the journal: committed
-        lends minus committed reclaims = lent rows; a trailing begin
-        without commit is reconciled via ``probe`` or aborted. Never
+        lends minus committed reclaims = lent rows (commit ORDER
+        reconstructs the LIFO reclaim stack); a trailing begin without
+        commit is reconciled via ``probe`` — or rolled back, phase by
+        completed phase, through ``actuators.rollback``. Never
         guesswork — a controller that cannot read its journal starts
         owning nothing."""
         path = os.path.join(self.obs_dir, "telemetry.launcher.jsonl")
         lent: Set[int] = set()
+        order: List[int] = []
         pending: Optional[dict] = None
         max_seq = 0
         rows = 0
         for row in _read_rows(path):
             kind = row.get("kind")
-            if kind not in ("ctl_lend", "ctl_reclaim", "ctl_abort"):
+            if kind not in ("ctl_lend", "ctl_reclaim", "ctl_abort",
+                            "ctl_phase"):
                 continue
             p = row.get("payload") or {}
             if not isinstance(p, dict):
@@ -361,6 +485,15 @@ class FleetController:
             seq = p.get("seq")
             if isinstance(seq, int):
                 max_seq = max(max_seq, seq)
+            if kind == "ctl_phase":
+                # per-stage ladder rows: fold into the pending outer
+                # transition so reconciliation knows how far it got
+                if pending is not None and pending["seq"] == seq:
+                    if p.get("phase") == "commit":
+                        pending["stages"].append(p.get("stage"))
+                    else:
+                        pending["stage_open"] = p.get("stage")
+                continue
             if kind == "ctl_abort":
                 if pending is not None and pending["seq"] == seq:
                     pending = None
@@ -369,18 +502,25 @@ class FleetController:
             ranks = [r for r in (p.get("ranks") or [])
                      if isinstance(r, int)]
             if p.get("phase") == "begin":
-                pending = {"verb": verb, "seq": seq, "ranks": ranks}
+                pending = {"verb": verb, "seq": seq, "ranks": ranks,
+                           "stages": [], "stage_open": None}
             elif p.get("phase") == "commit":
                 if verb == "lend":
                     lent.update(ranks)
+                    order.extend(r for r in ranks if r not in order)
                 else:
                     lent.difference_update(ranks)
+                    order = [r for r in order if r not in ranks]
                 if pending is not None and pending["seq"] == seq:
                     pending = None
         self.lent = lent
+        self.lent_order = [r for r in order if r in lent]
         self.seq = max_seq
         if pending is not None:
             committed = False
+            if probe is None and self.actuators is not None \
+                    and self.actuators.probe is not None:
+                probe = self._pending_probe
             if probe is not None:
                 try:
                     committed = bool(probe(dict(pending)))
@@ -391,27 +531,67 @@ class FleetController:
                 # write the commit the dead controller never got to
                 if pending["verb"] == "lend":
                     self.lent.update(pending["ranks"])
+                    self.lent_order.extend(
+                        r for r in pending["ranks"]
+                        if r not in self.lent_order)
                 else:
                     self.lent.difference_update(pending["ranks"])
+                    self.lent_order = [r for r in self.lent_order
+                                       if r not in pending["ranks"]]
                 self._write_row(f"ctl_{pending['verb']}", {
                     "phase": "commit", "seq": pending["seq"],
                     "ranks": pending["ranks"], "recovered": True,
                     "lent": sorted(self.lent)})
             else:
+                # roll the half-done ladder back to the pre-transition
+                # ownership the journal still records — the stage that
+                # died mid-flight counts as touched and is undone too
+                touched = list(pending["stages"])
+                if pending["stage_open"] is not None \
+                        and pending["stage_open"] not in touched:
+                    touched.append(pending["stage_open"])
+                self._rollback(pending["verb"],
+                               pending.get("stage_open"),
+                               touched, pending["ranks"])
                 self._write_row("ctl_abort", {
                     "verb": pending["verb"], "seq": pending["seq"],
                     "ranks": pending["ranks"],
+                    "stage": pending.get("stage_open"),
+                    "rolled_back": touched,
                     "reason": "recovered begin without commit"})
         if rows:
             self._write_row("ctl_recover", {
                 "lent": sorted(self.lent), "rows": rows,
-                "seq": self.seq,
+                "seq": self.seq, "order": list(self.lent_order),
                 "pending": None if pending is None else pending["verb"]})
             print(f"paddle_tpu.ctl: recovered from journal — "
                   f"lent {sorted(self.lent)}, seq {self.seq}"
                   + (f", reconciled pending {pending['verb']}"
                      if pending is not None else ""),
                   file=sys.stderr, flush=True)
+
+    def _pending_probe(self, pending: dict) -> bool:
+        """Default reconciliation when only the per-rank
+        ``actuators.probe`` is wired: a lend landed iff every rank now
+        probes as serving; a reclaim landed iff none still does."""
+        checks = [bool(self.actuators.probe(r)) for r in pending["ranks"]]
+        return (all(checks) if pending["verb"] == "lend"
+                else not any(checks))
+
+    def _rollback(self, verb: str, stage: Optional[str],
+                  completed: List[str], ranks: List[int]) -> None:
+        """Best-effort physical undo of a failed/recovered ladder —
+        the journal already records the authoritative ownership; this
+        just converges the planes to it."""
+        act = self.actuators
+        if act is None or act.rollback is None:
+            return
+        try:
+            act.rollback(verb, stage, list(completed), list(ranks))
+        except Exception as e:  # noqa: BLE001 — rollback is advisory
+            print(f"paddle_tpu.ctl: rollback of {verb} "
+                  f"{completed} failed: {e!r}", file=sys.stderr,
+                  flush=True)
 
     # -- pressure ---------------------------------------------------------
     def _sample(self) -> Dict:
@@ -435,7 +615,7 @@ class FleetController:
         # the first window only seeds the baselines: a restarted
         # controller must not read a lifetime of counters as one spike
         pressure = 0.0 if first else max(reject_frac, queue_frac)
-        return {
+        samp = {
             "pressure": pressure,
             "reject_frac": round(reject_frac, 4),
             "queue_frac": round(queue_frac, 4),
@@ -443,6 +623,46 @@ class FleetController:
             "queue_depth": qd,
             "train_step_ms": s.get("train_step_ms"),
         }
+        if self.cfg.predict and not first:
+            pred = self._predict(s.get("ttft_p50_ms"),
+                                 s.get("ttft_p99_ms"))
+            if pred is not None:
+                samp["predicted"] = round(pred, 4)
+                samp["ttft_p99_ms"] = s.get("ttft_p99_ms")
+                # the fold is a MAX: prediction can only raise pressure
+                # toward a lend, never mask a measured burn — and the
+                # dead band / sustain / cooldown see one number, so
+                # hysteresis semantics are unchanged
+                samp["pressure"] = max(pressure, pred)
+        return samp
+
+    def _predict(self, p50, p99) -> Optional[float]:
+        """Satellite: pressure the TTFT trend PROJECTS, before the
+        rejections start. Least-squares slope of the fleet p99 digest
+        over the last ``predict_n`` windows, extrapolated ``predict_n``
+        windows forward; the predicted pressure is the projected
+        FRACTIONAL growth over that horizon, clipped to [0, 1] — a
+        latency on track to double within the horizon saturates to
+        1.0, flat or improving trends contribute 0."""
+        if not isinstance(p99, (int, float)) or p99 <= 0:
+            return None
+        self._ttft_trail.append(
+            (float(p50) if isinstance(p50, (int, float)) else 0.0,
+             float(p99)))
+        n = self.cfg.predict_n
+        if len(self._ttft_trail) > n:
+            self._ttft_trail = self._ttft_trail[-n:]
+        if len(self._ttft_trail) < n:
+            return None
+        ys = [y for _, y in self._ttft_trail]
+        xm = (n - 1) / 2.0
+        ym = sum(ys) / n
+        den = sum((i - xm) ** 2 for i in range(n))
+        slope = sum((i - xm) * (y - ym)
+                    for i, y in enumerate(ys)) / den
+        last = ys[-1]
+        projected = last + slope * n
+        return max(0.0, min((projected - last) / max(last, 1e-9), 1.0))
 
     # -- the control window -----------------------------------------------
     def window(self) -> Optional[dict]:
@@ -456,6 +676,12 @@ class FleetController:
             elif action == "die":
                 self._die_armed = True
                 self._die_sig = int(arg) if arg else signal.SIGKILL
+            elif action == "lend_crash":
+                # phase-targeted die: fires between the named phase's
+                # begin and commit rows (no phase named = the first
+                # phase of the next transition)
+                self._crash_armed = True
+                self._crash_phase = arg if isinstance(arg, str) else None
         samp = self._sample()
         if self._flap_left > 0:
             # synthetic square wave: runs of sustain-length hot windows
@@ -478,11 +704,30 @@ class FleetController:
             avail = [r for r in self.donor_ranks if r not in self.lent]
             if not avail:
                 return None  # nothing left to lend (no donors wired)
+            if self.actuators is not None \
+                    and self.actuators.probe is not None:
+                # per-row budget (ISSUE 20): a second row leaves
+                # training only while every already-lent row is
+                # COMMITTED AND SERVING per the planes themselves — a
+                # row still mid-delivery defers the decision, it does
+                # not stack a second in-flight migration
+                try:
+                    settled = all(bool(self.actuators.probe(r))
+                                  for r in self.lent)
+                except Exception:  # noqa: BLE001 — broken probe = not settled
+                    settled = False
+                if not settled:
+                    self.deferred_lends += 1
+                    return None
             ranks = [max(avail)]  # highest dp row first, the PR-11 order
         else:
             if not self.lent:
                 return None
-            ranks = [max(self.lent)]
+            # LIFO (ISSUE 20): the most recently lent row returns
+            # first — nested lends unwind like a stack, so training's
+            # mesh shrinks and regrows through the same shapes
+            ranks = [self.lent_order[-1]] if self.lent_order \
+                else [max(self.lent)]
         self.seq += 1
         seq = self.seq
         kind = f"ctl_{verb}"
@@ -493,7 +738,7 @@ class FleetController:
         self._write_row(kind, dict(base, phase="begin",
                                    sample={k: samp[k] for k in
                                            ("reject_frac", "queue_frac",
-                                            "queue_depth")
+                                            "queue_depth", "predicted")
                                            if k in samp}))
         if self._die_armed:
             # ctl:die aims HERE — after the begin row is durable,
@@ -503,35 +748,122 @@ class FleetController:
                   f"{int(self._die_sig)} mid-{verb} seq {seq}",
                   file=sys.stderr, flush=True)
             self.die_hook(self._die_sig)
-        fn = self.lend_fn if verb == "lend" else self.reclaim_fn
-        try:
-            if fn is not None:
-                fn(ranks, samp)
-        except Exception as e:  # noqa: BLE001 — actuation failed: abort,
-            # ownership unchanged (the journal shows begin→abort, both
-            # planes keep running on their pre-transition shapes)
-            self._write_row("ctl_abort", {
-                "verb": verb, "seq": seq, "ranks": ranks,
-                "reason": repr(e)[:200]})
-            print(f"paddle_tpu.ctl: {verb} seq {seq} aborted: {e!r}",
-                  file=sys.stderr, flush=True)
-            return None
+        if self.actuators is not None:
+            if not self._run_ladder(verb, seq, ranks, samp):
+                return None
+            live = True
+        else:
+            fn = self.lend_fn if verb == "lend" else self.reclaim_fn
+            live = fn is not None
+            try:
+                if fn is not None:
+                    fn(ranks, samp)
+            except Exception as e:  # noqa: BLE001 — actuation failed:
+                # abort, ownership unchanged (the journal shows
+                # begin→abort, both planes keep running on their
+                # pre-transition shapes)
+                self._write_row("ctl_abort", {
+                    "verb": verb, "seq": seq, "ranks": ranks,
+                    "reason": repr(e)[:200]})
+                print(f"paddle_tpu.ctl: {verb} seq {seq} aborted: {e!r}",
+                      file=sys.stderr, flush=True)
+                return None
         if verb == "lend":
             self.lent.update(ranks)
+            self.lent_order.extend(r for r in ranks
+                                   if r not in self.lent_order)
         else:
             self.lent.difference_update(ranks)
+            self.lent_order = [r for r in self.lent_order
+                               if r not in ranks]
         dur_ms = (time.time() - t0) * 1000.0
         self._write_row(kind, dict(base, phase="commit",
                                    lent=sorted(self.lent),
                                    dur_ms=round(dur_ms, 3)))
         rec = {"verb": verb, "seq": seq, "ranks": ranks,
                "pressure": samp["pressure"], "dur_ms": dur_ms,
-               "lent": sorted(self.lent), "dryrun": fn is None}
+               "lent": sorted(self.lent), "dryrun": not live}
         self.transitions.append(rec)
         print(f"paddle_tpu.ctl: {verb} seq {seq} ranks {ranks} "
               f"(pressure {samp['pressure']:.2f}, "
-              f"{dur_ms:.1f}ms{', dryrun' if fn is None else ''}) — "
+              f"{dur_ms:.1f}ms{'' if live else ', dryrun'}) — "
               f"lent now {sorted(self.lent)}",
+              file=sys.stderr, flush=True)
+        return rec
+
+    def _run_ladder(self, verb: str, seq: int, ranks: List[int],
+                    samp: dict) -> bool:
+        """Drive one live transition through its phase ladder: every
+        stage is a ``ctl_phase`` begin → actuate → commit triple, each
+        row fsync'd BEFORE the next action, so a SIGKILL anywhere
+        leaves a journal from which :meth:`_recover` can reconstruct
+        exactly how far the migration got. Returns False (after
+        rollback + ``ctl_abort``) when a stage raises."""
+        stages = LEND_PHASES if verb == "lend" else RECLAIM_PHASES
+        completed: List[str] = []
+        for stage in stages:
+            self._write_row("ctl_phase", {
+                "seq": seq, "verb": verb, "stage": stage,
+                "phase": "begin", "ranks": ranks})
+            if self._crash_armed and self._crash_phase in (None, stage):
+                # ctl:lend_crash aims HERE — the stage's begin row is
+                # durable, its commit will never be written: the
+                # phase-ladder recovery matrix's prey
+                self._crash_armed = False
+                print(f"fault_injection: ctl:lend_crash firing "
+                      f"mid-{stage} ({verb} seq {seq})",
+                      file=sys.stderr, flush=True)
+                self.die_hook(signal.SIGKILL)
+            t0 = time.time()
+            fn = self.actuators.stage_fn(stage)
+            try:
+                if fn is not None:
+                    fn(ranks[0], samp)
+            except Exception as e:  # noqa: BLE001 — mid-ladder failure:
+                # undo what committed (this stage counts as touched),
+                # journal the abort with the stage name, ownership
+                # unchanged
+                self._rollback(verb, stage, completed + [stage], ranks)
+                self._write_row("ctl_abort", {
+                    "verb": verb, "seq": seq, "ranks": ranks,
+                    "stage": stage, "rolled_back": completed + [stage],
+                    "reason": repr(e)[:200]})
+                print(f"paddle_tpu.ctl: {verb} seq {seq} aborted at "
+                      f"{stage}: {e!r}", file=sys.stderr, flush=True)
+                return False
+            self._write_row("ctl_phase", {
+                "seq": seq, "verb": verb, "stage": stage,
+                "phase": "commit", "ranks": ranks,
+                "dur_ms": round((time.time() - t0) * 1000.0, 3)})
+            completed.append(stage)
+        return True
+
+    def force_reclaim(self, rank: int, reason: str) -> Optional[dict]:
+        """Out-of-band reclaim (ISSUE 20): the lent worker DIED while
+        serving, so there is nothing to drain and no ladder to run —
+        journal the ownership change (begin→commit, ``forced``) so the
+        row is back on the training plane's books before anything else
+        happens. Router failover re-homes the dead worker's in-flight
+        requests; the process death itself then takes the training
+        plane's standard rank-loss path."""
+        if rank not in self.lent:
+            return None
+        self.seq += 1
+        seq = self.seq
+        base = {"seq": seq, "ranks": [rank], "forced": True,
+                "reason": str(reason)[:200], "lent": sorted(self.lent)}
+        self._write_row("ctl_reclaim", dict(base, phase="begin"))
+        self.lent.discard(rank)
+        self.lent_order = [r for r in self.lent_order if r != rank]
+        self._write_row("ctl_reclaim", dict(base, phase="commit",
+                                            lent=sorted(self.lent),
+                                            dur_ms=0.0))
+        rec = {"verb": "reclaim", "seq": seq, "ranks": [rank],
+               "pressure": None, "dur_ms": 0.0, "forced": True,
+               "lent": sorted(self.lent), "dryrun": False}
+        self.transitions.append(rec)
+        print(f"paddle_tpu.ctl: FORCED reclaim seq {seq} rank {rank} "
+              f"({reason}) — lent now {sorted(self.lent)}",
               file=sys.stderr, flush=True)
         return rec
 

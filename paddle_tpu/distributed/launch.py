@@ -119,6 +119,12 @@ def launch(script: str, script_args: List[str], nproc_per_node: int = 1,
       lend/reclaim decisions (ctl_lend/ctl_reclaim rows, crash
       recoverable) to the launcher bus stream — without actuating, since
       the training step and serving engine live in the children.
+      "live" (ISSUE 20) additionally wires the phase-ladder actuators:
+      a committed lend really walks the chosen dp row through
+      depart → deliver → join (and a reclaim through
+      drain → leave → rejoin), each phase its own crash-recoverable
+      journal pair; requires reshard != "off" (the depart/rejoin
+      phases ride the reshard notice channel).
     """
     if node_rank is None:
         node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
@@ -189,10 +195,11 @@ def main(argv=None):
                              "observability dir exists (default: "
                              "$PADDLE_MON or on)")
     parser.add_argument("--ctl", type=str, default=None,
-                        choices=("off", "dryrun"),
+                        choices=("off", "dryrun", "live"),
                         help="embed the co-tenancy fleet controller "
-                             "(journal-only in the launcher; default: "
-                             "$PADDLE_CTL or off)")
+                             "(dryrun journals only; live drives the "
+                             "lend phase ladder against the children; "
+                             "default: $PADDLE_CTL or off)")
     parser.add_argument("script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)

@@ -305,8 +305,12 @@ def read_launcher_notices(offset: int = 0) -> Tuple[List[dict], int]:
             row = json.loads(line)
         except ValueError:
             continue
-        if isinstance(row, dict) and row.get("event") in ("depart",
-                                                          "return"):
+        if isinstance(row, dict) and row.get("event") in (
+                "depart", "return", "lend", "reclaim"):
+            # "lend"/"reclaim" (ISSUE 20) are ROLE-carrying depart/
+            # return rows from the live lend plane: survivors fold them
+            # into the mesh like any departure; the NAMED rank reads
+            # its new job off the same row (ElasticStep.role_events)
             rows.append(row)
     return rows, offset + consumed
 
@@ -375,6 +379,13 @@ class ElasticStep:
         self.mesh = mesh
         self._lost: Set[int] = set()
         self._queued: List[Tuple[str, Optional[int]]] = []
+        #: live-lend role notices naming THIS rank (ISSUE 20): dicts
+        #: like ``{"role": "serve", "ckpt": ..., "event": "lend"}``
+        #: appended in arrival order — the training loop drains them
+        #: via :meth:`pending_role` and switches jobs at the same step
+        #: boundary the survivors reshard at
+        self.role_events: List[dict] = []
+        self._self_rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         self._notice_offset = 0
         self._per_rank_batch: Optional[int] = None
         self.reshards = 0
@@ -393,6 +404,15 @@ class ElasticStep:
     def notify_return(self, ranks) -> None:
         for r in np.atleast_1d(ranks):
             self._queued.append(("return", int(r)))
+
+    def pending_role(self):
+        """Pop the oldest live-lend role notice addressed to THIS rank
+        (``{"role": "serve"|"train", ...}``), or None. A "serve" role
+        means the launcher lent this rank to the serving plane: the
+        training loop should stop stepping, load serving weights (the
+        row's ``ckpt`` names the PR-18 ``load_quantized`` artifact) and
+        run the worker; "train" is the reclaim — rejoin the gang."""
+        return self.role_events.pop(0) if self.role_events else None
 
     @property
     def live_ranks(self) -> List[int]:
@@ -472,7 +492,20 @@ class ElasticStep:
             rows, self._notice_offset = read_launcher_notices(
                 self._notice_offset)
             for row in rows:
-                events.extend((row["event"], int(r), "launcher")
+                ev = row["event"]
+                if ev in ("lend", "reclaim"):
+                    # live lend plane (ISSUE 20): mesh-wise a lend IS a
+                    # departure and a reclaim IS a return; the named
+                    # rank additionally learns its new job
+                    ranks = [int(r) for r in row.get("ranks", [])]
+                    if self._self_rank in ranks:
+                        self.role_events.append(dict(
+                            row, role=("serve" if ev == "lend"
+                                       else "train")))
+                    ev = "depart" if ev == "lend" else "return"
+                    events.extend((ev, r, "launcher") for r in ranks)
+                    continue
+                events.extend((ev, int(r), "launcher")
                               for r in row.get("ranks", []))
         if not events:
             return

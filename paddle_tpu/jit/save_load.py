@@ -239,7 +239,7 @@ def save_quantized(layer, path, dtype: str = "int8", block: int = 128):
     return dict(info)
 
 
-def load_quantized(layer, path):
+def load_quantized(layer, path, deadline_ms=None):
     """Load a :func:`save_quantized` checkpoint INTO ``layer`` without
     ever materializing wide weights: each linear weight's raw becomes
     the int8/fp8 payload directly off the npz (the narrow serving form —
@@ -250,6 +250,14 @@ def load_quantized(layer, path):
     Loud on architecture mismatch: quantized names with no matching
     linear, wide entries with no matching state, and state left
     uncovered all raise. Returns the meta ledger + ``load_ms``.
+
+    ``deadline_ms`` (ISSUE 20) bounds the live lend plane's deliver
+    phase: a load that finishes past the deadline raises TimeoutError
+    INSTEAD of reporting success, so the phase ladder rolls the lend
+    back rather than committing a rank whose weights arrived too late
+    to matter (the load itself is synchronous and runs to completion —
+    the bound is on what we admit as a delivered rank, not a mid-read
+    abort).
     """
     import time as _time
 
@@ -315,5 +323,13 @@ def load_quantized(layer, path):
         )
     info = dict(meta)
     info["load_ms"] = round((_time.perf_counter() - t0) * 1e3, 2)
+    if deadline_ms is not None and info["load_ms"] > float(deadline_ms):
+        info["deadline_ms"] = float(deadline_ms)
+        _emit_q_checkpoint("load_deadline_blown", info)
+        raise TimeoutError(
+            f"load_quantized({path!r}) took {info['load_ms']}ms, past "
+            f"the {float(deadline_ms)}ms deliver deadline — refusing to "
+            "report the rank as delivered"
+        )
     _emit_q_checkpoint("load", info)
     return info

@@ -481,8 +481,16 @@ def _notable_detail(kind: str, payload: dict) -> Optional[str]:
                 + (f" (pressure {p:.2f})"
                    if isinstance(p, (int, float)) else ""))
     if kind == "ctl_abort":
+        stage = payload.get("stage")
         return (f"{payload.get('verb')} seq {payload.get('seq')} "
-                f"aborted: {payload.get('reason')}")
+                + (f"aborted at {stage}: " if stage else "aborted: ")
+                + f"{payload.get('reason')}")
+    # live lend plane (ISSUE 20): the phase ladder's per-stage rows —
+    # a crash mid-migration must chain as "lend begin → depart commit
+    # → deliver begin → (silence)", NAMING the phase that died
+    if kind == "ctl_phase":
+        return (f"{payload.get('verb')} {payload.get('stage')} "
+                f"{payload.get('phase')} ranks {payload.get('ranks')}")
     return None
 
 
@@ -799,6 +807,15 @@ class FleetMonitor:
         with self._lock:
             out = dict(self.serve)
             out["train_step_ms"] = self._fleet_median_ewma() or None
+            # fleet TTFT digests (ISSUE 20): merged per-rank log
+            # histograms — the pressure PREDICTOR's raw signal. Counts
+            # are cumulative like the admit counters; the controller
+            # windows them itself.
+            ttft = LogHistogram()
+            for rv in self.ranks.values():
+                ttft.merge(rv.ttft_hist)
+            out["ttft_p50_ms"] = ttft.percentile(50)
+            out["ttft_p99_ms"] = ttft.percentile(99)
             return out
 
     def snapshot_dict(self) -> dict:

@@ -563,7 +563,11 @@ class InferenceEngine:
 
     def expand_slots(self, n: int) -> int:
         """Grow the decode pool by ``n`` slots at a turn boundary — the
-        serving half of a fleet-controller lend (ISSUE 16). Every cache
+        serving half of a fleet-controller lend (ISSUE 16; under the
+        ISSUE-20 live plane this is the in-process join phase: the
+        ladder calls it after the lent rank's deliver-phase
+        ``load_quantized`` lands, and the router's ``register_capacity``
+        publishes the new depth the same tick). Every cache
         leaf gains ``n`` batch rows (paged: ``n * nmax`` fresh pool
         blocks and ``n`` all-trash table rows, registered with the
         BlockPool so admission sees the new capacity immediately), the
@@ -641,7 +645,11 @@ class InferenceEngine:
 
     def retire_slots(self, n: int) -> List[int]:
         """Mark the top ``n`` slots retiring — the reclaim half of a
-        lend round trip. A retiring slot is never refilled; work
+        lend round trip (the live plane's drain phase rides this exact
+        never-refill semantic: ISSUE 20 asserts zero dropped requests
+        across a reclaim because retiring slots finish their work
+        before the leave phase takes the rank). A retiring slot is
+        never refilled; work
         in flight on it finishes first (drain semantics — nothing is
         cancelled). The pool physically truncates lazily: once the
         retiring tail is free — and, for a paged pool, as the highest

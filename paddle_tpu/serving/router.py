@@ -978,6 +978,26 @@ class Router:
             raise ValueError(f"no host {idx}")
         self.capacity[idx] = max(int(units), 1)
 
+    def add_host(self, host, units: int = 1) -> int:
+        """Admit a NEW host into the rotation mid-flight and return its
+        index. The live lend plane's join phase (ISSUE 20) calls this
+        when a lent training rank comes up as a serving worker: the
+        host starts healthy with ``units`` admission-capacity units and
+        is eligible for the very next submit — no router restart, no
+        re-registration of the existing fleet. The reverse direction
+        (leave) is just ``drain_host(idx)``: indices are
+        stable for the router's lifetime, so departed hosts keep their
+        slot quarantined rather than being popped."""
+        self.hosts.append(host)
+        idx = len(self.hosts) - 1
+        self._pending_guess.append(0)
+        self._last_submit_t.append(0.0)
+        hh = _HostHealth()
+        self._health.append(hh)
+        self.capacity.append(max(int(units), 1))
+        self._emit_host_event("router_host_join", idx, hh, units=self.capacity[idx])
+        return idx
+
     def outstanding(self, idx: Optional[int] = None) -> List[object]:
         """rids tracked on one host (or orphaned, for ``idx=None``)."""
         if idx is None:
@@ -1967,6 +1987,13 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 # the step_ms telemetry alone
                 straggle_s = 0.25
             elif action == "host_crash" and _mine(arg):
+                crash_armed = True
+            elif action == "lent_worker_crash" and _mine(arg):
+                # ISSUE 20: the lent rank dies WHILE SERVING — same
+                # mid-decode SIGKILL as host_crash on the worker side,
+                # but the launcher attributes it to the lend plane and
+                # answers with a forced reclaim (journal-only ownership
+                # transfer) on top of the router's normal failover
                 crash_armed = True
             elif action == "hang" and _mine(arg):
                 hung = True

@@ -74,14 +74,24 @@
   the telemetry bus consumes the rule at its nth row write and drops /
   duplicates that one line — the monitor's incremental cursor and
   count-based aggregation must survive a lossy, re-appending stream),
-  or ``flap`` / ``die`` (``ctl`` only: ``flap`` overrides the fleet
-  controller's measured serving pressure with a synthetic square wave —
-  runs of sustain-length hot windows alternating with calm ones, for
-  ``arg`` windows total (default 32) — the hysteresis/cooldown
-  suppression test's prey; ``die`` SIGKILLs the controller process at
-  its nth control window (``arg`` = exit signal override, default
-  SIGKILL), mid-lend when aimed between journal ``begin`` and
-  ``commit`` — the journal-recovery path's prey).
+  or ``flap`` / ``die`` / ``lend_crash`` (``ctl`` only: ``flap``
+  overrides the fleet controller's measured serving pressure with a
+  synthetic square wave — runs of sustain-length hot windows
+  alternating with calm ones, for ``arg`` windows total (default 32) —
+  the hysteresis/cooldown suppression test's prey; ``die`` SIGKILLs the
+  controller process at its nth control window (``arg`` = exit signal
+  override, default SIGKILL), mid-lend when aimed between journal
+  ``begin`` and ``commit`` — the journal-recovery path's prey;
+  ``lend_crash`` (ISSUE 20) is the PHASE-TARGETED die: ``arg`` names a
+  live-lend phase (``depart``/``deliver``/``join`` or
+  ``drain``/``leave``/``rejoin``, default the first phase of the next
+  transition) and the controller SIGKILLs itself between THAT phase's
+  journal ``begin`` and ``commit`` rows — the phase-ladder recovery
+  matrix's prey). The ``serve`` site additionally accepts
+  ``lent_worker_crash`` (ISSUE 20): the LENT worker (``arg`` = its
+  rank, a rank serving on loan from training) SIGKILLs itself at its
+  next mailbox poll — the router must fail its in-flight requests over
+  and the launcher must force-reclaim the row back to training.
 - ``nth``    1-based per-process call count at which the rule fires
   (each call to a site increments that site's counter), so a relaunched
   attempt that resumes later in training naturally skips the fault.
@@ -106,14 +116,15 @@ __all__ = ["InjectedFault", "FaultInjector", "fault_point", "consume_flag",
            "has_site", "consume_grad_action", "consume_rank_events",
            "consume_serve_events", "consume_serve_matching",
            "consume_mon_action",
-           "consume_ctl_events", "GRAD_POISONS", "reset"]
+           "consume_ctl_events", "GRAD_POISONS", "LEND_PHASES",
+           "RECLAIM_PHASES", "reset"]
 
 _SPEC_ENV = "PADDLE_FAULT_SPEC"
 _ACTIONS = ("fail", "hang", "kill", "corrupt", "desync", "nan", "inf",
             "spike", "depart", "return", "burst", "slow_host",
             "straggler", "host_crash", "kv_corrupt", "kv_lost",
-            "prefix_stale", "adapter_missing", "drop",
-            "dup", "flap", "die")
+            "prefix_stale", "adapter_missing", "lent_worker_crash",
+            "drop", "dup", "flap", "die", "lend_crash")
 # desync only makes sense where a fingerprint is being recorded
 _DESYNC_SITES = ("coll",)
 # grad poison only makes sense where a compiled step consumes the flag
@@ -129,7 +140,7 @@ _RANK_SITES = ("rank",)
 # worker consumes it as "stop draining the mailbox, stay alive")
 _SERVE_ACTIONS = ("burst", "slow_host", "straggler", "host_crash",
                   "kv_corrupt", "kv_lost", "prefix_stale",
-                  "adapter_missing")
+                  "adapter_missing", "lent_worker_crash")
 _SERVE_SITES = ("serve",)
 # bus-line faults only make sense where a bus row is being written
 # (observability/bus.py emit — the fleet monitor's cursor prey)
@@ -137,8 +148,13 @@ _MON_ACTIONS = ("drop", "dup")
 _MON_SITES = ("mon",)
 # controller faults only make sense where the fleet controller's
 # control window polls for them (distributed/fleet_controller.py)
-_CTL_ACTIONS = ("flap", "die")
+_CTL_ACTIONS = ("flap", "die", "lend_crash")
 _CTL_SITES = ("ctl",)
+#: the live-lend phase ladder (ISSUE 20) — a `lend_crash` arg must name
+#: one of these; kept here (stdlib-pure) so the parser rejects a typo'd
+#: phase at spec time instead of silently never firing
+LEND_PHASES = ("depart", "deliver", "join")
+RECLAIM_PHASES = ("drain", "leave", "rejoin")
 # sites that pass a file path to fault_point (the only places a corrupt
 # rule can bite) — a corrupt rule elsewhere would be a silent no-op, so
 # the parser rejects it loudly instead
@@ -222,6 +238,12 @@ class FaultInjector:
                     f"(controller sites: {_CTL_SITES})"
                 )
             arg = parts[3] if len(parts) > 3 else None
+            if action == "lend_crash" and arg is not None \
+                    and arg not in LEND_PHASES + RECLAIM_PHASES:
+                raise ValueError(
+                    f"bad {_SPEC_ENV} lend_crash phase {arg!r} (one of "
+                    f"{LEND_PHASES + RECLAIM_PHASES})"
+                )
             self._rules.append(_Rule(site, action, nth, arg))
 
     def fire(self, site: str, path: Optional[str] = None) -> None:
@@ -286,7 +308,9 @@ class FaultInjector:
             self.serve_events.append((r.action, arg))
             return
         if r.action in _CTL_ACTIONS:
-            arg = int(r.arg) if r.arg else None
+            # lend_crash's arg is a PHASE NAME, not a number
+            arg = (r.arg if r.action == "lend_crash"
+                   else int(r.arg) if r.arg else None)
             print(f"fault_injection: arming ctl:{r.action}"
                   f"{'' if arg is None else f':{arg}'} at {tag}",
                   file=sys.stderr, flush=True)

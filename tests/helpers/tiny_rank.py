@@ -24,6 +24,25 @@ Modes (env TINY_MODE):
             calm windows with none — the embedded fleet controller's
             prey (ISSUE 16 launcher dryrun: rank 0 emits, everyone
             heartbeats until TINY_SERVE_WINDOWS windows elapse)
+  live      ISSUE 20: a trainable-AND-lendable rank for the live lend
+            plane E2E. Every rank runs a deterministic synthetic
+            training loop (TINY_TRAIN_STEPS steps, loss a pure function
+            of the step index — the dp ideal, so a lend/reclaim cycle
+            must not move it) and polls PADDLE_RESHARD_NOTICE_FILE. A
+            "lend" row naming this rank switches it to the serving
+            role: it acks the launcher's phase ladder through the lend
+            dir the row names (departed -> delivered [reads the row's
+            ckpt, reports load_ms] -> serving), then serves REAL
+            mailbox requests under the row's serve_dir
+            (host<r>/inbox -> outbox/done_<rid>.json, the FileHost
+            wire form) until the drain marker (or a "reclaim" row)
+            sends it back (drained -> left -> rejoined), where training
+            resumes at the step it paused on. Rank 0 additionally
+            emits the serve-mode pressure wave (hot then calm) to drive
+            the embedded controller, and appends each step's loss to
+            TINY_LOSS_FILE — the E2E's loss-continuity ledger. Children
+            exit if the launcher dies (PPID check) so a SIGKILLed
+            crash-matrix launcher never leaks orphans.
 """
 import importlib.util
 import os
@@ -165,6 +184,196 @@ elif mode == "serve":
                 "hosts": 1, "admitted": admitted, "rejected": rejected,
                 "queue_depth_total": 4 if w < hot else 0,
             })
+        time.sleep(dt)
+    beat()
+    sys.exit(0)
+elif mode == "live":
+    # ISSUE 20: the live lend plane's child. Stdlib-pure; the launcher
+    # owns every decision — this rank only trains, acks phases, and
+    # serves the mailbox while lent.
+    import json
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    steps_total = int(os.environ.get("TINY_TRAIN_STEPS", "40"))
+    dt = float(os.environ.get("TINY_TRAIN_DT", "0.05"))
+    hot = int(os.environ.get("TINY_SERVE_HOT", "0"))
+    loss_file = os.environ.get("TINY_LOSS_FILE")
+    parent = os.getppid()
+    bus = None
+    if rank == 0 and hot:
+        bus = _load_standalone(
+            "obs_bus", ("paddle_tpu", "observability", "bus.py"))
+    fault = None
+    if "serve:" in os.environ.get("PADDLE_FAULT_SPEC", ""):
+        # the lent rank consumes serve-site faults while serving —
+        # serve:lent_worker_crash:<nth>:<rank> SIGKILLs it mid-loan
+        fault = _load_standalone(
+            "fault_injection", ("paddle_tpu", "utils",
+                                "fault_injection.py"))
+    signal.signal(signal.SIGUSR1, lambda s, f: None)
+    notice_path = os.environ.get("PADDLE_RESHARD_NOTICE_FILE")
+    if notice_path:
+        with open(notice_path + ".armed", "w"):
+            pass
+    consumed = 0          # notice lines already folded
+    admitted = rejected = 0
+    served = 0
+
+    def _orphaned() -> bool:
+        # the crash-matrix E2E SIGKILLs the LAUNCHER; its children are
+        # re-parented (ppid changes) and must not linger past it
+        return os.getppid() != parent
+
+    def _notices():
+        """New complete notice rows since the last poll."""
+        global consumed
+        if not notice_path:
+            return []
+        try:
+            with open(notice_path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return []
+        fresh = []
+        for line in lines[consumed:]:
+            consumed += 1
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict):
+                fresh.append(row)
+        return fresh
+
+    def _ack(row, state, payload=None):
+        d = row.get("ack_dir")
+        if not d:
+            return
+        path = os.path.join(d, f"rank{rank}.{state}")
+        with open(path + ".tmp", "w") as f:
+            f.write(json.dumps(payload or {}))
+        os.replace(path + ".tmp", path)
+
+    def _serve(row):
+        """The lent role: deliver, join, serve the mailbox, drain on
+        the launcher's marker (or a rollback's reclaim row), leave."""
+        global served
+        _ack(row, "departed")
+        t0 = time.monotonic()
+        ckpt = row.get("ckpt")
+        if ckpt:
+            try:
+                with open(ckpt, "rb") as f:
+                    while f.read(1 << 20):
+                        pass  # the simulated load_quantized stream
+            except OSError:
+                pass
+        load_ms = (time.monotonic() - t0) * 1e3
+        _ack(row, "delivered", {"load_ms": round(load_ms, 3)})
+        serve_dir = row.get("serve_dir")
+        inbox = outbox = None
+        if serve_dir:
+            inbox = os.path.join(serve_dir, f"host{rank}", "inbox")
+            outbox = os.path.join(serve_dir, f"host{rank}", "outbox")
+            os.makedirs(inbox, exist_ok=True)
+            os.makedirs(outbox, exist_ok=True)
+        _ack(row, "serving")
+        drain_marker = os.path.join(row.get("ack_dir") or ".",
+                                    f"rank{rank}.drain")
+        seen = set()
+        draining = False
+        saw_reclaim = False
+        while True:
+            beat()
+            if _orphaned():
+                sys.exit(0)
+            if fault is not None:
+                for action, farg in fault.consume_serve_events():
+                    if action == "lent_worker_crash" and \
+                            (farg or 0) == rank:
+                        os.kill(os.getpid(), signal.SIGKILL)
+            if not draining:
+                if any(r.get("event") == "reclaim"
+                       and rank in (r.get("ranks") or [])
+                       for r in _notices()):
+                    saw_reclaim = True  # rollback path: no drain phase
+                if saw_reclaim or os.path.exists(drain_marker):
+                    draining = True
+            fresh_work = False
+            if inbox:
+                for name in sorted(os.listdir(inbox)):
+                    if not name.endswith(".json") or name in seen:
+                        continue
+                    seen.add(name)
+                    fresh_work = True
+                    try:
+                        with open(os.path.join(inbox, name)) as f:
+                            req = json.load(f)
+                    except (OSError, ValueError):
+                        continue
+                    rid = req.get("rid")
+                    prompt = req.get("token_ids") or [1]
+                    # deterministic continuation (sim_next_token
+                    # spirit): a pure function of the prefix
+                    out = list(prompt)
+                    for _ in range(int(req.get("max_new_tokens", 4))):
+                        out.append((out[-1] * 31 + len(out)) % 997)
+                    done = os.path.join(outbox, f"done_{rid}.json")
+                    with open(done + ".tmp", "w") as f:
+                        json.dump({"rid": rid, "token_ids": out,
+                                   "rank": rank}, f)
+                    os.replace(done + ".tmp", done)
+                    served += 1
+            if draining and not fresh_work:
+                break  # queue empty: the zero-drop drain is complete
+            time.sleep(0.02)
+        _ack(row, "drained", {"served": served})
+        _ack(row, "left")
+        # wait for the rejoin notice (the reclaim ladder's last phase);
+        # a rollback's reclaim row was already consumed in the loop
+        deadline = time.monotonic() + float(
+            os.environ.get("TINY_WAIT", "60"))
+        while not saw_reclaim and time.monotonic() < deadline:
+            beat()
+            if _orphaned():
+                sys.exit(0)
+            for r in _notices():
+                if r.get("event") == "reclaim" and \
+                        rank in (r.get("ranks") or []):
+                    saw_reclaim = True
+            time.sleep(0.02)
+        _ack(row, "rejoined")
+
+    step = 0
+    while step < steps_total:
+        beat()
+        if _orphaned():
+            sys.exit(0)
+        lend_row = None
+        for row in _notices():
+            if row.get("event") == "lend" and \
+                    rank in (row.get("ranks") or []):
+                lend_row = row
+        if lend_row is not None:
+            _serve(lend_row)   # training pauses at this exact step
+            continue           # resume from `step` — loss continuity
+        loss = 1.0 / (1.0 + 0.1 * step)  # pure function of the step
+        step += 1
+        if rank == 0:
+            if loss_file:
+                with open(loss_file, "a") as f:
+                    f.write(f"{step} {loss:.9f}\n")
+            if bus is not None:
+                if step <= hot:
+                    admitted += 1
+                    rejected += 5
+                else:
+                    admitted += 6
+                bus.emit("router_metrics", {
+                    "hosts": 1, "admitted": admitted,
+                    "rejected": rejected,
+                    "queue_depth_total": 4 if step <= hot else 0,
+                })
         time.sleep(dt)
     beat()
     sys.exit(0)

@@ -248,11 +248,14 @@ class TestJournal:
                 "queue_depth": 0}
         assert ctl._transition("lend", samp)["ranks"] == [3]
         assert ctl._transition("lend", samp)["ranks"] == [2]
-        assert ctl._transition("reclaim", samp)["ranks"] == [3]
-        assert ctl.lent == {2}
+        # LIFO since ISSUE 20: the MOST RECENTLY lent row returns first,
+        # so training's mesh unwinds through the same shapes it grew by
+        assert ctl._transition("reclaim", samp)["ranks"] == [2]
+        assert ctl.lent == {3}
         fresh = fc.FleetController(str(tmp_path),
                                    donor_ranks=[0, 1, 2, 3])
-        assert fresh.lent == {2} and fresh.seq == 3
+        assert fresh.lent == {3} and fresh.seq == 3
+        assert fresh.lent_order == [3]
 
     def test_actuation_failure_aborts_ownership_unchanged(self, tmp_path):
         def bad_lend(ranks, samp):

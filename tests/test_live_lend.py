@@ -1,0 +1,693 @@
+"""Live lend plane (ISSUE 20) — crash-safe rank role migration.
+
+Fast layer (in-suite, no mesh, no subprocess):
+- ``ctl:lend_crash`` / ``serve:lent_worker_crash`` fault grammar:
+  phase-name args parse (and stay strings), typo'd phases and
+  wrong-site rules are rejected loudly;
+- the phase ladder: a committed lend journals
+  ``ctl_lend begin → (depart|deliver|join) begin/commit × 3 →
+  ctl_lend commit``, actuators run in order, a mid-ladder raise
+  aborts with the stage named and completed phases rolled back;
+- crash/recovery matrix in process (raising ``die_hook`` as the
+  SIGKILL stand-in): a ``lend_crash`` at every phase leaves a
+  begin-without-commit journal from which a restarted controller
+  rolls back (probe False) or commits (probe True) — never guesses;
+- multi-row lends: per-row budget defers a second lend until the
+  first probes as serving, reclaim is LIFO, journal replay
+  reconstructs the ownership stack;
+- pressure prediction: a rising TTFT p99 trend lends BEFORE any
+  rejection appears; the dead band / cooldown flap bound holds with
+  the predictor on;
+- ``Router.add_host`` admits a mid-flight worker into rotation;
+- ``force_reclaim``: a lent worker's death journals ownership back
+  to training without a ladder.
+
+Slow layer (``-m slow`` — the launcher E2E, excluded from tier-1 per
+the ROADMAP ordering note): the full live cycle over jax-free
+tiny_rank children (lend → the lent rank serves real mailbox
+requests → reclaim → dp restored, loss continuity, zero dropped
+requests), the SIGKILL-per-phase crash matrix (launcher dies
+mid-phase, restart recovers from the journal alone), and the
+lent-worker-death forced reclaim.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed import fleet_controller as fc
+from paddle_tpu.serving.router import HostStats, Router
+from paddle_tpu.utils import fault_injection as FI
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPERS = os.path.join(REPO, "tests", "helpers")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("PADDLE_FAULT_SPEC", "PADDLE_OBS_DIR",
+              "PADDLE_OBS_BUS_FILE", "PADDLE_CTL", "PADDLE_CTL_PRESSURE",
+              "PADDLE_CTL_SUSTAIN_N", "PADDLE_CTL_RELEASE",
+              "PADDLE_CTL_COOLDOWN_N", "PADDLE_CTL_LEND_BUDGET",
+              "PADDLE_CTL_WINDOW_S", "PADDLE_CTL_PREDICT",
+              "PADDLE_CTL_PREDICT_N", "PADDLE_CTL_PHASE_TIMEOUT_S",
+              "PADDLE_CTL_SERVE_CKPT", "PADDLE_CTL_SERVE_DIR"):
+        monkeypatch.delenv(k, raising=False)
+    FI.reset()
+    yield monkeypatch
+    FI.reset()
+
+
+def _cfg(**kw):
+    kw.setdefault("pressure", 0.5)
+    kw.setdefault("sustain_n", 2)
+    kw.setdefault("release", 0.1)
+    kw.setdefault("cooldown_n", 3)
+    kw.setdefault("lend_budget", 1)
+    kw.setdefault("window_s", 0.01)
+    return fc.CtlConfig(**kw)
+
+
+def _journal(obs_dir):
+    path = os.path.join(str(obs_dir), "telemetry.launcher.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+def _ctl_kinds(obs_dir):
+    return [(r["kind"], r["payload"].get("phase"),
+             r["payload"].get("stage"))
+            for r in _journal(obs_dir)
+            if r["kind"].startswith("ctl_")]
+
+
+class _Ladder:
+    """Recording actuators: every phase appends, probe/rollback are
+    scriptable."""
+
+    def __init__(self, serving=lambda rank: False, fail_at=None):
+        self.calls = []
+        self.rollbacks = []
+        self.serving = serving
+        self.fail_at = fail_at
+
+    def _fn(self, stage):
+        def run(rank, samp):
+            self.calls.append((stage, rank))
+            if stage == self.fail_at:
+                raise RuntimeError(f"{stage} refused")
+        return run
+
+    def actuators(self):
+        return fc.PhaseActuators(
+            depart=self._fn("depart"), deliver=self._fn("deliver"),
+            join=self._fn("join"), drain=self._fn("drain"),
+            leave=self._fn("leave"), rejoin=self._fn("rejoin"),
+            probe=lambda rank: self.serving(rank),
+            rollback=lambda verb, stage, completed, ranks:
+                self.rollbacks.append((verb, stage, tuple(completed),
+                                       tuple(ranks))))
+
+
+SAMP = {"pressure": 0.9, "reject_frac": 0.9, "queue_frac": 0.0,
+        "queue_depth": 0}
+
+
+# ---------------------------------------------------------------------------
+# fault grammar
+# ---------------------------------------------------------------------------
+
+
+class TestLendCrashSpec:
+    def test_phase_arg_parses_and_stays_a_string(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "ctl:lend_crash:1:deliver")
+        FI.reset()
+        assert FI.consume_ctl_events() == [("lend_crash", "deliver")]
+
+    def test_no_phase_means_first_phase(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "ctl:lend_crash:1")
+        FI.reset()
+        assert FI.consume_ctl_events() == [("lend_crash", None)]
+
+    def test_typo_phase_rejected_loudly(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "ctl:lend_crash:1:delivr")
+        FI.reset()
+        with pytest.raises(ValueError, match="delivr"):
+            FI.consume_ctl_events()
+
+    def test_every_ladder_phase_is_a_valid_target(self, monkeypatch):
+        for phase in FI.LEND_PHASES + FI.RECLAIM_PHASES:
+            monkeypatch.setenv("PADDLE_FAULT_SPEC",
+                               f"ctl:lend_crash:1:{phase}")
+            FI.reset()
+            assert FI.consume_ctl_events() == [("lend_crash", phase)]
+
+    def test_wrong_site_rejected(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "serve:lend_crash:1")
+        FI.reset()
+        with pytest.raises(ValueError, match="controller sites"):
+            FI.consume_serve_events()
+        monkeypatch.setenv("PADDLE_FAULT_SPEC", "ctl:lent_worker_crash:1")
+        FI.reset()
+        with pytest.raises(ValueError, match="serving-event sites"):
+            FI.consume_ctl_events()
+
+    def test_lent_worker_crash_arms_on_serve_site(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC",
+                           "serve:lent_worker_crash:1:1")
+        FI.reset()
+        assert FI.consume_serve_events() == [("lent_worker_crash", 1)]
+
+
+# ---------------------------------------------------------------------------
+# the phase ladder
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseLadder:
+    def test_lend_journals_every_phase_in_order(self, tmp_path):
+        lad = _Ladder()
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg(),
+                                 actuators=lad.actuators())
+        rec = ctl._transition("lend", dict(SAMP))
+        assert rec["ranks"] == [1] and not rec["dryrun"]
+        assert lad.calls == [("depart", 1), ("deliver", 1), ("join", 1)]
+        assert _ctl_kinds(tmp_path) == [
+            ("ctl_lend", "begin", None),
+            ("ctl_phase", "begin", "depart"),
+            ("ctl_phase", "commit", "depart"),
+            ("ctl_phase", "begin", "deliver"),
+            ("ctl_phase", "commit", "deliver"),
+            ("ctl_phase", "begin", "join"),
+            ("ctl_phase", "commit", "join"),
+            ("ctl_lend", "commit", None),
+        ]
+        commits = [r for r in _journal(tmp_path)
+                   if r["kind"] == "ctl_phase"
+                   and r["payload"]["phase"] == "commit"]
+        assert all("dur_ms" in r["payload"] for r in commits)
+
+    def test_reclaim_runs_the_reverse_ladder(self, tmp_path):
+        lad = _Ladder()
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg(),
+                                 actuators=lad.actuators())
+        ctl._transition("lend", dict(SAMP))
+        lad.calls.clear()
+        rec = ctl._transition("reclaim", dict(SAMP))
+        assert rec["ranks"] == [1]
+        assert lad.calls == [("drain", 1), ("leave", 1), ("rejoin", 1)]
+        assert ctl.lent == set()
+
+    def test_midladder_failure_aborts_names_stage_rolls_back(
+            self, tmp_path):
+        lad = _Ladder(fail_at="deliver")
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg(),
+                                 actuators=lad.actuators())
+        assert ctl._transition("lend", dict(SAMP)) is None
+        assert ctl.lent == set()
+        abort = [r for r in _journal(tmp_path)
+                 if r["kind"] == "ctl_abort"][-1]["payload"]
+        assert abort["stage"] == "deliver"
+        assert abort["rolled_back"] == ["depart", "deliver"]
+        assert lad.rollbacks == [
+            ("lend", "deliver", ("depart", "deliver"), (1,))]
+
+    def test_actuators_exclude_legacy_callbacks(self, tmp_path):
+        with pytest.raises(ValueError, match="not both"):
+            fc.FleetController(
+                str(tmp_path), donor_ranks=[0],
+                actuators=fc.PhaseActuators(),
+                lend=lambda ranks, samp: None)
+
+    def test_unknown_stage_rejected(self):
+        with pytest.raises(ValueError, match="unknown lend phase"):
+            fc.PhaseActuators().stage_fn("teleport")
+
+
+class TestCrashRecoveryMatrix:
+    """``ctl:lend_crash`` at every phase: the journal ends at that
+    phase's begin; a restarted controller rolls the half-done ladder
+    back (probe says the rank never served) or writes the missing
+    commit (probe says it did) — from the journal alone."""
+
+    class _Died(RuntimeError):
+        pass
+
+    def _crash_at(self, tmp_path, monkeypatch, phase, verb):
+        monkeypatch.setenv("PADDLE_FAULT_SPEC",
+                           f"ctl:lend_crash:1:{phase}")
+        FI.reset()
+
+        def boom(sig):
+            assert sig == signal.SIGKILL
+            raise self._Died(phase)
+
+        lad = _Ladder()
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg(),
+                                 actuators=lad.actuators(),
+                                 die_hook=boom)
+        ctl.window()  # drains the fault: armed for the named phase
+        if verb == "reclaim":
+            ctl._transition("lend", dict(SAMP))
+        with pytest.raises(self._Died):
+            ctl._transition(verb, dict(SAMP))
+        last = _ctl_kinds(tmp_path)[-1]
+        assert last == ("ctl_phase", "begin", phase)
+
+    @pytest.mark.parametrize("phase", fc.LEND_PHASES)
+    def test_lend_phase_crash_rolls_back(self, tmp_path, monkeypatch,
+                                         phase):
+        self._crash_at(tmp_path, monkeypatch, phase, "lend")
+        lad2 = _Ladder(serving=lambda rank: False)
+        ctl2 = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                  actuators=lad2.actuators())
+        assert ctl2.lent == set()
+        abort = [r for r in _journal(tmp_path)
+                 if r["kind"] == "ctl_abort"][-1]["payload"]
+        assert abort["stage"] == phase
+        assert phase in abort["rolled_back"]
+        assert lad2.rollbacks and lad2.rollbacks[0][0] == "lend"
+
+    @pytest.mark.parametrize("phase", fc.RECLAIM_PHASES)
+    def test_reclaim_phase_crash_keeps_row_lent(self, tmp_path,
+                                                monkeypatch, phase):
+        self._crash_at(tmp_path, monkeypatch, phase, "reclaim")
+        # the rank still probes as serving: the reclaim never landed
+        lad2 = _Ladder(serving=lambda rank: True)
+        ctl2 = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                  actuators=lad2.actuators())
+        assert ctl2.lent == {1}
+        abort = [r for r in _journal(tmp_path)
+                 if r["kind"] == "ctl_abort"][-1]["payload"]
+        assert abort["verb"] == "reclaim" and abort["stage"] == phase
+
+    def test_crash_then_probe_true_commits_the_lend(self, tmp_path,
+                                                    monkeypatch):
+        self._crash_at(tmp_path, monkeypatch, "join", "lend")
+        # the planes say the rank IS serving: write the missing commit
+        lad2 = _Ladder(serving=lambda rank: True)
+        ctl2 = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                  actuators=lad2.actuators())
+        assert ctl2.lent == {1} and ctl2.lent_order == [1]
+        commit = [r for r in _journal(tmp_path)
+                  if r["kind"] == "ctl_lend"
+                  and r["payload"].get("phase") == "commit"][-1]
+        assert commit["payload"]["recovered"] is True
+        assert not lad2.rollbacks
+
+
+# ---------------------------------------------------------------------------
+# multi-row lends
+# ---------------------------------------------------------------------------
+
+
+class TestMultiRowLIFO:
+    def test_second_row_waits_for_first_to_serve(self, tmp_path):
+        serving = set()
+        lad = _Ladder(serving=lambda rank: rank in serving)
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1, 2],
+                                 config=_cfg(lend_budget=2),
+                                 actuators=lad.actuators())
+        assert ctl._transition("lend", dict(SAMP))["ranks"] == [2]
+        # row 2 not yet serving: the second lend DEFERS, no journal row
+        assert ctl._transition("lend", dict(SAMP)) is None
+        assert ctl.deferred_lends == 1 and ctl.lent == {2}
+        serving.add(2)
+        assert ctl._transition("lend", dict(SAMP))["ranks"] == [1]
+        assert ctl.lent == {1, 2} and ctl.lent_order == [2, 1]
+
+    def test_reclaim_is_lifo_and_replay_rebuilds_the_stack(
+            self, tmp_path):
+        serving = {0, 1, 2}
+        lad = _Ladder(serving=lambda rank: rank in serving)
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1, 2],
+                                 config=_cfg(lend_budget=3),
+                                 actuators=lad.actuators())
+        ctl._transition("lend", dict(SAMP))
+        ctl._transition("lend", dict(SAMP))
+        assert ctl.lent_order == [2, 1]
+        # LIFO: the most recent loan returns first
+        assert ctl._transition("reclaim", dict(SAMP))["ranks"] == [1]
+        assert ctl._transition("reclaim", dict(SAMP))["ranks"] == [2]
+        ctl._transition("lend", dict(SAMP))
+        ctl._transition("lend", dict(SAMP))
+        # replay rebuilds the stack, not just the set
+        fresh = fc.FleetController(str(tmp_path), donor_ranks=[0, 1, 2])
+        assert fresh.lent == {1, 2} and fresh.lent_order == [2, 1]
+        rec = [r for r in _journal(tmp_path)
+               if r["kind"] == "ctl_recover"][-1]["payload"]
+        assert rec["order"] == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# pressure prediction
+# ---------------------------------------------------------------------------
+
+
+class _TrendMonitor:
+    """Zero rejections, rising (or scripted) fleet TTFT p99."""
+
+    def __init__(self, p99s):
+        self.p99s = list(p99s)
+        self.t = -1
+
+    def serving_sample(self):
+        self.t = min(self.t + 1, len(self.p99s) - 1)
+        p99 = self.p99s[self.t]
+        return {"admitted": 100 + self.t, "rejected": 0,
+                "ttft_p50_ms": p99 / 2.0, "ttft_p99_ms": p99}
+
+
+class TestPredictor:
+    def test_rising_ttft_lends_before_any_rejection(self, tmp_path):
+        mon = _TrendMonitor([10, 20, 40, 80, 160, 320, 640, 1280])
+        ctl = fc.FleetController(
+            str(tmp_path), monitor=mon, donor_ranks=[0, 1],
+            config=_cfg(predict=True, predict_n=3, cooldown_n=1),
+            emit=False)
+        verbs = [ctl.window() for _ in range(8)]
+        lends = [v for v in verbs if v and v["verb"] == "lend"]
+        assert lends, "trend never predicted the burn"
+        # _TrendMonitor scripts zero rejections throughout, so the
+        # lend fired on PREDICTED pressure alone
+        assert lends[0]["pressure"] >= ctl.cfg.pressure
+
+    def test_predict_off_stays_quiet_on_the_same_trend(self, tmp_path):
+        mon = _TrendMonitor([10, 20, 40, 80, 160, 320, 640, 1280])
+        ctl = fc.FleetController(
+            str(tmp_path), monitor=mon, donor_ranks=[0, 1],
+            config=_cfg(predict=False, cooldown_n=1), emit=False)
+        assert all(ctl.window() is None for _ in range(8))
+
+    def test_flat_trend_predicts_nothing(self, tmp_path):
+        mon = _TrendMonitor([100] * 8)
+        ctl = fc.FleetController(
+            str(tmp_path), monitor=mon, donor_ranks=[0, 1],
+            config=_cfg(predict=True, predict_n=3), emit=False)
+        for _ in range(8):
+            assert ctl.window() is None
+
+    def test_env_knobs(self, _clean):
+        _clean.setenv("PADDLE_CTL_PREDICT", "on")
+        _clean.setenv("PADDLE_CTL_PREDICT_N", "6")
+        cfg = fc.CtlConfig()
+        assert cfg.predict is True and cfg.predict_n == 6
+        _clean.setenv("PADDLE_CTL_PREDICT_N", "1")
+        assert fc.CtlConfig().predict_n == 2  # slope needs two points
+
+    def test_flap_bound_holds_under_the_predictor(self, tmp_path):
+        """A p99 square wave through the predictor still respects the
+        cooldown: at most one transition per cooldown window."""
+        wave = ([10, 400, 10, 400] * 8)[:32]
+        mon = _TrendMonitor(wave)
+        cfg = _cfg(predict=True, predict_n=2, sustain_n=2, cooldown_n=3)
+        ctl = fc.FleetController(str(tmp_path), monitor=mon,
+                                 donor_ranks=[0, 1], config=cfg,
+                                 emit=False)
+        stamps = []
+        for w in range(32):
+            if ctl.window() is not None:
+                stamps.append(w)
+        for a, b in zip(stamps, stamps[1:]):
+            assert b - a > cfg.cooldown_n, stamps
+
+
+# ---------------------------------------------------------------------------
+# router: mid-flight host admission
+# ---------------------------------------------------------------------------
+
+
+class _InstantHost:
+    def __init__(self):
+        self.taken = []
+
+    def stats(self):
+        return HostStats(queue_depth=0, inflight=0, tokens_per_sec=1e4)
+
+    def submit(self, req):
+        self.taken.append(req)
+
+
+class TestRouterAddHost:
+    def test_add_host_joins_rotation(self):
+        r = Router([_InstantHost()], admit_queue=2)
+        idx = r.add_host(_InstantHost(), units=3)
+        assert idx == 1
+        assert len(r.hosts) == len(r.capacity) == len(r._health) == 2
+        assert r.capacity[1] == 3
+        assert r.host_state(1) == "healthy"
+        # the new host is schedulable on the very next submit
+        for i in range(6):
+            assert r.submit({"rid": f"a{i}", "token_ids": [1]}) is not None
+        assert r.hosts[1].taken, "new host never scheduled"
+
+    def test_indices_stay_stable(self):
+        h0, h1 = _InstantHost(), _InstantHost()
+        r = Router([h0], admit_queue=2)
+        assert r.add_host(h1) == 1
+        assert r.hosts[0] is h0 and r.hosts[1] is h1
+
+
+# ---------------------------------------------------------------------------
+# forced reclaim
+# ---------------------------------------------------------------------------
+
+
+class TestForceReclaim:
+    def test_dead_lent_worker_returns_to_training_books(self, tmp_path):
+        lad = _Ladder(serving=lambda rank: True)
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg(),
+                                 actuators=lad.actuators())
+        ctl._transition("lend", dict(SAMP))
+        rec = ctl.force_reclaim(1, "lent_worker_crash rc=-9")
+        assert rec["forced"] is True and ctl.lent == set()
+        rows = [r["payload"] for r in _journal(tmp_path)
+                if r["kind"] == "ctl_reclaim"]
+        assert [p["phase"] for p in rows] == ["begin", "commit"]
+        assert all(p["forced"] for p in rows)
+        # replay agrees: nothing lent, the stack is empty
+        fresh = fc.FleetController(str(tmp_path), donor_ranks=[0, 1])
+        assert fresh.lent == set() and fresh.lent_order == []
+
+    def test_not_lent_is_a_noop(self, tmp_path):
+        ctl = fc.FleetController(str(tmp_path), donor_ranks=[0, 1],
+                                 config=_cfg())
+        assert ctl.force_reclaim(1, "spurious") is None
+        assert _journal(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# launcher E2E (slow: full live cycle + SIGKILL crash matrix)
+# ---------------------------------------------------------------------------
+
+
+def _launch_env(obs, serve, ckpt, steps=60, hot=20, **extra):
+    env = dict(os.environ)
+    env.pop("PADDLE_FAULT_SPEC", None)
+    env.update({
+        "PADDLE_OBS_DIR": obs, "PADDLE_CTL": "live",
+        "PADDLE_RESHARD_MODE": "shrink", "PADDLE_MON_POLL": "0.05",
+        "PADDLE_CTL_WINDOW_S": "0.15", "PADDLE_CTL_SUSTAIN_N": "2",
+        "PADDLE_CTL_COOLDOWN_N": "2",
+        "PADDLE_CTL_SERVE_CKPT": ckpt, "PADDLE_CTL_SERVE_DIR": serve,
+        "TINY_MODE": "live", "TINY_TRAIN_STEPS": str(steps),
+        "TINY_TRAIN_DT": "0.05", "TINY_SERVE_HOT": str(hot),
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.update(extra)
+    return env
+
+
+def _launch(env, timeout=90):
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", os.path.join(HELPERS, "tiny_rank.py")],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _stage_requests(serve, rank, rids):
+    inbox = os.path.join(serve, f"host{rank}", "inbox")
+    os.makedirs(inbox, exist_ok=True)
+    for rid in rids:
+        with open(os.path.join(inbox, f"req_{rid}.json"), "w") as f:
+            json.dump({"rid": rid, "token_ids": [5, 7],
+                       "max_new_tokens": 3}, f)
+
+
+@pytest.mark.slow
+class TestLiveLaunchE2E:
+    def test_full_live_cycle(self, tmp_path):
+        """Lend → the lent rank serves real mailbox requests →
+        reclaim → dp restored, rc 0 — with loss continuity against an
+        uninterrupted run and zero dropped serving requests."""
+        obs = str(tmp_path / "obs")
+        serve = str(tmp_path / "serve")
+        ckpt = str(tmp_path / "w.pdqparams")
+        os.makedirs(obs)
+        with open(ckpt, "wb") as f:
+            f.write(b"\0" * 200_000)
+        rids = ["r1", "r2", "r3"]
+        _stage_requests(serve, 1, rids)
+        loss = str(tmp_path / "loss.txt")
+        p = _launch(_launch_env(obs, serve, ckpt,
+                                TINY_LOSS_FILE=loss))
+        assert p.returncode == 0, p.stderr[-2000:]
+
+        # --- the journal tells the whole story, phase by phase
+        kinds = _ctl_kinds(obs)
+        lends = [k for k in kinds if k[0] == "ctl_lend"
+                 and k[1] == "commit"]
+        reclaims = [k for k in kinds if k[0] == "ctl_reclaim"
+                    and k[1] == "commit"]
+        assert lends and reclaims, kinds
+        first_cycle = kinds[:kinds.index(("ctl_reclaim", "commit",
+                                          None)) + 1]
+        assert first_cycle[:8] == [
+            ("ctl_lend", "begin", None),
+            ("ctl_phase", "begin", "depart"),
+            ("ctl_phase", "commit", "depart"),
+            ("ctl_phase", "begin", "deliver"),
+            ("ctl_phase", "commit", "deliver"),
+            ("ctl_phase", "begin", "join"),
+            ("ctl_phase", "commit", "join"),
+            ("ctl_lend", "commit", None),
+        ]
+        assert ("ctl_phase", "commit", "rejoin") in first_cycle
+        # nothing lent at exit: the dp row came home
+        fresh = fc.FleetController(obs, donor_ranks=[0, 1], emit=False)
+        assert fresh.lent == set()
+
+        # --- zero dropped requests: every staged rid completed, with
+        # the deterministic continuation (prefix 5,7 → 219, 810, 189)
+        outbox = os.path.join(serve, "host1", "outbox")
+        for rid in rids:
+            done = os.path.join(outbox, f"done_{rid}.json")
+            assert os.path.exists(done), f"request {rid} dropped"
+            out = json.load(open(done))
+            assert out["token_ids"] == [5, 7, 219, 810, 189]
+        drained = [r for r in _journal(obs)
+                   if r["kind"] == "ctl_phase"
+                   and r["payload"].get("stage") == "drain"
+                   and r["payload"].get("phase") == "commit"]
+        assert drained, "reclaim never drained"
+
+        # --- loss continuity: rank 0 stepped exactly TINY_TRAIN_STEPS
+        # times (no relaunch, no rewind) and the trajectory matches an
+        # uninterrupted baseline run exactly
+        lines = open(loss).read().splitlines()
+        assert len(lines) == 60, "rank 0 restarted or skipped steps"
+        base_obs = str(tmp_path / "obs_base")
+        os.makedirs(base_obs)
+        base_loss = str(tmp_path / "loss_base.txt")
+        env = _launch_env(base_obs, str(tmp_path / "sv2"), ckpt,
+                          TINY_LOSS_FILE=base_loss)
+        env["PADDLE_CTL"] = "off"   # the uninterrupted reference
+        p2 = _launch(env)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        base = open(base_loss).read().splitlines()
+        assert len(base) == len(lines)
+        for got, want in zip(lines, base):
+            d = abs(float(got.split()[1]) - float(want.split()[1]))
+            assert d < 1e-4, (got, want)
+
+    @pytest.mark.parametrize("phase", ["depart", "deliver", "join",
+                                       "drain"])
+    def test_sigkill_crash_matrix_recovers_from_journal(
+            self, tmp_path, phase):
+        """A SIGKILL between ``phase``'s begin and commit takes the
+        LAUNCHER down mid-migration; a restart over the same obs dir
+        recovers a consistent ownership state from the journal alone
+        and the incident chain names the phase."""
+        obs = str(tmp_path / "obs")
+        serve = str(tmp_path / "serve")
+        ckpt = str(tmp_path / "w.pdqparams")
+        os.makedirs(obs)
+        with open(ckpt, "wb") as f:
+            f.write(b"\0" * 50_000)
+        env = _launch_env(obs, serve, ckpt, steps=40, hot=15)
+        env["PADDLE_FAULT_SPEC"] = f"ctl:lend_crash:1:{phase}"
+        p = _launch(env)
+        assert p.returncode != 0   # SIGKILL took the launcher down
+        assert f"lend_crash firing mid-{phase}" in (p.stderr + p.stdout)
+        kinds = _ctl_kinds(obs)
+        assert kinds[-1] == ("ctl_phase", "begin", phase), kinds[-3:]
+        # children must not outlive the dead launcher (orphan check)
+        spawn = [r for r in _journal(obs) if r["kind"] == "elastic_spawn"]
+        deadline = time.monotonic() + 10
+        pids = spawn[-1]["payload"]["pids"]
+        while time.monotonic() < deadline:
+            if not any(_pid_alive(pid) for pid in pids):
+                break
+            time.sleep(0.2)
+        assert not any(_pid_alive(pid) for pid in pids), \
+            "orphaned tiny ranks survived the launcher SIGKILL"
+
+        # restart, same journal, no fault: recovery reconciles, then a
+        # fresh clean cycle runs on top of it
+        env2 = _launch_env(obs, serve, ckpt, steps=40, hot=15)
+        p2 = _launch(env2)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        assert "recovered from journal" in p2.stderr
+        rows = _journal(obs)
+        rec = [r for r in rows if r["kind"] == "ctl_recover"]
+        assert rec, "restart never wrote its recovery row"
+        if phase in fc.LEND_PHASES:
+            # uncommitted lend: rolled back, the abort names the phase
+            aborts = [r["payload"] for r in rows
+                      if r["kind"] == "ctl_abort"]
+            assert any(a.get("stage") == phase and
+                       a.get("reason") == "recovered begin without commit"
+                       for a in aborts)
+        else:
+            # drain crash: the lent child died with the launcher, so
+            # the planes answer "no longer serving" and recovery writes
+            # the missing reclaim commit — either way, OWNERSHIP IS
+            # CONSISTENT: nothing half-lent on the books
+            pass
+        fresh = fc.FleetController(obs, donor_ranks=[0, 1], emit=False)
+        assert fresh.lent == set(), "a half-lent chip survived recovery"
+        # the incident chain names the crashed phase
+        chains = " | ".join(r["payload"].get("chain", "")
+                            for r in rows if r["kind"] == "incident")
+        assert phase in chains, chains
+
+    def test_lent_worker_death_forces_reclaim(self, tmp_path):
+        """The lent rank dies WHILE SERVING: the launcher journals a
+        forced reclaim (ownership back to training, no ladder) and the
+        job still exits 0 on the surviving rank."""
+        obs = str(tmp_path / "obs")
+        serve = str(tmp_path / "serve")
+        ckpt = str(tmp_path / "w.pdqparams")
+        os.makedirs(obs)
+        with open(ckpt, "wb") as f:
+            f.write(b"\0" * 50_000)
+        env = _launch_env(obs, serve, ckpt, steps=50, hot=12)
+        env["PADDLE_FAULT_SPEC"] = "serve:lent_worker_crash:1:1"
+        p = _launch(env)
+        assert p.returncode == 0, p.stderr[-2000:]
+        forced = [r["payload"] for r in _journal(obs)
+                  if r["kind"] == "ctl_reclaim"
+                  and r["payload"].get("forced")]
+        assert [f["phase"] for f in forced] == ["begin", "commit"]
+        assert "lent_worker_crash" in forced[0]["reason"]
+        fresh = fc.FleetController(obs, donor_ranks=[0, 1], emit=False)
+        assert fresh.lent == set()
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False

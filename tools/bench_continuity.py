@@ -83,6 +83,12 @@ def metric_direction(name: str):
         return 1
     if name == "serve_failover_recovery_ms_migrate":
         return -1  # round-17 migrate twin of the gated _ms key
+    if name == "ctl_live_reclaim_ms":
+        # round-20 live lend: the reclaim ladder's wall time scales
+        # with whatever queue depth drain happens to find — a load
+        # artifact, not a regression signal. The lend-side twin
+        # (ctl_live_lend_ms) IS gated by the _ms rule below.
+        return None
     if name.endswith("_ms") or name.endswith("_s"):
         return -1
     # round-19 quantization byte accounting: static shape arithmetic,

@@ -260,6 +260,24 @@ def chrome_trace(streams: Dict[int, List[dict]],
                               "dur_ms", "recovered")},
                 })
                 continue
+            if kind == "ctl_phase" and payload.get("phase") == "commit":
+                # live lend phase ladder (ISSUE 20): one slice per
+                # committed phase on the controller lane, nested inside
+                # the enclosing lend/reclaim slice, so a migration
+                # reads depart -> deliver -> join (or drain -> leave ->
+                # rejoin) with each stage's wall time; a crashed phase
+                # leaves only its begin instant — the visible scar
+                dur = float(payload.get("dur_ms") or 0.0) * 1e3
+                events.append({
+                    "ph": "X",
+                    "name": f"{payload.get('verb')}:{payload.get('stage')}",
+                    "pid": rank, "tid": "controller",
+                    "ts": max(us(t) - dur, 0.0), "dur": max(dur, 1.0),
+                    "args": {k: payload.get(k) for k in
+                             ("seq", "verb", "stage", "ranks",
+                              "dur_ms")},
+                })
+                continue
             if kind == "reshard":
                 # elastic mesh reshard (ISSUE 11): wall_s covers drain +
                 # device-to-device moves (+ fallback reload when taken)
@@ -567,6 +585,9 @@ def summarize(streams: Dict[int, List[dict]],
     ctl = {"lend": 0, "reclaim": 0, "abort": 0, "recover": 0}
     ctl_ms: List[float] = []
     ctl_last_lent = None
+    # live phase ladder (ISSUE 20): per-stage medians so the summary
+    # prices WHERE a migration spends its wall time
+    phase_ms: Dict[str, List[float]] = {}
     for rows in streams.values():
         for r in rows:
             p = r.get("payload")
@@ -579,6 +600,10 @@ def summarize(streams: Dict[int, List[dict]],
                 if isinstance(p.get("dur_ms"), (int, float)):
                     ctl_ms.append(float(p["dur_ms"]))
                 ctl_last_lent = p.get("lent", ctl_last_lent)
+            elif k == "ctl_phase" and p.get("phase") == "commit" and \
+                    isinstance(p.get("dur_ms"), (int, float)):
+                phase_ms.setdefault(str(p.get("stage")), []).append(
+                    float(p["dur_ms"]))
             elif k == "ctl_abort":
                 ctl["abort"] += 1
             elif k == "ctl_recover":
@@ -595,6 +620,15 @@ def summarize(streams: Dict[int, List[dict]],
                else "")
             + (f" — lent now {ctl_last_lent}"
                if ctl_last_lent else " — full mesh restored"))
+    if phase_ms:
+        order = ("depart", "deliver", "join", "drain", "leave",
+                 "rejoin")
+        parts = [f"{s} {_median(phase_ms[s]):.1f}ms"
+                 for s in order if s in phase_ms]
+        parts += [f"{s} {_median(v):.1f}ms"
+                  for s, v in sorted(phase_ms.items())
+                  if s not in order]
+        lines.append("  phase ladder (median): " + ", ".join(parts))
     for p in incidents:
         lines.append(f"INCIDENT #{p.get('id')} ranks {p.get('ranks')}: "
                      f"{p.get('chain')}")
